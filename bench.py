@@ -1,0 +1,83 @@
+"""Benchmark: samples/sec/volunteer-chip on the flagship train step.
+
+Run on real TPU hardware by the driver at end of round; prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric per BASELINE.json:2 (samples/sec/volunteer-chip). The reference
+publishes no numbers ("published": {}, BASELINE.json:13), so vs_baseline is
+reported against this framework's own first recorded number (ratchet), 1.0
+when no prior record exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    # Default flips to gpt2_small (the north-star config) once the full zoo
+    # lands; mnist_mlp is the always-available fallback.
+    model_name = os.environ.get("DVC_BENCH_MODEL", "mnist_mlp")
+    batch_size = int(os.environ.get("DVC_BENCH_BATCH", "8"))
+    warmup = max(int(os.environ.get("DVC_BENCH_WARMUP", "3")), 1)
+    iters = int(os.environ.get("DVC_BENCH_ITERS", "20"))
+
+    from distributedvolunteercomputing_tpu.models import get_model
+    from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+    from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+
+    bundle = get_model(model_name)
+    rng = jax.random.PRNGKey(0)
+    tx = make_optimizer("adamw", lr=1e-4)
+    state = TrainState.create(bundle.init(jax.random.PRNGKey(1)), tx, jax.random.PRNGKey(2))
+    step = make_train_step(bundle.loss_fn, tx)
+    batch = bundle.make_batch(rng, batch_size)
+
+    for _ in range(warmup):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    # The single-volunteer step runs on the default device only; divide by the
+    # devices the computation actually uses, not everything visible.
+    n_chips = len(m["loss"].sharding.device_set)
+    samples_per_sec_chip = batch_size * iters / dt / n_chips
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_baseline.json")
+    vs_baseline = 1.0
+    prior = {}
+    try:
+        with open(baseline_path) as fh:
+            prior = json.load(fh)
+    except (OSError, ValueError):
+        pass
+    if prior.get("model") == model_name and prior.get("value"):
+        vs_baseline = samples_per_sec_chip / float(prior["value"])
+    else:
+        with open(baseline_path, "w") as fh:
+            json.dump({"model": model_name, "value": samples_per_sec_chip}, fh)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"samples/sec/volunteer-chip ({model_name}, bs={batch_size})",
+                "value": round(samples_per_sec_chip, 3),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""distributedvolunteercomputing_tpu — a TPU-native volunteer-computing training framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the reference
+``SrinivasBaskar1995/DistributedVolunteerComputing`` (see SURVEY.md — the
+reference source mount was empty this round; parity targets come from the
+driver metadata in BASELINE.json):
+
+- per-volunteer ``train_step`` compiled with ``jax.jit``/``pjit`` (reference:
+  per-worker CUDA train_step, BASELINE.json:5)
+- ``GradientAverager`` with synchronous / gossip / butterfly / Byzantine-robust
+  modes over a host-side DCN transport (reference: NCCL/gloo GradientAverager +
+  gossip + butterfly + Byzantine aggregation, BASELINE.json:5)
+- DHT peer discovery, heartbeat liveness, join/leave churn handling
+  (reference: coordinator/DHT/heartbeat/join-leave, BASELINE.json:5)
+- intra-slice collectives ride ICI via XLA (``jax.lax.psum`` under ``pjit``);
+  inter-slice averaging rides DCN via the swarm transport.
+
+Layer map (mirrors SURVEY.md §1):
+
+    L6 entrypoints   coordinator.py / run_volunteer.py (repo root)
+    L5 trainer       distributedvolunteercomputing_tpu.training
+    L4 averaging     distributedvolunteercomputing_tpu.swarm.{averager,gossip,butterfly,byzantine}
+    L3 membership    distributedvolunteercomputing_tpu.swarm.{dht,heartbeat,membership,coordinator}
+    L2 transport     distributedvolunteercomputing_tpu.swarm.transport (+ native C++ core)
+    L1 compute       distributedvolunteercomputing_tpu.{models,ops,parallel}
+"""
+
+from distributedvolunteercomputing_tpu.version import __version__
+
+__all__ = ["__version__"]

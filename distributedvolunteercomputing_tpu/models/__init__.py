@@ -1,0 +1,3 @@
+from distributedvolunteercomputing_tpu.models.registry import ModelBundle, get_model, list_models
+
+__all__ = ["ModelBundle", "get_model", "list_models"]

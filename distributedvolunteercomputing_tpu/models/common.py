@@ -1,0 +1,95 @@
+"""Shared building blocks for the plain-JAX functional model zoo.
+
+Models are pure functions over explicit param pytrees (nested dicts of
+jnp arrays). That keeps the whole zoo uniform for the three things this
+framework does with params: shard them with ``pjit``, average them on host
+across volunteers, and checkpoint them — no framework Module state to
+special-case.
+
+Params are stored float32; matmul-heavy compute casts to bfloat16 on TPU so
+the MXU runs at full rate. Reference parity: the CUDA train_step genre uses
+AMP the same way (SURVEY.md L1/L5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+# bf16 on TPU keeps the MXU at full rate; f32 on CPU keeps tests exact enough
+# to compare against numpy references.
+def compute_dtype() -> jnp.dtype:
+    if jax.default_backend() in ("tpu", "axon"):
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def dense_init(rng: jax.Array, d_in: int, d_out: int, scale: Optional[float] = None) -> Params:
+    if scale is None:
+        scale = 1.0 / (d_in ** 0.5)
+    w_rng, _ = jax.random.split(rng)
+    return {
+        "w": (jax.random.normal(w_rng, (d_in, d_out), jnp.float32) * scale),
+        "b": jnp.zeros((d_out,), jnp.float32),
+    }
+
+
+def dense(p: Params, x: jax.Array, dtype: Optional[jnp.dtype] = None) -> jax.Array:
+    dtype = dtype or compute_dtype()
+    y = jnp.dot(x.astype(dtype), p["w"].astype(dtype))
+    return y + p["b"].astype(dtype)
+
+
+def embed_init(rng: jax.Array, vocab: int, d: int, scale: float = 0.02) -> jax.Array:
+    return jax.random.normal(rng, (vocab, d), jnp.float32) * scale
+
+
+def layernorm_init(d: int) -> Params:
+    return {"g": jnp.ones((d,), jnp.float32), "b": jnp.zeros((d,), jnp.float32)}
+
+
+def layernorm(p: Params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    # LN statistics in f32 for stability even when activations are bf16.
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["g"] + p["b"]).astype(x.dtype)
+
+
+def rmsnorm_init(d: int) -> Params:
+    return {"g": jnp.ones((d,), jnp.float32)}
+
+
+def rmsnorm(p: Params, x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (y * p["g"]).astype(x.dtype)
+
+
+def softmax_xent(logits: jax.Array, labels: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean cross-entropy; ``labels`` are int ids; optional 0/1 mask."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def count_params(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def split_keys(rng: jax.Array, n: int) -> Tuple[jax.Array, ...]:
+    return tuple(jax.random.split(rng, n))

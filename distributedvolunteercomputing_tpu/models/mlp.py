@@ -1,0 +1,44 @@
+"""2-layer MLP for MNIST — reference config 1 (BASELINE.json:7).
+
+Single-volunteer local SGD, no averaging: the minimum end-to-end slice of the
+framework (SURVEY.md §7 step 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distributedvolunteercomputing_tpu.models import common
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    d_in: int = 784
+    d_hidden: int = 256
+    n_classes: int = 10
+
+
+def init(rng: jax.Array, cfg: MLPConfig) -> common.Params:
+    k1, k2 = jax.random.split(rng)
+    return {
+        "fc1": common.dense_init(k1, cfg.d_in, cfg.d_hidden),
+        "fc2": common.dense_init(k2, cfg.d_hidden, cfg.n_classes),
+    }
+
+
+def forward(params: common.Params, x: jax.Array, cfg: MLPConfig) -> jax.Array:
+    x = x.reshape((x.shape[0], -1))
+    h = jax.nn.relu(common.dense(params["fc1"], x))
+    return common.dense(params["fc2"], h).astype(jnp.float32)
+
+
+def loss_fn(
+    params: common.Params, batch: Dict[str, jax.Array], rng: jax.Array, cfg: MLPConfig
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    logits = forward(params, batch["x"], cfg)
+    loss = common.softmax_xent(logits, batch["y"])
+    return loss, {"loss": loss, "accuracy": common.accuracy(logits, batch["y"])}

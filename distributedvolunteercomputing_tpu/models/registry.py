@@ -1,0 +1,127 @@
+"""Model registry: one bundle per reference workload (BASELINE.json:7-11).
+
+Bundles are built lazily so importing the registry never pays for the whole
+zoo. Each bundle closes over its config and exposes:
+
+    init(rng) -> params
+    loss_fn(params, batch, rng) -> (loss, metrics)
+    make_batch(rng, batch_size) -> synthetic batch with the right shapes
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+
+Batch = Dict[str, jax.Array]
+Metrics = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelBundle:
+    name: str
+    config: Any
+    init: Callable[[jax.Array], Any]
+    loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]]
+    make_batch: Callable[[jax.Array, int], Batch]
+
+
+def _mlp(**overrides: Any) -> ModelBundle:
+    from distributedvolunteercomputing_tpu.models import mlp
+    from distributedvolunteercomputing_tpu.training import data
+
+    cfg = dataclasses.replace(mlp.MLPConfig(), **overrides)
+    return ModelBundle(
+        name="mnist_mlp",
+        config=cfg,
+        init=lambda rng: mlp.init(rng, cfg),
+        loss_fn=lambda p, b, rng: mlp.loss_fn(p, b, rng, cfg),
+        make_batch=lambda rng, bs: data.synthetic_image_batch(
+            rng, bs, shape=(28, 28, 1), n_classes=cfg.n_classes
+        ),
+    )
+
+
+def _resnet18(**overrides: Any) -> ModelBundle:
+    from distributedvolunteercomputing_tpu.models import resnet
+    from distributedvolunteercomputing_tpu.training import data
+
+    cfg = dataclasses.replace(resnet.ResNetConfig(), **overrides)
+    return ModelBundle(
+        name="cifar10_resnet18",
+        config=cfg,
+        init=lambda rng: resnet.init(rng, cfg),
+        loss_fn=lambda p, b, rng: resnet.loss_fn(p, b, rng, cfg),
+        make_batch=lambda rng, bs: data.synthetic_image_batch(
+            rng, bs, shape=(32, 32, 3), n_classes=cfg.n_classes
+        ),
+    )
+
+
+def _bert(**overrides: Any) -> ModelBundle:
+    from distributedvolunteercomputing_tpu.models import bert
+    from distributedvolunteercomputing_tpu.training import data
+
+    cfg = dataclasses.replace(bert.BertConfig(), **overrides)
+    return ModelBundle(
+        name="bert_mlm",
+        config=cfg,
+        init=lambda rng: bert.init(rng, cfg),
+        loss_fn=lambda p, b, rng: bert.loss_fn(p, b, rng, cfg),
+        make_batch=lambda rng, bs: data.synthetic_mlm_batch(
+            rng, bs, seq_len=cfg.max_len, vocab=cfg.vocab, mask_id=bert.MASK_ID
+        ),
+    )
+
+
+def _gpt2(**overrides: Any) -> ModelBundle:
+    from distributedvolunteercomputing_tpu.models import gpt2
+    from distributedvolunteercomputing_tpu.training import data
+
+    cfg = dataclasses.replace(gpt2.GPT2Config(), **overrides)
+    return ModelBundle(
+        name="gpt2_small",
+        config=cfg,
+        init=lambda rng: gpt2.init(rng, cfg),
+        loss_fn=lambda p, b, rng: gpt2.loss_fn(p, b, rng, cfg),
+        make_batch=lambda rng, bs: data.synthetic_lm_batch(
+            rng, bs, seq_len=cfg.max_len, vocab=cfg.vocab
+        ),
+    )
+
+
+def _llama_lora(**overrides: Any) -> ModelBundle:
+    from distributedvolunteercomputing_tpu.models import llama
+    from distributedvolunteercomputing_tpu.training import data
+
+    cfg = dataclasses.replace(llama.LlamaConfig(), **overrides)
+    return ModelBundle(
+        name="llama_lora",
+        config=cfg,
+        init=lambda rng: llama.init(rng, cfg),
+        loss_fn=lambda p, b, rng: llama.loss_fn(p, b, rng, cfg),
+        make_batch=lambda rng, bs: data.synthetic_lm_batch(
+            rng, bs, seq_len=cfg.max_len, vocab=cfg.vocab
+        ),
+    )
+
+
+_REGISTRY: Dict[str, Callable[..., ModelBundle]] = {
+    "mnist_mlp": _mlp,
+    "cifar10_resnet18": _resnet18,
+    "bert_mlm": _bert,
+    "gpt2_small": _gpt2,
+    "llama_lora": _llama_lora,
+}
+
+
+def get_model(name: str, **overrides: Any) -> ModelBundle:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**overrides)
+
+
+def list_models() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))

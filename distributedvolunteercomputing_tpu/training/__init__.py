@@ -1,0 +1,4 @@
+from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+
+__all__ = ["TrainState", "make_train_step", "make_optimizer"]

@@ -1,0 +1,76 @@
+"""Synthetic datasets shaped like the five reference workloads.
+
+The sandbox has zero egress, so MNIST/CIFAR/corpora cannot be downloaded.
+These generators produce LEARNABLE tasks with the right tensor shapes:
+
+- images: class-conditional Gaussian blobs (fixed per-class prototypes), so a
+  classifier provably drives loss well below chance — used by the convergence
+  smoke tests (SURVEY.md §4).
+- LM: sequences from a fixed random bigram transition table, so next-token
+  prediction has low achievable entropy.
+
+Real-data loading is a thin swap: anything yielding the same dict-of-arrays
+batches works (see training.trainer.Trainer).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_PROTO_SEED = 1234  # class prototypes are global constants of the task
+_BIGRAM_SEED = 4321
+
+
+def _image_prototypes(shape: Tuple[int, ...], n_classes: int) -> jax.Array:
+    rng = jax.random.PRNGKey(_PROTO_SEED)
+    return jax.random.normal(rng, (n_classes,) + shape, jnp.float32)
+
+
+def synthetic_image_batch(
+    rng: jax.Array, batch_size: int, shape: Tuple[int, ...], n_classes: int, noise: float = 0.3
+) -> Dict[str, jax.Array]:
+    ky, kn = jax.random.split(rng)
+    y = jax.random.randint(ky, (batch_size,), 0, n_classes)
+    protos = _image_prototypes(shape, n_classes)
+    x = protos[y] + noise * jax.random.normal(kn, (batch_size,) + shape, jnp.float32)
+    return {"x": x, "y": y}
+
+
+def _bigram_table(vocab: int) -> jax.Array:
+    """Row-stochastic transition logits: each token has ~4 likely successors."""
+    rng = jax.random.PRNGKey(_BIGRAM_SEED)
+    return jax.random.normal(rng, (vocab, vocab), jnp.float32) * 2.0
+
+
+def synthetic_token_stream(rng: jax.Array, batch_size: int, seq_len: int, vocab: int) -> jax.Array:
+    table = _bigram_table(vocab)
+    k0, kseq = jax.random.split(rng)
+    first = jax.random.randint(k0, (batch_size,), 0, vocab)
+
+    def step(tok, k):
+        nxt = jax.random.categorical(k, table[tok])
+        return nxt, nxt
+
+    keys = jax.random.split(kseq, seq_len - 1)
+    _, rest = jax.lax.scan(step, first, keys)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def synthetic_lm_batch(rng: jax.Array, batch_size: int, seq_len: int, vocab: int) -> Dict[str, jax.Array]:
+    """Causal LM batch: predict tokens[1:] from tokens[:-1]."""
+    toks = synthetic_token_stream(rng, batch_size, seq_len + 1, vocab)
+    return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+def synthetic_mlm_batch(
+    rng: jax.Array, batch_size: int, seq_len: int, vocab: int, mask_id: int, mask_rate: float = 0.15
+) -> Dict[str, jax.Array]:
+    """BERT-style MLM batch: 15% of positions replaced by [MASK], predict originals."""
+    kt, km = jax.random.split(rng)
+    toks = synthetic_token_stream(kt, batch_size, seq_len, vocab)
+    mask = jax.random.bernoulli(km, mask_rate, toks.shape)
+    inputs = jnp.where(mask, mask_id, toks)
+    return {"tokens": inputs, "targets": toks, "mask": mask.astype(jnp.float32)}

@@ -1,0 +1,47 @@
+"""Optimizers for the volunteer train loop (optax-backed).
+
+The reference's per-worker loop runs a local optimizer step every batch and
+averages every K steps (SURVEY.md §3-C); any optax GradientTransformation
+slots in here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+def make_optimizer(
+    name: str = "adamw",
+    lr: float = 1e-3,
+    weight_decay: float = 0.0,
+    warmup_steps: int = 0,
+    total_steps: Optional[int] = None,
+    grad_clip: Optional[float] = 1.0,
+    momentum: float = 0.9,
+) -> optax.GradientTransformation:
+    if total_steps and total_steps > warmup_steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=total_steps,
+        )
+    elif warmup_steps:
+        schedule = optax.linear_schedule(0.0, lr, warmup_steps)
+    else:
+        schedule = lr
+
+    if name == "adamw":
+        core = optax.adamw(schedule, weight_decay=weight_decay)
+    elif name == "adam":
+        core = optax.adam(schedule)
+    elif name == "sgd":
+        core = optax.sgd(schedule, momentum=momentum)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+
+    if grad_clip:
+        return optax.chain(optax.clip_by_global_norm(grad_clip), core)
+    return core

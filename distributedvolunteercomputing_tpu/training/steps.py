@@ -1,0 +1,74 @@
+"""The compiled per-volunteer train step.
+
+Reference parity: the per-worker CUDA ``train_step`` (BASELINE.json:5) —
+forward + backward + local optimizer update, entirely on-device. Here it is
+one ``jax.jit`` computation with donated state, so XLA fuses fwd/bwd/update
+and the params never round-trip to host between steps. The multi-chip variant
+(psum over ICI inside the same compiled step) lives in
+``parallel/train_step.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Batch = Dict[str, jax.Array]
+Metrics = Dict[str, jax.Array]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """Everything the volunteer owns on-device: params, opt state, step, rng."""
+
+    params: Any
+    opt_state: Any
+    step: jax.Array
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, params: Any, tx: optax.GradientTransformation, rng: jax.Array) -> "TrainState":
+        return cls(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+            rng=rng,
+        )
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
+    tx: optax.GradientTransformation,
+    donate: bool = True,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
+    """Build the jitted ``(state, batch) -> (state, metrics)`` step."""
+
+    def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
+        rng, step_rng = jax.random.split(state.rng)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, metrics), grads = grad_fn(state.params, batch, step_rng)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=params, opt_state=opt_state, step=state.step + 1, rng=rng
+        )
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
+) -> Callable[[Any, Batch, jax.Array], Metrics]:
+    def ev(params: Any, batch: Batch, rng: jax.Array) -> Metrics:
+        _, metrics = loss_fn(params, batch, rng)
+        return metrics
+
+    return jax.jit(ev)

@@ -1,0 +1,127 @@
+"""The volunteer train loop: local SGD + periodic collaborative averaging.
+
+Reference call stack C (SURVEY.md §3): data -> device -> fwd/bwd -> local
+optimizer step -> every K steps, hand params to the averager and continue
+from the averaged result. The averager is injected as a callback so the
+trainer (L5) never imports the swarm (L3/L4) — config 1 (single volunteer,
+no averaging, BASELINE.json:7) is just ``averager=None``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import numpy as np
+
+from distributedvolunteercomputing_tpu.models.registry import Batch, ModelBundle
+from distributedvolunteercomputing_tpu.training.metrics import MetricsWriter
+from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Averager callback: takes the CURRENT host params pytree, returns the
+# averaged pytree (or None to keep local params, e.g. when no group formed).
+AveragerFn = Callable[[Any, int], Optional[Any]]
+
+
+class Trainer:
+    def __init__(
+        self,
+        bundle: ModelBundle,
+        batch_size: int = 32,
+        optimizer: str = "adamw",
+        lr: float = 1e-3,
+        seed: int = 0,
+        average_every: int = 10,
+        averager: Optional[AveragerFn] = None,
+        metrics_path: Optional[str] = None,
+        volunteer_id: str = "local",
+        total_steps: Optional[int] = None,
+    ):
+        self.bundle = bundle
+        self.batch_size = batch_size
+        self.average_every = average_every
+        self.averager = averager
+        rng = jax.random.PRNGKey(seed)
+        init_rng, data_rng, state_rng = jax.random.split(rng, 3)
+        self.tx = make_optimizer(optimizer, lr=lr, total_steps=total_steps)
+        params = bundle.init(init_rng)
+        self.state = TrainState.create(params, self.tx, state_rng)
+        self._step_fn = make_train_step(bundle.loss_fn, self.tx)
+        self._data_rng = data_rng
+        self.metrics = MetricsWriter(metrics_path, volunteer_id)
+
+    def data_iter(self) -> Iterable[Batch]:
+        rng = self._data_rng
+        while True:
+            rng, k = jax.random.split(rng)
+            yield self.bundle.make_batch(k, self.batch_size)
+
+    def run(
+        self,
+        steps: int,
+        target_loss: Optional[float] = None,
+        log_every: int = 50,
+        stop_flag: Optional[Callable[[], bool]] = None,
+    ) -> Dict[str, float]:
+        """Train for ``steps`` (or until ``target_loss``); returns summary."""
+        it = iter(self.data_iter())
+        # Materialising metrics forces a host<->device sync that breaks JAX's
+        # async dispatch pipelining — only pay for it when something consumes
+        # the value (target check, JSONL record, or a log line).
+        sync_every_step = target_loss is not None or self.metrics.has_sink
+        m = None
+        last_loss = float("nan")
+        start_step = int(self.state.step)
+        t_start = time.monotonic()
+        ran_steps = 0
+        for i in range(steps):
+            if stop_flag is not None and stop_flag():
+                log.info("stop flag set; exiting train loop at step %d", int(self.state.step))
+                break
+            batch = next(it)
+            self.state, m = self._step_fn(self.state, batch)
+            ran_steps += 1
+            step_no = start_step + ran_steps
+            at_log_point = bool(log_every) and step_no % log_every == 0
+            if sync_every_step or at_log_point:
+                last_loss = float(m["loss"])
+                self.metrics.record(step_no, m, n_samples=self.batch_size)
+            else:
+                self.metrics.count_samples(self.batch_size)
+
+            if self.averager is not None and step_no % self.average_every == 0:
+                averaged = self.averager(self.state.params, step_no)
+                if averaged is not None:
+                    self.state = TrainState(
+                        params=jax.device_put(
+                            jax.tree_util.tree_map(np.asarray, averaged)
+                        ),
+                        opt_state=self.state.opt_state,
+                        step=self.state.step,
+                        rng=self.state.rng,
+                    )
+
+            if at_log_point:
+                log.info(
+                    "step %d loss %.4f (%.1f samples/s)",
+                    step_no,
+                    last_loss,
+                    self.metrics.samples_per_sec(),
+                )
+            if target_loss is not None and last_loss <= target_loss:
+                log.info("target loss %.4f reached at step %d", target_loss, step_no)
+                break
+        if m is not None:
+            last_loss = float(m["loss"])  # sync once at the end regardless
+        wall = time.monotonic() - t_start
+        return {
+            "final_loss": last_loss,
+            "steps": int(self.state.step),
+            "wall_time_s": wall,
+            "samples_per_sec": ran_steps * self.batch_size / wall if wall > 0 else 0.0,
+        }

@@ -1,0 +1,17 @@
+from distributedvolunteercomputing_tpu.utils.pytree import (
+    TensorSpec,
+    flatten_to_buffer,
+    unflatten_from_buffer,
+    tree_size_bytes,
+    tree_zeros_like,
+)
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+__all__ = [
+    "TensorSpec",
+    "flatten_to_buffer",
+    "unflatten_from_buffer",
+    "tree_size_bytes",
+    "tree_zeros_like",
+    "get_logger",
+]

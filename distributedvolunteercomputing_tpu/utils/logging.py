@@ -1,0 +1,25 @@
+"""Per-volunteer structured logging.
+
+Swarm-level metric aggregation happens at the coordinator (SURVEY.md §5);
+each process logs human-readable lines to stderr and machine-readable JSONL
+via training.metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
+
+
+def get_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers and not logging.getLogger().handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("DVC_LOGLEVEL", "INFO").upper())
+        logger.propagate = False
+    return logger

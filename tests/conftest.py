@@ -1,0 +1,46 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Multi-chip hardware is not available in the sandbox; all sharding tests run
+on xla_force_host_platform_device_count=8 CPU devices (SURVEY.md §4
+"multi-node-without-a-cluster"). Swarm tests additionally spawn real
+localhost processes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+# The sandbox's sitecustomize imports jax at interpreter startup (to register
+# the axon TPU plugin), so jax.config has already snapshotted JAX_PLATFORMS —
+# override via config, not just env.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    import jax
+
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.default_rng(0)

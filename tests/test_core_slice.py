@@ -1,0 +1,114 @@
+"""Tests for the minimum end-to-end slice: utils, MLP, train step, trainer.
+
+Mirrors reference config 1: MNIST MLP, single volunteer, local SGD, no
+averaging (BASELINE.json:7).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.models import get_model, list_models
+from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+from distributedvolunteercomputing_tpu.training.trainer import Trainer
+from distributedvolunteercomputing_tpu.utils.pytree import (
+    flatten_to_buffer,
+    unflatten_from_buffer,
+    tree_size_bytes,
+)
+
+
+class TestPytreeSerde:
+    def test_roundtrip(self, rng):
+        tree = {
+            "a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "b": {"c": jnp.ones((4,), jnp.bfloat16), "d": jnp.zeros((2, 2, 2), jnp.int32)},
+        }
+        buf, specs, treedef = flatten_to_buffer(tree)
+        assert buf.dtype == np.float32
+        assert buf.size == 6 + 4 + 8
+        out = unflatten_from_buffer(buf, specs, treedef)
+        for orig, rec in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(out)):
+            assert np.asarray(orig).dtype == rec.dtype
+            np.testing.assert_allclose(np.asarray(orig, np.float32), rec.astype(np.float32))
+
+    def test_empty_tree(self):
+        buf, specs, treedef = flatten_to_buffer({})
+        assert buf.size == 0
+        assert unflatten_from_buffer(buf, specs, treedef) == {}
+
+    def test_size_mismatch_raises(self):
+        tree = {"a": jnp.ones((3,))}
+        buf, specs, treedef = flatten_to_buffer(tree)
+        with pytest.raises(ValueError):
+            unflatten_from_buffer(buf[:-1], specs, treedef)
+
+    def test_tree_size_bytes(self):
+        assert tree_size_bytes({"a": jnp.ones((4,), jnp.float32)}) == 16
+
+
+class TestMLP:
+    def test_registry_lists_all_configs(self):
+        names = list_models()
+        for expected in ("mnist_mlp", "cifar10_resnet18", "bert_mlm", "gpt2_small", "llama_lora"):
+            assert expected in names
+
+    def test_forward_shapes_and_loss(self, rng):
+        bundle = get_model("mnist_mlp")
+        params = bundle.init(rng)
+        batch = bundle.make_batch(rng, 16)
+        loss, metrics = bundle.loss_fn(params, batch, rng)
+        assert loss.shape == ()
+        assert np.isfinite(float(loss))
+        assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+    def test_train_step_reduces_loss(self):
+        # NB: the step donates its input state, so every TrainState.create gets
+        # fresh key/param buffers — never reuse a donated array.
+        bundle = get_model("mnist_mlp")
+        tx = make_optimizer("adam", lr=1e-2)
+        step = make_train_step(bundle.loss_fn, tx)
+        batch = bundle.make_batch(jax.random.PRNGKey(7), 64)
+        state = TrainState.create(bundle.init(jax.random.PRNGKey(8)), tx, jax.random.PRNGKey(9))
+        _, m0 = step(state, batch)
+        state = TrainState.create(bundle.init(jax.random.PRNGKey(8)), tx, jax.random.PRNGKey(9))
+        for _ in range(30):
+            state, m = step(state, batch)
+        assert float(m["loss"]) < float(m0["loss"])
+        assert int(state.step) == 30
+
+
+class TestTrainerLocalSGD:
+    def test_mnist_convergence_smoke(self):
+        # Config 1: single volunteer, no averaging, bounded steps to target loss.
+        t = Trainer(get_model("mnist_mlp"), batch_size=64, lr=1e-2, optimizer="adam", seed=0)
+        summary = t.run(steps=200, target_loss=0.3, log_every=0)
+        assert summary["final_loss"] <= 0.3, summary
+        assert summary["steps"] < 200, "should hit target before budget"
+
+    def test_target_loss_stops_early(self):
+        t = Trainer(get_model("mnist_mlp"), batch_size=32, lr=1e-2, optimizer="adam", seed=1)
+        summary = t.run(steps=500, target_loss=10.0, log_every=0)  # trivially satisfied
+        assert summary["steps"] == 1
+
+    def test_averager_callback_applied(self):
+        calls = []
+
+        def fake_averager(params, step):
+            calls.append(step)
+            # returns zeros — trainer must adopt them
+            return jax.tree_util.tree_map(lambda x: np.zeros_like(np.asarray(x)), params)
+
+        t = Trainer(
+            get_model("mnist_mlp"),
+            batch_size=8,
+            average_every=5,
+            averager=fake_averager,
+        )
+        t.run(steps=10, log_every=0)
+        assert calls == [5, 10]
+        # params adopted from averager at step 10... then no further steps ran
+        leaf = jax.tree_util.tree_leaves(t.state.params)[0]
+        assert float(jnp.abs(leaf).sum()) == 0.0
