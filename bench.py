@@ -20,9 +20,7 @@ import time
 def main() -> None:
     import jax
 
-    # Default flips to gpt2_small (the north-star config) once the full zoo
-    # lands; mnist_mlp is the always-available fallback.
-    model_name = os.environ.get("DVC_BENCH_MODEL", "mnist_mlp")
+    model_name = os.environ.get("DVC_BENCH_MODEL", "gpt2_small")
     batch_size = int(os.environ.get("DVC_BENCH_BATCH", "8"))
     warmup = max(int(os.environ.get("DVC_BENCH_WARMUP", "3")), 1)
     iters = int(os.environ.get("DVC_BENCH_ITERS", "20"))
@@ -40,13 +38,17 @@ def main() -> None:
 
     for _ in range(warmup):
         state, m = step(state, batch)
-    jax.block_until_ready(m["loss"])
+    # float() (host copy), not block_until_ready: on some backends execution
+    # errors (e.g. OOM) only surface when the value is materialized, and a
+    # benchmark that times a failed computation reports fiction.
+    float(m["loss"])
 
     t0 = time.perf_counter()
     for _ in range(iters):
         state, m = step(state, batch)
-    jax.block_until_ready(m["loss"])
+    final_loss = float(m["loss"])
     dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "NaN loss during benchmark"
 
     # The single-volunteer step runs on the default device only; divide by the
     # devices the computation actually uses, not everything visible.

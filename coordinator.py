@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Swarm coordinator entrypoint (reference-parity name, BASELINE.json:5).
+
+Bootstraps the swarm: initial DHT node + rendezvous address + liveness
+registry + swarm-level metrics. Prints ``COORDINATOR_READY host:port`` once
+listening.
+
+    python coordinator.py --host 0.0.0.0 --port 9000 --metrics swarm.jsonl
+"""
+
+import argparse
+import asyncio
+
+from distributedvolunteercomputing_tpu.swarm.coordinator import run_coordinator_forever
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0, help="0 = pick a free port")
+    ap.add_argument("--metrics", default=None, help="swarm-level metrics JSONL path")
+    ap.add_argument("--advertise-host", default=None,
+                    help="dialable address to publish when binding 0.0.0.0")
+    args = ap.parse_args()
+    try:
+        asyncio.run(
+            run_coordinator_forever(args.host, args.port, args.metrics, args.advertise_host)
+        )
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

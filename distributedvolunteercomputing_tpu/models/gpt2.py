@@ -1,0 +1,87 @@
+"""GPT-2 small — reference config 4 and the north-star workload
+(BASELINE.json:10, north_star: GPT-2-small on 4x v4-8 volunteer slices).
+
+Pre-LN transformer decoder with learned positional embeddings and tied
+input/output embeddings. Flagship model for bench.py and __graft_entry__.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distributedvolunteercomputing_tpu.models import common
+from distributedvolunteercomputing_tpu.ops.attention import multi_head_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab: int = 50257
+    max_len: int = 1024
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    # Rematerialize each block in backward: trades ~30% FLOPs for O(layers x
+    # activations) HBM — required to train at bs>=8, seq 1024 on one 16GB chip.
+    remat: bool = True
+
+
+def _layer_init(rng: jax.Array, cfg: GPT2Config) -> common.Params:
+    k = jax.random.split(rng, 4)
+    # GPT-2 uses fused qkv; residual projections scaled by 1/sqrt(2*n_layers)
+    res_scale = 1.0 / ((2 * cfg.n_layers) ** 0.5 * cfg.d_model ** 0.5)
+    return {
+        "ln1": common.layernorm_init(cfg.d_model),
+        "qkv": common.dense_init(k[0], cfg.d_model, 3 * cfg.d_model, scale=0.02),
+        "attn_out": common.dense_init(k[1], cfg.d_model, cfg.d_model, scale=res_scale),
+        "ln2": common.layernorm_init(cfg.d_model),
+        "mlp_in": common.dense_init(k[2], cfg.d_model, cfg.d_ff, scale=0.02),
+        "mlp_out": common.dense_init(k[3], cfg.d_ff, cfg.d_model, scale=res_scale),
+    }
+
+
+def init(rng: jax.Array, cfg: GPT2Config) -> common.Params:
+    keys = jax.random.split(rng, cfg.n_layers + 2)
+    return {
+        "wte": common.embed_init(keys[0], cfg.vocab, cfg.d_model),
+        "wpe": common.embed_init(keys[1], cfg.max_len, cfg.d_model, scale=0.01),
+        "blocks": [_layer_init(keys[2 + i], cfg) for i in range(cfg.n_layers)],
+        "ln_f": common.layernorm_init(cfg.d_model),
+    }
+
+
+def _block(p: common.Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
+    h = common.layernorm(p["ln1"], x)
+    qkv = common.dense(p["qkv"], h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = multi_head_attention(q, k, v, cfg.n_heads, causal=True)
+    x = x + common.dense(p["attn_out"], attn)
+    h = common.layernorm(p["ln2"], x)
+    h = common.dense(p["mlp_out"], jax.nn.gelu(common.dense(p["mlp_in"], h)))
+    return x + h
+
+
+def forward(params: common.Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    dtype = common.compute_dtype()
+    t = tokens.shape[1]
+    x = (params["wte"][tokens] + params["wpe"][:t][None]).astype(dtype)
+    blk = jax.checkpoint(lambda p, h: _block(p, h, cfg)) if cfg.remat else (
+        lambda p, h: _block(p, h, cfg)
+    )
+    for p in params["blocks"]:
+        x = blk(p, x)
+    x = common.layernorm(params["ln_f"], x)
+    # tied output embedding
+    return jnp.einsum("btd,vd->btv", x, params["wte"].astype(dtype)).astype(jnp.float32)
+
+
+def loss_fn(
+    params: common.Params, batch: Dict[str, jax.Array], rng: jax.Array, cfg: GPT2Config
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    logits = forward(params, batch["tokens"], cfg)
+    loss = common.softmax_xent(logits, batch["targets"])
+    return loss, {"loss": loss}

@@ -1,0 +1,156 @@
+"""Llama-family decoder with LoRA finetuning — reference config 5
+(BASELINE.json:11: Llama-2-7B LoRA under Byzantine-tolerant averaging).
+
+RMSNorm + RoPE + SwiGLU, no biases (Llama-2 architecture). The default
+config is a sandbox proxy (SURVEY.md §7 step 6 prescribes a scaled-down
+proxy); ``LlamaConfig.llama2_7b()`` gives the real dims for multi-chip runs.
+
+When ``lora_rank > 0`` the params split into ``{"base", "lora"}`` subtrees;
+the base is frozen with ``stop_gradient`` (XLA prunes its whole backward
+pass) and only the ``lora`` subtree carries gradients — so averagers ship
+just the adapters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distributedvolunteercomputing_tpu.models import common
+from distributedvolunteercomputing_tpu.models.lora import lora_delta, lora_init
+from distributedvolunteercomputing_tpu.ops.attention import attention_core, merge_heads, rope, split_heads
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 2048
+    max_len: int = 256
+    d_model: int = 512
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    n_layers: int = 8
+    d_ff: int = 1408
+    lora_rank: int = 8
+    lora_alpha: float = 16.0
+    remat: bool = True  # see GPT2Config.remat
+
+    @classmethod
+    def llama2_7b(cls, lora_rank: int = 8) -> "LlamaConfig":
+        return cls(
+            vocab=32000, max_len=4096, d_model=4096, n_heads=32, n_kv_heads=32,
+            n_layers=32, d_ff=11008, lora_rank=lora_rank,
+        )
+
+
+def _no_bias_dense_init(rng: jax.Array, d_in: int, d_out: int) -> jax.Array:
+    return jax.random.normal(rng, (d_in, d_out), jnp.float32) * (1.0 / d_in**0.5)
+
+
+def _layer_init(rng: jax.Array, cfg: LlamaConfig) -> common.Params:
+    k = jax.random.split(rng, 7)
+    d_head = cfg.d_model // cfg.n_heads
+    d_kv = cfg.n_kv_heads * d_head
+    return {
+        "ln_attn": common.rmsnorm_init(cfg.d_model),
+        "wq": _no_bias_dense_init(k[0], cfg.d_model, cfg.d_model),
+        "wk": _no_bias_dense_init(k[1], cfg.d_model, d_kv),
+        "wv": _no_bias_dense_init(k[2], cfg.d_model, d_kv),
+        "wo": _no_bias_dense_init(k[3], cfg.d_model, cfg.d_model),
+        "ln_mlp": common.rmsnorm_init(cfg.d_model),
+        "w_gate": _no_bias_dense_init(k[4], cfg.d_model, cfg.d_ff),
+        "w_up": _no_bias_dense_init(k[5], cfg.d_model, cfg.d_ff),
+        "w_down": _no_bias_dense_init(k[6], cfg.d_ff, cfg.d_model),
+    }
+
+
+def _lora_layer_init(rng: jax.Array, cfg: LlamaConfig) -> common.Params:
+    kq, kv = jax.random.split(rng)
+    d_head = cfg.d_model // cfg.n_heads
+    d_kv = cfg.n_kv_heads * d_head
+    return {
+        "q": lora_init(kq, cfg.d_model, cfg.d_model, cfg.lora_rank),
+        "v": lora_init(kv, cfg.d_model, d_kv, cfg.lora_rank),
+    }
+
+
+def init(rng: jax.Array, cfg: LlamaConfig) -> common.Params:
+    keys = jax.random.split(rng, cfg.n_layers + 2)
+    base = {
+        "wte": common.embed_init(keys[0], cfg.vocab, cfg.d_model),
+        "blocks": [_layer_init(keys[2 + i], cfg) for i in range(cfg.n_layers)],
+        "ln_f": common.rmsnorm_init(cfg.d_model),
+        "lm_head": _no_bias_dense_init(keys[1], cfg.d_model, cfg.vocab),
+    }
+    if cfg.lora_rank <= 0:
+        return base
+    lora_keys = jax.random.split(jax.random.fold_in(rng, 1), cfg.n_layers)
+    return {
+        "base": base,
+        "lora": {"blocks": [_lora_layer_init(lora_keys[i], cfg) for i in range(cfg.n_layers)]},
+    }
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=1)
+
+
+def _block(p: common.Params, lp: common.Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    dtype = x.dtype
+    h = common.rmsnorm(p["ln_attn"], x)
+    q = h @ p["wq"].astype(dtype)
+    k = h @ p["wk"].astype(dtype)
+    v = h @ p["wv"].astype(dtype)
+    if lp is not None:
+        q = q + lora_delta(lp["q"], h, cfg.lora_alpha, cfg.lora_rank)
+        v = v + lora_delta(lp["v"], h, cfg.lora_alpha, cfg.lora_rank)
+    qh = rope(split_heads(q, cfg.n_heads))
+    kh = rope(split_heads(k, cfg.n_kv_heads))
+    vh = split_heads(v, cfg.n_kv_heads)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    attn = attention_core(qh, _repeat_kv(kh, n_rep), _repeat_kv(vh, n_rep), causal=True)
+    x = x + merge_heads(attn) @ p["wo"].astype(dtype)
+    h = common.rmsnorm(p["ln_mlp"], x)
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+    up = h @ p["w_up"].astype(dtype)
+    return x + (gate * up) @ p["w_down"].astype(dtype)
+
+
+def forward(params: common.Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    lora_enabled = cfg.lora_rank > 0
+    base = params["base"] if lora_enabled else params
+    lora_p = params["lora"] if lora_enabled else None
+    if lora_enabled:
+        # Freeze the base: its backward pass is pruned entirely by XLA.
+        base = jax.tree_util.tree_map(jax.lax.stop_gradient, base)
+    dtype = common.compute_dtype()
+    x = base["wte"][tokens].astype(dtype)
+    blk = jax.checkpoint(lambda p, lp, h: _block(p, lp, h, cfg)) if cfg.remat else (
+        lambda p, lp, h: _block(p, lp, h, cfg)
+    )
+    for i, p in enumerate(base["blocks"]):
+        lp = lora_p["blocks"][i] if lora_enabled else None
+        x = blk(p, lp, x)
+    x = common.rmsnorm(base["ln_f"], x)
+    return (x @ base["lm_head"].astype(dtype)).astype(jnp.float32)
+
+
+def loss_fn(
+    params: common.Params, batch: Dict[str, jax.Array], rng: jax.Array, cfg: LlamaConfig
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    logits = forward(params, batch["tokens"], cfg)
+    loss = common.softmax_xent(logits, batch["targets"])
+    return loss, {"loss": loss}
+
+
+def lora_subtree(params: common.Params) -> common.Params:
+    """The averaging payload for config 5: adapters only."""
+    return params["lora"]
+
+
+def with_lora_subtree(params: common.Params, lora: common.Params) -> common.Params:
+    return {"base": params["base"], "lora": lora}

@@ -1,0 +1,29 @@
+"""LoRA adapters as separate pytree leaves.
+
+Reference config 5 finetunes Llama-2-7B with LoRA under Byzantine-tolerant
+averaging (BASELINE.json:11). Keeping adapters in their own subtree means the
+swarm averages ONLY the adapter params — a ~1000x smaller WAN payload than
+full params, which is what makes robust aggregation affordable per round
+(SURVEY.md §7 hard part d).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def lora_init(rng: jax.Array, d_in: int, d_out: int, rank: int) -> Dict[str, jax.Array]:
+    # A ~ N(0, 1/d_in), B = 0: adapters start as identity (zero delta).
+    return {
+        "a": jax.random.normal(rng, (d_in, rank), jnp.float32) * (1.0 / d_in**0.5),
+        "b": jnp.zeros((rank, d_out), jnp.float32),
+    }
+
+
+def lora_delta(p: Dict[str, jax.Array], x: jax.Array, alpha: float, rank: int) -> jax.Array:
+    scale = alpha / rank
+    dtype = x.dtype
+    return ((x @ p["a"].astype(dtype)) @ p["b"].astype(dtype)) * scale

@@ -19,6 +19,14 @@ Batch = Dict[str, jax.Array]
 Metrics = Dict[str, jax.Array]
 
 
+def _identity_select(params: Any) -> Any:
+    return params
+
+
+def _identity_merge(params: Any, averaged: Any) -> Any:
+    return averaged
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelBundle:
     name: str
@@ -26,6 +34,11 @@ class ModelBundle:
     init: Callable[[jax.Array], Any]
     loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]]
     make_batch: Callable[[jax.Array, int], Batch]
+    # What the swarm averages: select the payload subtree out of the params
+    # (identity for full averaging; the LoRA bundle selects adapters only so
+    # the WAN round ships ~1000x less) and merge the averaged result back.
+    avg_select: Callable[[Any], Any] = _identity_select
+    avg_merge: Callable[[Any, Any], Any] = _identity_merge
 
 
 def _mlp(**overrides: Any) -> ModelBundle:
@@ -97,6 +110,7 @@ def _llama_lora(**overrides: Any) -> ModelBundle:
     from distributedvolunteercomputing_tpu.training import data
 
     cfg = dataclasses.replace(llama.LlamaConfig(), **overrides)
+    lora_on = cfg.lora_rank > 0
     return ModelBundle(
         name="llama_lora",
         config=cfg,
@@ -105,6 +119,8 @@ def _llama_lora(**overrides: Any) -> ModelBundle:
         make_batch=lambda rng, bs: data.synthetic_lm_batch(
             rng, bs, seq_len=cfg.max_len, vocab=cfg.vocab
         ),
+        avg_select=llama.lora_subtree if lora_on else _identity_select,
+        avg_merge=llama.with_lora_subtree if lora_on else _identity_merge,
     )
 
 

@@ -1,0 +1,121 @@
+"""ResNet-18 for CIFAR-10 — reference config 2 (BASELINE.json:8).
+
+TPU-first deviations from the torchvision-style reference genre:
+
+- **GroupNorm instead of BatchNorm.** BN running statistics are mutable
+  cross-batch state; in a volunteer swarm they would ALSO need averaging and
+  churn-safe bookkeeping. GN is stateless (pure function of params + batch),
+  equally accurate at CIFAR scale, and keeps the whole zoo uniform as
+  "params pytree -> loss".
+- NHWC layout (TPU-native conv layout for XLA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distributedvolunteercomputing_tpu.models import common
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    n_classes: int = 10
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)  # ResNet-18
+    widths: Tuple[int, ...] = (64, 128, 256, 512)
+    stem_width: int = 64
+    groups: int = 8  # GroupNorm groups
+
+
+def _conv_init(rng: jax.Array, kh: int, kw: int, c_in: int, c_out: int) -> jax.Array:
+    fan_in = kh * kw * c_in
+    return jax.random.normal(rng, (kh, kw, c_in, c_out), jnp.float32) * (2.0 / fan_in) ** 0.5
+
+
+def _conv(w: jax.Array, x: jax.Array, stride: int = 1) -> jax.Array:
+    dtype = common.compute_dtype()
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype),
+        w.astype(dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _groupnorm_init(c: int) -> common.Params:
+    return {"g": jnp.ones((c,), jnp.float32), "b": jnp.zeros((c,), jnp.float32)}
+
+
+def _groupnorm(p: common.Params, x: jax.Array, groups: int, eps: float = 1e-5) -> jax.Array:
+    b, h, w, c = x.shape
+    xf = x.astype(jnp.float32).reshape(b, h, w, groups, c // groups)
+    mu = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xf, axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mu) * jax.lax.rsqrt(var + eps)
+    xf = xf.reshape(b, h, w, c)
+    return (xf * p["g"] + p["b"]).astype(x.dtype)
+
+
+def _block_init(rng: jax.Array, c_in: int, c_out: int) -> common.Params:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    p = {
+        "conv1": _conv_init(k1, 3, 3, c_in, c_out),
+        "gn1": _groupnorm_init(c_out),
+        "conv2": _conv_init(k2, 3, 3, c_out, c_out),
+        "gn2": _groupnorm_init(c_out),
+    }
+    if c_in != c_out:
+        p["proj"] = _conv_init(k3, 1, 1, c_in, c_out)
+        p["gn_proj"] = _groupnorm_init(c_out)
+    return p
+
+
+def _block(p: common.Params, x: jax.Array, stride: int, groups: int) -> jax.Array:
+    h = _conv(p["conv1"], x, stride)
+    h = jax.nn.relu(_groupnorm(p["gn1"], h, groups))
+    h = _conv(p["conv2"], h)
+    h = _groupnorm(p["gn2"], h, groups)
+    if "proj" in p:
+        x = _groupnorm(p["gn_proj"], _conv(p["proj"], x, stride), groups)
+    elif stride != 1:
+        x = x[:, ::stride, ::stride, :]
+    return jax.nn.relu(h + x)
+
+
+def init(rng: jax.Array, cfg: ResNetConfig) -> common.Params:
+    keys = jax.random.split(rng, 2 + sum(cfg.stage_sizes))
+    params: Dict = {
+        "stem": _conv_init(keys[0], 3, 3, 3, cfg.stem_width),
+        "gn_stem": _groupnorm_init(cfg.stem_width),
+        "head": common.dense_init(keys[1], cfg.widths[-1], cfg.n_classes),
+    }
+    ki = 2
+    c_in = cfg.stem_width
+    for si, (n_blocks, width) in enumerate(zip(cfg.stage_sizes, cfg.widths)):
+        for bi in range(n_blocks):
+            params[f"s{si}b{bi}"] = _block_init(keys[ki], c_in, width)
+            c_in = width
+            ki += 1
+    return params
+
+
+def forward(params: common.Params, x: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    h = jax.nn.relu(_groupnorm(params["gn_stem"], _conv(params["stem"], x), cfg.groups))
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            h = _block(params[f"s{si}b{bi}"], h, stride, cfg.groups)
+    h = jnp.mean(h, axis=(1, 2))  # global average pool
+    return common.dense(params["head"], h).astype(jnp.float32)
+
+
+def loss_fn(
+    params: common.Params, batch: Dict[str, jax.Array], rng: jax.Array, cfg: ResNetConfig
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    logits = forward(params, batch["x"], cfg)
+    loss = common.softmax_xent(logits, batch["y"])
+    return loss, {"loss": loss, "accuracy": common.accuracy(logits, batch["y"])}

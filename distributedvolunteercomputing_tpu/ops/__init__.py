@@ -1,0 +1,3 @@
+from distributedvolunteercomputing_tpu.ops.attention import multi_head_attention, rope
+
+__all__ = ["multi_head_attention", "rope"]

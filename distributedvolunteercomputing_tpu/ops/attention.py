@@ -1,0 +1,77 @@
+"""Attention ops shared by the transformer zoo (BERT / GPT-2 / Llama).
+
+Plain-XLA reference path: one fused einsum-softmax-einsum that XLA maps onto
+the MXU. The pallas flash kernel (ops/pallas_attention.py) and the ring
+attention sequence-parallel path (parallel/ring_attention.py) are drop-in
+replacements for ``multi_head_attention``'s core.
+
+Softmax statistics run in float32 even when q/k/v are bfloat16 — MXU matmuls
+in bf16, reductions in f32, the standard TPU recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def attention_core(
+    q: jax.Array,  # [B, H, Tq, D]
+    k: jax.Array,  # [B, H, Tk, D]
+    v: jax.Array,  # [B, H, Tk, D]
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,  # [B, 1|H, Tq, Tk] additive-able bool
+) -> jax.Array:
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(causal_mask, logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def split_heads(x: jax.Array, n_heads: int) -> jax.Array:
+    b, t, d = x.shape
+    return x.reshape(b, t, n_heads, d // n_heads).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: jax.Array) -> jax.Array:
+    b, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+
+def multi_head_attention(
+    q: jax.Array,  # [B, T, d_model] (already projected)
+    k: jax.Array,
+    v: jax.Array,
+    n_heads: int,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    out = attention_core(
+        split_heads(q, n_heads), split_heads(k, n_heads), split_heads(v, n_heads),
+        causal=causal, mask=mask,
+    )
+    return merge_heads(out)
+
+
+def rope(x: jax.Array, positions: Optional[jax.Array] = None, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding over the last dim of ``x`` [B, H, T, D]."""
+    d = x.shape[-1]
+    t = x.shape[-2]
+    if positions is None:
+        positions = jnp.arange(t)
+    freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x1 * sin + x2 * cos
+    out = jnp.stack([rx1, rx2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)

@@ -1,0 +1,96 @@
+"""Robust (Byzantine-tolerant) aggregation — host-side numpy.
+
+Reference parity: "Byzantine-tolerant aggregation" (BASELINE.json:5,:11),
+prescribed to stay on host (BASELINE.json:5 "Keep the coordinator/DHT
+peer-discovery and Byzantine-tolerant aggregation on the host"). Inputs are
+the flattened float32 param buffers from utils.pytree — one row per peer.
+
+Estimators (standard robust-aggregation menu, cf. Krum/trimmed-mean
+literature):
+- mean            — baseline (not robust), supports per-peer weights
+- coordinate median — breaks down at 50% adversaries, cheap
+- trimmed mean    — drop the b largest/smallest per coordinate
+- krum            — select the contribution closest to its n-f-2 neighbours
+- geometric median — Weiszfeld iterations, strong + smooth
+
+All run in O(n^2 D) worst case (krum/geomedian) with n = volunteers in the
+round (reference scale: 4, BASELINE.json:2) — cheap next to the WAN transfer
+(SURVEY.md §7 hard part d).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def mean(stack: np.ndarray, weights: Optional[np.ndarray] = None) -> np.ndarray:
+    if weights is None:
+        return stack.mean(axis=0)
+    w = np.asarray(weights, np.float64)
+    w = w / w.sum()
+    return (stack * w[:, None].astype(stack.dtype)).sum(axis=0)
+
+
+def coordinate_median(stack: np.ndarray) -> np.ndarray:
+    return np.median(stack, axis=0).astype(stack.dtype)
+
+
+def trimmed_mean(stack: np.ndarray, trim: int = 1) -> np.ndarray:
+    n = stack.shape[0]
+    if 2 * trim >= n:
+        raise ValueError(f"trim={trim} too large for n={n}")
+    srt = np.sort(stack, axis=0)
+    return srt[trim : n - trim].mean(axis=0)
+
+
+def krum(stack: np.ndarray, n_byzantine: int = 1, multi: int = 1) -> np.ndarray:
+    """(Multi-)Krum: average the ``multi`` contributions with the smallest
+    sum of squared distances to their n - f - 2 nearest neighbours."""
+    n = stack.shape[0]
+    if n < n_byzantine + 3:
+        # Not enough honest mass for Krum's guarantee; degrade to median.
+        return coordinate_median(stack)
+    d2 = ((stack[:, None, :] - stack[None, :, :]) ** 2).sum(axis=-1)
+    np.fill_diagonal(d2, np.inf)
+    n_neighbors = n - n_byzantine - 2
+    scores = np.sort(d2, axis=1)[:, :n_neighbors].sum(axis=1)
+    chosen = np.argsort(scores)[:multi]
+    return stack[chosen].mean(axis=0)
+
+
+def geometric_median(stack: np.ndarray, iters: int = 32, eps: float = 1e-8) -> np.ndarray:
+    """Weiszfeld algorithm; robust to <50% arbitrary corruption.
+
+    Starts from the coordinate median, not the mean: a mean start under large
+    outliers puts z so far out that convergence needs many more iterations.
+    """
+    z = coordinate_median(stack).astype(np.float64)
+    for _ in range(iters):
+        dist = np.linalg.norm(stack - z[None, :], axis=1)
+        dist = np.maximum(dist, eps)
+        w = 1.0 / dist
+        z_new = (stack * w[:, None]).sum(axis=0) / w.sum()
+        if np.linalg.norm(z_new - z) < eps * (1 + np.linalg.norm(z)):
+            z = z_new
+            break
+        z = z_new
+    return z.astype(stack.dtype)
+
+
+AGGREGATORS = {
+    "mean": mean,
+    "median": coordinate_median,
+    "trimmed_mean": trimmed_mean,
+    "krum": krum,
+    "geometric_median": geometric_median,
+}
+
+
+def aggregate(stack: np.ndarray, method: str = "mean", **kw) -> np.ndarray:
+    if method not in AGGREGATORS:
+        raise KeyError(f"unknown aggregator {method!r}; known: {sorted(AGGREGATORS)}")
+    if stack.ndim != 2:
+        raise ValueError(f"expected [n_peers, D] stack, got shape {stack.shape}")
+    return AGGREGATORS[method](stack, **kw)

@@ -1,0 +1,4 @@
+from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+
+__all__ = ["Transport", "RPCError", "DHTNode"]

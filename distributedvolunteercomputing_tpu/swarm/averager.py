@@ -1,0 +1,546 @@
+"""GradientAverager family: the four WAN averaging modes of the reference.
+
+Reference parity (BASELINE.json:5,7-11):
+- ``SyncAverager``      — "synchronous GradientAverager" (config 2)
+- ``GossipAverager``    — "async gossip averaging" (config 3)
+- ``ButterflyAverager`` — "butterfly allreduce across heterogeneous
+                          volunteers" (config 4, Moshpit-style)
+- ``ByzantineAverager`` — "Byzantine-tolerant aggregation under volunteer
+                          churn" (config 5)
+
+Two-tier TPU design (BASELINE.json:5): gradients are ALREADY reduced across
+the chips of one slice by ``jax.lax.psum`` inside the compiled train step
+(parallel/train_step.py) — what crosses here is one float32 buffer per
+volunteer SLICE, exchanged over the DCN Transport and averaged on host.
+
+Churn rules (SURVEY.md §7 hard part a): every tensor message carries the
+round EPOCH from matchmaking; stale/foreign messages are dropped; any
+timeout degrades the round (skip stage / aggregate the subset / return None)
+instead of wedging — a dead peer costs one timeout, never a hang.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import random
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributedvolunteercomputing_tpu.ops import robust
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.matchmaking import Group, Matchmaker
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer, unflatten_from_buffer
+
+log = get_logger(__name__)
+
+
+class _Round:
+    """Leader-side state for one gather round."""
+
+    def __init__(self, expected: List[str]):
+        self.expected = set(expected)
+        self.contribs: Dict[str, Tuple[float, np.ndarray]] = {}
+        self.full = asyncio.Event()
+        self.result: Optional[np.ndarray] = None
+        self.result_ready = asyncio.Event()
+        self.t0 = time.monotonic()
+
+    def add(self, peer: str, weight: float, buf: np.ndarray) -> None:
+        if peer in self.expected:
+            self.contribs[peer] = (weight, buf)
+            if set(self.contribs) >= self.expected:
+                self.full.set()
+
+
+class AveragerBase:
+    """Shared packing, schema guard, and round bookkeeping."""
+
+    mode = "base"
+
+    def __init__(
+        self,
+        transport: Transport,
+        dht: DHTNode,
+        membership: SwarmMembership,
+        *,
+        min_group: int = 2,
+        max_group: int = 16,
+        gather_timeout: float = 20.0,
+        join_timeout: float = 10.0,
+        method: str = "mean",
+        method_kw: Optional[dict] = None,
+    ):
+        self.transport = transport
+        self.dht = dht
+        self.membership = membership
+        self.peer_id = membership.peer_id
+        self.matchmaker = Matchmaker(transport, dht, self.peer_id)
+        self.min_group = min_group
+        self.max_group = max_group
+        self.gather_timeout = gather_timeout
+        self.join_timeout = join_timeout
+        self.method = method
+        self.method_kw = method_kw or {}
+        self._specs = None
+        self._treedef = None
+        self._schema: Optional[str] = None
+        self.rounds_ok = 0
+        self.rounds_skipped = 0
+
+    @property
+    def round_key(self) -> str:
+        """Constant rendezvous key per mode — see Matchmaker.form_group."""
+        return f"avg/{self.mode}"
+
+    def _sweep_rounds(self, rounds: Dict[str, "_Round"], max_age: Optional[float] = None) -> None:
+        """Evict stale round state (parked contributions hold param-sized
+        buffers; a round nobody finishes must not leak them)."""
+        if max_age is None:
+            max_age = self.gather_timeout * 3 + 30.0
+        now = time.monotonic()
+        for epoch in [e for e, st in rounds.items() if now - st.t0 > max_age]:
+            del rounds[epoch]
+
+    # -- packing -----------------------------------------------------------
+
+    def _pack(self, tree: Any) -> np.ndarray:
+        buf, specs, treedef = flatten_to_buffer(tree)
+        if self._schema is None:
+            self._specs, self._treedef = specs, treedef
+            self._schema = hashlib.sha1(
+                repr([(s.shape, s.dtype) for s in specs]).encode()
+            ).hexdigest()[:16]
+        return buf
+
+    def _unpack(self, buf: np.ndarray) -> Any:
+        return unflatten_from_buffer(buf, self._specs, self._treedef)
+
+    def _check_schema(self, args: dict) -> bool:
+        # Before our first pack we don't know the schema yet — accept and let
+        # the buffer-length guard at stack time catch real mismatches (an
+        # early-arriving contribution from a faster peer is normal).
+        return self._schema is None or args.get("schema") == self._schema
+
+    def _buf_from_payload(self, payload: bytes) -> np.ndarray:
+        return np.frombuffer(payload, np.float32).copy()
+
+    # -- public API --------------------------------------------------------
+
+    async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        return {"mode": self.mode, "rounds_ok": self.rounds_ok, "rounds_skipped": self.rounds_skipped}
+
+
+class SyncAverager(AveragerBase):
+    """Leader-gather allreduce: members push, leader aggregates, members fetch.
+
+    The inter-slice half of the synchronous GradientAverager (config 2). At
+    reference swarm scale (2-8 slices) a leader-gather round is one RTT
+    cheaper than a ring and trivially churn-safe: missing contributions are
+    dropped at the deadline, a dead leader fails everyone's fetch -> skip.
+    """
+
+    mode = "sync"
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._rounds: Dict[str, _Round] = {}
+        self.transport.register("sync.contribute", self._rpc_contribute)
+        self.transport.register("sync.fetch", self._rpc_fetch)
+
+    async def _rpc_contribute(self, args: dict, payload: bytes):
+        if not self._check_schema(args):
+            raise RPCError("schema mismatch")
+        st = self._rounds.get(args["epoch"])
+        if st is None:
+            # Members can push before the leader enters its round: park it.
+            st = self._rounds[args["epoch"]] = _Round([])
+        st.contribs[args["peer"]] = (float(args["weight"]), self._buf_from_payload(payload))
+        if st.expected and set(st.contribs) >= st.expected:
+            st.full.set()
+        return {"ok": True}, b""
+
+    async def _rpc_fetch(self, args: dict, payload: bytes):
+        st = self._rounds.get(args["epoch"])
+        if st is None:
+            raise RPCError("unknown or finished round epoch")
+        # Must outwait the leader's own gather deadline (plus margin), or a
+        # member's fetch races the aggregation and loses by milliseconds.
+        await asyncio.wait_for(st.result_ready.wait(), timeout=self.gather_timeout + 3.0)
+        if st.result is None:
+            raise RPCError("round skipped by leader (too few contributions)")
+        return {"ok": True}, st.result.tobytes()
+
+    async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
+        self._sweep_rounds(self._rounds)
+        group = await self.matchmaker.form_group(
+            self.round_key, self.min_group, self.max_group, self.join_timeout
+        )
+        if group is None:
+            self.rounds_skipped += 1
+            return None
+        buf = self._pack(tree)
+        try:
+            if group.my_index == 0:
+                return await self._lead_round(group, buf, weight)
+            return await self._member_round(group, buf, weight)
+        except (RPCError, OSError, asyncio.TimeoutError) as e:
+            log.info("sync round %d failed (%s); continuing local", round_no, e)
+            self.rounds_skipped += 1
+            return None
+
+    async def _lead_round(self, group: Group, buf: np.ndarray, weight: float):
+        member_ids = [pid for pid, _ in group.members]
+        st = self._rounds.get(group.epoch)
+        if st is None:
+            st = self._rounds[group.epoch] = _Round([])
+        st.expected = set(member_ids)
+        st.contribs = {p: c for p, c in st.contribs.items() if p in st.expected}
+        st.contribs[self.peer_id] = (weight, buf)
+        if set(st.contribs) >= st.expected:
+            st.full.set()
+        try:
+            try:
+                await asyncio.wait_for(st.full.wait(), timeout=self.gather_timeout)
+            except asyncio.TimeoutError:
+                pass  # aggregate whoever made it
+            # Drop contributions whose buffer doesn't match ours (model
+            # mismatch that slipped past the early-accept schema check).
+            good = {p: c for p, c in st.contribs.items() if c[1].size == buf.size}
+            if len(good) < self.min_group:
+                self.rounds_skipped += 1
+                # Fail members' pending fetches fast, then free the buffers.
+                st.result_ready.set()  # with st.result None -> fetch raises
+                asyncio.get_running_loop().call_later(
+                    5.0, self._rounds.pop, group.epoch, None
+                )
+                return None
+            peers = sorted(good)
+            stack = np.stack([good[p][1] for p in peers])
+            weights = np.array([good[p][0] for p in peers])
+            kw = dict(self.method_kw)
+            if self.method == "mean":
+                kw["weights"] = weights
+            st.result = robust.aggregate(stack, self.method, **kw)
+            st.result_ready.set()
+            self.rounds_ok += 1
+            # Keep state around long enough for members to fetch.
+            asyncio.get_running_loop().call_later(
+                self.gather_timeout * 2, self._rounds.pop, group.epoch, None
+            )
+            return self._unpack(st.result)
+        except Exception:
+            self._rounds.pop(group.epoch, None)
+            raise
+
+    async def _member_round(self, group: Group, buf: np.ndarray, weight: float):
+        leader_addr = group.members[0][1]
+        args = {
+            "epoch": group.epoch,
+            "peer": self.peer_id,
+            "weight": weight,
+            "schema": self._schema,
+        }
+        await self.transport.call(
+            leader_addr, "sync.contribute", args, buf.tobytes(), timeout=self.gather_timeout
+        )
+        _, payload = await self.transport.call(
+            leader_addr, "sync.fetch", {"epoch": group.epoch}, timeout=self.gather_timeout + 6.0
+        )
+        self.rounds_ok += 1
+        return self._unpack(self._buf_from_payload(payload))
+
+
+class GossipAverager(AveragerBase):
+    """Asynchronous pairwise gossip (config 3): no rounds, no barriers.
+
+    Caller mixes with one random live peer per averaging point; the
+    counterparty banks the caller's contribution in an inbox and folds it in
+    at ITS next averaging point. Every volunteer's params drift toward the
+    swarm mean without any global synchronization (Moshpit/PushSum genre).
+    """
+
+    mode = "gossip"
+
+    def __init__(self, *a, seed: int = 0, **kw):
+        super().__init__(*a, **kw)
+        self._inbox: List[Tuple[float, np.ndarray]] = []
+        self._current: Optional[Tuple[float, np.ndarray]] = None
+        self._rng = random.Random(seed ^ hash(self.peer_id))
+        self.transport.register("gossip.exchange", self._rpc_exchange)
+
+    async def _rpc_exchange(self, args: dict, payload: bytes):
+        if not self._check_schema(args):
+            raise RPCError("schema mismatch")
+        if self._current is None:
+            raise RPCError("peer has no params published yet")
+        my_w, my_buf = self._current
+        self._inbox.append((float(args["weight"]), self._buf_from_payload(payload)))
+        return {"weight": my_w}, my_buf.tobytes()
+
+    def _mix(self, w1, b1, w2, b2) -> Tuple[float, np.ndarray]:
+        total = w1 + w2
+        return total, (b1 * (w1 / total) + b2 * (w2 / total))
+
+    async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
+        buf = self._pack(tree)
+        w = weight
+        # 1. fold in whatever neighbours pushed since last time
+        inbox, self._inbox = self._inbox, []
+        for iw, ibuf in inbox:
+            w, buf = self._mix(w, buf, iw, ibuf)
+        self._current = (w, buf)
+        # 2. push-pull with one random live peer
+        peers = await self.membership.alive_peers(include_self=False)
+        targets = [(pid, tuple(rec["addr"])) for pid, rec in peers.items() if "addr" in rec]
+        mixed = bool(inbox)
+        if targets:
+            pid, addr = self._rng.choice(targets)
+            try:
+                ret, payload = await self.transport.call(
+                    addr,
+                    "gossip.exchange",
+                    {"peer": self.peer_id, "weight": w, "schema": self._schema},
+                    buf.tobytes(),
+                    timeout=self.gather_timeout,
+                )
+                w, buf = self._mix(w, buf, float(ret["weight"]), self._buf_from_payload(payload))
+                self._current = (w, buf)
+                mixed = True
+            except (RPCError, OSError, asyncio.TimeoutError) as e:
+                log.info("gossip with %s failed (%s)", pid, e)
+        if not mixed:
+            self.rounds_skipped += 1
+            return None
+        self.rounds_ok += 1
+        return self._unpack(buf)
+
+
+class ButterflyAverager(AveragerBase):
+    """Butterfly (hypercube) allreduce (config 4).
+
+    log2(n) pairwise stages; at stage s, peer i exchanges its running
+    weighted average with peer i XOR 2^s. Bandwidth is balanced (every peer
+    moves ~log n buffers — no leader hotspot), and heterogeneous/absent
+    partners cost ONE skipped stage, not the round: with a partial butterfly
+    each peer still holds the average of a 2^k subset, which contracts
+    variance every round (Moshpit SGD's argument, PAPERS.md:9).
+    """
+
+    mode = "butterfly"
+
+    def __init__(self, *a, stage_timeout: float = 8.0, **kw):
+        super().__init__(*a, **kw)
+        self.stage_timeout = stage_timeout
+        # (epoch, stage) -> {"ready": Event, "buf":, "w":, "done": Event, "in": (w, buf)}
+        self._stages: Dict[Tuple[str, int], dict] = {}
+        self.transport.register("bfly.exchange", self._rpc_exchange)
+
+    def _stage_state(self, epoch: str, stage: int) -> dict:
+        key = (epoch, stage)
+        if key not in self._stages:
+            self._stages[key] = {
+                "ready": asyncio.Event(),
+                "done": asyncio.Event(),
+                "buf": None,
+                "w": None,
+                "in": None,
+                "t0": time.monotonic(),
+            }
+        return self._stages[key]
+
+    def _sweep_stages(self) -> None:
+        # A partner's exchange for a round we never joined leaves a stage
+        # entry behind after its handler times out — evict by age.
+        cutoff = time.monotonic() - (self.stage_timeout * 4 + 30.0)
+        for key in [k for k, st in self._stages.items() if st["t0"] < cutoff]:
+            del self._stages[key]
+
+    async def _rpc_exchange(self, args: dict, payload: bytes):
+        if not self._check_schema(args):
+            raise RPCError("schema mismatch")
+        st = self._stage_state(args["epoch"], int(args["stage"]))
+        # Wait until the local peer reaches this stage (it may be behind).
+        await asyncio.wait_for(st["ready"].wait(), timeout=self.stage_timeout)
+        st["in"] = (float(args["weight"]), self._buf_from_payload(payload))
+        st["done"].set()
+        return {"weight": st["w"]}, st["buf"].tobytes()
+
+    @staticmethod
+    def _mix(w1: float, b1: np.ndarray, w2: float, b2: np.ndarray) -> Tuple[float, np.ndarray]:
+        total = w1 + w2
+        # Same expression on both sides of the pair -> bitwise-identical
+        # results (float + and * are commutative), so the pair stays in sync.
+        return total, (b1 * (w1 / total) + b2 * (w2 / total))
+
+    async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
+        self._sweep_stages()
+        group = await self.matchmaker.form_group(
+            self.round_key, self.min_group, self.max_group, self.join_timeout
+        )
+        if group is None:
+            self.rounds_skipped += 1
+            return None
+        buf = self._pack(tree)
+        w = float(weight)
+        n = group.size
+        n_stages = max((n - 1).bit_length(), 1)
+        mixed_any = False
+        for s in range(n_stages):
+            partner_idx = group.my_index ^ (1 << s)
+            if partner_idx >= n:
+                continue
+            partner_id, partner_addr = group.members[partner_idx]
+            st = self._stage_state(group.epoch, s)
+            st["buf"], st["w"] = buf, w
+            st["ready"].set()
+            try:
+                if group.my_index < partner_idx:
+                    ret, payload = await self.transport.call(
+                        partner_addr,
+                        "bfly.exchange",
+                        {
+                            "epoch": group.epoch,
+                            "stage": s,
+                            "peer": self.peer_id,
+                            "weight": w,
+                            "schema": self._schema,
+                        },
+                        buf.tobytes(),
+                        timeout=self.stage_timeout,
+                    )
+                    pw, pbuf = float(ret["weight"]), self._buf_from_payload(payload)
+                else:
+                    await asyncio.wait_for(st["done"].wait(), timeout=self.stage_timeout)
+                    pw, pbuf = st["in"]
+                w, buf = self._mix(w, buf, pw, pbuf)
+                mixed_any = True
+            except (RPCError, OSError, asyncio.TimeoutError) as e:
+                log.info(
+                    "butterfly round %d stage %d with %s failed (%s); skipping stage",
+                    round_no, s, partner_id, e,
+                )
+            finally:
+                self._stages.pop((group.epoch, s), None)
+        if not mixed_any:
+            self.rounds_skipped += 1
+            return None
+        self.rounds_ok += 1
+        return self._unpack(buf)
+
+
+class ByzantineAverager(AveragerBase):
+    """Full-mesh robust aggregation (config 5): no trusted leader.
+
+    Every member pushes its contribution to every other member; each member
+    independently applies the robust estimator (trimmed mean by default;
+    median/krum/geometric_median via ``method=``) to whatever arrived by the
+    deadline. A Byzantine peer can send garbage — the estimator bounds its
+    influence — but no single peer can forge the aggregate for others, which
+    a malicious leader could under leader-gather.
+    """
+
+    mode = "byzantine"
+
+    def __init__(self, *a, **kw):
+        kw.setdefault("method", "trimmed_mean")
+        super().__init__(*a, **kw)
+        self._rounds: Dict[str, _Round] = {}
+        self.transport.register("byz.contribute", self._rpc_contribute)
+
+    async def _rpc_contribute(self, args: dict, payload: bytes):
+        if not self._check_schema(args):
+            raise RPCError("schema mismatch")
+        st = self._rounds.get(args["epoch"])
+        if st is None:
+            # Contribution can arrive before we enter the round: park it.
+            st = self._rounds[args["epoch"]] = _Round([])
+        buf = self._buf_from_payload(payload)
+        st.contribs[args["peer"]] = (float(args["weight"]), buf)
+        if st.expected and set(st.contribs) >= st.expected:
+            st.full.set()
+        return {"ok": True}, b""
+
+    async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
+        self._sweep_rounds(self._rounds)
+        group = await self.matchmaker.form_group(
+            self.round_key, self.min_group, self.max_group, self.join_timeout
+        )
+        if group is None:
+            self.rounds_skipped += 1
+            return None
+        buf = self._pack(tree)
+        st = self._rounds.get(group.epoch)
+        if st is None:
+            st = self._rounds[group.epoch] = _Round([])
+        st.expected = set(pid for pid, _ in group.members)
+        st.contribs[self.peer_id] = (weight, buf)
+        if set(st.contribs) >= st.expected:
+            st.full.set()
+
+        args = {
+            "epoch": group.epoch,
+            "peer": self.peer_id,
+            "weight": weight,
+            "schema": self._schema,
+        }
+
+        async def push(addr):
+            try:
+                await self.transport.call(
+                    addr, "byz.contribute", args, buf.tobytes(), timeout=self.gather_timeout
+                )
+            except (RPCError, OSError, asyncio.TimeoutError) as e:
+                log.info("byz push to %s failed: %s", addr, e)
+
+        await asyncio.gather(
+            *(push(addr) for pid, addr in group.members if pid != self.peer_id)
+        )
+        try:
+            await asyncio.wait_for(st.full.wait(), timeout=self.gather_timeout)
+        except asyncio.TimeoutError:
+            pass
+        received = {
+            p: c
+            for p, c in st.contribs.items()
+            if p in st.expected and c[1].size == buf.size
+        }
+        self._rounds.pop(group.epoch, None)
+        if len(received) < self.min_group:
+            self.rounds_skipped += 1
+            return None
+        peers = sorted(received)
+        stack = np.stack([received[p][1] for p in peers])
+        kw = dict(self.method_kw)
+        if self.method == "mean":
+            kw["weights"] = np.array([received[p][0] for p in peers])
+        elif self.method == "trimmed_mean":
+            # trim 1/4 of peers per side when the group is big enough;
+            # trim=0 degrades gracefully to the plain mean.
+            trim = kw.setdefault("trim", len(peers) // 4)
+            if trim * 2 >= len(peers):
+                kw["trim"] = 0
+        self.rounds_ok += 1
+        return self._unpack(robust.aggregate(stack, self.method, **kw))
+
+
+AVERAGERS = {
+    "sync": SyncAverager,
+    "gossip": GossipAverager,
+    "butterfly": ButterflyAverager,
+    "byzantine": ByzantineAverager,
+}
+
+
+def make_averager(mode: str, transport, dht, membership, **kw) -> AveragerBase:
+    if mode not in AVERAGERS:
+        raise KeyError(f"unknown averaging mode {mode!r}; known: {sorted(AVERAGERS)}")
+    return AVERAGERS[mode](transport, dht, membership, **kw)

@@ -1,0 +1,88 @@
+"""Coordinator: swarm bootstrap node + liveness registry + metrics sink.
+
+Reference parity: the ``coordinator.py`` entrypoint "bootstraps the swarm:
+initial DHT node, rendezvous address, liveness registry" (SURVEY.md §2,
+BASELINE.json:5). It does NO device work (SURVEY.md §3-A) — one asyncio
+process serving DHT RPCs, collecting per-volunteer metrics, and evicting the
+dead (by TTL expiry, which the DHT does for free).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict, Optional, Tuple
+
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.membership import PEERS_KEY
+from distributedvolunteercomputing_tpu.swarm.transport import Transport
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Coordinator:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics_path: Optional[str] = None,
+        advertise_host: Optional[str] = None,
+    ):
+        self.transport = Transport(host, port, advertise_host=advertise_host)
+        self.dht = DHTNode(self.transport)
+        self.metrics_path = metrics_path
+        self.latest_metrics: Dict[str, dict] = {}
+        self._t0 = time.time()
+        self.transport.register("coord.report", self._rpc_report)
+        self.transport.register("coord.status", self._rpc_status)
+
+    async def start(self) -> Tuple[str, int]:
+        addr = await self.transport.start()
+        await self.dht.start(bootstrap=None)
+        log.info("coordinator listening on %s:%d", *addr)
+        return addr
+
+    async def close(self) -> None:
+        await self.transport.close()
+
+    # -- RPCs --------------------------------------------------------------
+
+    async def _rpc_report(self, args: dict, payload: bytes):
+        """Volunteers push per-step metrics; coordinator aggregates swarm-level."""
+        peer = args.get("peer", "?")
+        self.latest_metrics[peer] = {**args, "recv_t": time.time()}
+        if self.metrics_path:
+            with open(self.metrics_path, "a") as fh:
+                fh.write(json.dumps(self.latest_metrics[peer]) + "\n")
+        return {"ok": True}, b""
+
+    async def _rpc_status(self, args: dict, payload: bytes):
+        """Swarm-level view: alive peers + aggregate samples/sec."""
+        peers = await self.dht.get(PEERS_KEY)
+        alive = {pid: rec for pid, rec in peers.items() if rec is not None}
+        fresh = [
+            m for m in self.latest_metrics.values() if time.time() - m["recv_t"] < 60.0
+        ]
+        agg_sps = sum(float(m.get("samples_per_sec", 0.0)) for m in fresh)
+        return {
+            "alive": alive,
+            "n_alive": len(alive),
+            "swarm_samples_per_sec": agg_sps,
+            "uptime_s": time.time() - self._t0,
+        }, b""
+
+
+async def run_coordinator_forever(
+    host: str, port: int, metrics_path: Optional[str] = None, advertise_host: Optional[str] = None
+) -> None:
+    coord = Coordinator(host, port, metrics_path, advertise_host=advertise_host)
+    addr = await coord.start()
+    print(f"COORDINATOR_READY {addr[0]}:{addr[1]}", flush=True)
+    try:
+        while True:
+            await asyncio.sleep(10.0)
+            _, _ = await coord._rpc_status({}, b"")
+    except asyncio.CancelledError:
+        await coord.close()

@@ -1,0 +1,270 @@
+"""Kademlia-style DHT: decentralized peer discovery and rendezvous.
+
+Reference parity: "coordinator/DHT peer-discovery on the host"
+(BASELINE.json:5). The genre (SURVEY.md §0.2) uses the DHT for three things,
+all supported here:
+
+1. peer discovery — volunteers announce themselves under a shared key;
+2. liveness — heartbeat records with TTL (absence == death);
+3. matchmaking rendezvous — averaging groups form under round-scoped keys.
+
+Design notes:
+- 160-bit node ids, XOR metric, k-bucket routing table, iterative
+  alpha-parallel lookups — standard Kademlia, sized down (k=8, alpha=3) for
+  swarm scales the reference targets (4-ish volunteer slices, BASELINE.json:2).
+- **Dict-valued keys**: every key holds a {subkey: (value, expiry)} map and
+  STORE merges subkeys. Plain Kademlia can't enumerate "all peers"; the
+  dict-value pattern makes membership listing one GET. (Same trick the
+  hivemind lineage uses for its DHT records.)
+- Values are small JSON blobs (addresses, step counts) — tensors NEVER go
+  through the DHT; they ride Transport payloads peer-to-peer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ID_BITS = 160
+K = 8       # bucket size / replication factor
+ALPHA = 3   # lookup parallelism
+
+
+def _sha1_int(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha1(data).digest(), "big")
+
+
+def key_id(key: str) -> int:
+    return _sha1_int(key.encode())
+
+
+def node_id_for(addr: Addr) -> int:
+    return _sha1_int(f"{addr[0]}:{addr[1]}".encode())
+
+
+class RoutingTable:
+    """k-buckets by XOR-distance prefix; most-recently-seen wins."""
+
+    def __init__(self, own_id: int):
+        self.own_id = own_id
+        self.buckets: List[List[Tuple[int, Addr]]] = [[] for _ in range(ID_BITS)]
+
+    def _bucket_of(self, nid: int) -> int:
+        d = nid ^ self.own_id
+        return d.bit_length() - 1 if d else 0
+
+    def add(self, nid: int, addr: Addr) -> None:
+        if nid == self.own_id:
+            return
+        bucket = self.buckets[self._bucket_of(nid)]
+        for i, (bid, _) in enumerate(bucket):
+            if bid == nid:
+                bucket.pop(i)
+                break
+        bucket.append((nid, addr))
+        if len(bucket) > K:
+            # Simplified eviction: drop least-recently-seen without ping.
+            bucket.pop(0)
+
+    def remove(self, nid: int) -> None:
+        bucket = self.buckets[self._bucket_of(nid)]
+        self.buckets[self._bucket_of(nid)] = [(b, a) for b, a in bucket if b != nid]
+
+    def closest(self, target: int, n: int = K) -> List[Tuple[int, Addr]]:
+        allnodes = [na for bucket in self.buckets for na in bucket]
+        allnodes.sort(key=lambda na: na[0] ^ target)
+        return allnodes[:n]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+
+class DHTNode:
+    """One DHT participant bound to a Transport."""
+
+    def __init__(self, transport: Transport):
+        self.transport = transport
+        self.node_id: int = 0  # assigned at start() once the port is known
+        self.table: Optional[RoutingTable] = None
+        # key -> {subkey -> (json_value, expiry_monotonic)}
+        self.storage: Dict[str, Dict[str, Tuple[str, float]]] = {}
+        self._last_sweep = time.monotonic()
+        transport.register("dht.ping", self._rpc_ping)
+        transport.register("dht.store", self._rpc_store)
+        transport.register("dht.find", self._rpc_find)
+
+    def _sweep_storage(self, interval: float = 30.0) -> None:
+        """Drop expired subkeys/keys (amortized on writes): long-lived nodes
+        otherwise accumulate one dead record per averaging round forever."""
+        now = time.monotonic()
+        if now - self._last_sweep < interval:
+            return
+        self._last_sweep = now
+        for key in list(self.storage):
+            rec = {sk: ve for sk, ve in self.storage[key].items() if ve[1] > now}
+            if rec:
+                self.storage[key] = rec
+            else:
+                del self.storage[key]
+
+    async def start(self, bootstrap: Optional[List[Addr]] = None) -> None:
+        addr = self.transport.addr
+        if self.transport._server is None:
+            addr = await self.transport.start()
+        self.node_id = node_id_for(addr)
+        self.table = RoutingTable(self.node_id)
+        for peer in bootstrap or []:
+            try:
+                ret, _ = await self.transport.call(
+                    tuple(peer), "dht.ping", {"sender": self._self_info()}, timeout=5.0
+                )
+                self.table.add(int(ret["id"]), tuple(ret["addr"]))
+            except (RPCError, OSError, asyncio.TimeoutError) as e:
+                log.warning("bootstrap peer %s unreachable: %s", peer, e)
+        if bootstrap:
+            # Standard Kademlia join: lookup own id to populate the table.
+            await self._lookup(self.node_id)
+
+    def _self_info(self) -> dict:
+        return {"id": str(self.node_id), "addr": list(self.transport.addr)}
+
+    def _note_sender(self, args: dict) -> None:
+        sender = args.get("sender")
+        if sender and self.table is not None:
+            self.table.add(int(sender["id"]), tuple(sender["addr"]))
+
+    # -- RPC handlers ------------------------------------------------------
+
+    async def _rpc_ping(self, args: dict, payload: bytes) -> Tuple[dict, bytes]:
+        self._note_sender(args)
+        return {"id": str(self.node_id), "addr": list(self.transport.addr)}, b""
+
+    async def _rpc_store(self, args: dict, payload: bytes) -> Tuple[dict, bytes]:
+        self._note_sender(args)
+        self._sweep_storage()
+        key, subkey = args["key"], args.get("subkey", "")
+        ttl = float(args.get("ttl", 60.0))
+        rec = self.storage.setdefault(key, {})
+        rec[subkey] = (args["value"], time.monotonic() + ttl)
+        return {"ok": True}, b""
+
+    async def _rpc_find(self, args: dict, payload: bytes) -> Tuple[dict, bytes]:
+        """FIND_VALUE + FIND_NODE in one: returns value (if any) and closer nodes."""
+        self._note_sender(args)
+        target = int(args["target"])
+        out: dict = {"nodes": [[str(nid), list(a)] for nid, a in self.table.closest(target)]}
+        key = args.get("key")
+        if key is not None and key in self.storage:
+            now = time.monotonic()
+            live = {
+                sk: (v, exp - now)
+                for sk, (v, exp) in self.storage[key].items()
+                if exp > now
+            }
+            if live:
+                out["value"] = {sk: [v, ttl] for sk, (v, ttl) in live.items()}
+        return out, b""
+
+    # -- iterative lookup --------------------------------------------------
+
+    async def _lookup(
+        self, target: int, key: Optional[str] = None
+    ) -> Tuple[List[Tuple[int, Addr]], Dict[str, Tuple[str, float]]]:
+        """Iterative Kademlia lookup. Returns (k closest nodes, merged values)."""
+        assert self.table is not None
+        shortlist: Dict[int, Addr] = dict(self.table.closest(target, K))
+        queried: set = set()
+        found_values: Dict[str, Tuple[str, float]] = {}
+
+        while True:
+            candidates = sorted(
+                (nid for nid in shortlist if nid not in queried), key=lambda n: n ^ target
+            )[:ALPHA]
+            if not candidates:
+                break
+
+            async def query(nid: int):
+                try:
+                    ret, _ = await self.transport.call(
+                        shortlist[nid],
+                        "dht.find",
+                        {"target": str(target), "key": key, "sender": self._self_info()},
+                        timeout=5.0,
+                    )
+                    return nid, ret
+                except (RPCError, OSError, asyncio.TimeoutError):
+                    return nid, None
+
+            results = await asyncio.gather(*(query(nid) for nid in candidates))
+            for nid, ret in results:
+                queried.add(nid)
+                if ret is None:
+                    self.table.remove(nid)
+                    shortlist.pop(nid, None)
+                    continue
+                self.table.add(nid, shortlist[nid])
+                for nid_s, addr in ret.get("nodes", []):
+                    n = int(nid_s)
+                    if n != self.node_id and n not in queried:
+                        shortlist.setdefault(n, tuple(addr))
+                for sk, (v, ttl) in ret.get("value", {}).items():
+                    # freshest record per subkey wins
+                    if sk not in found_values or found_values[sk][1] < ttl:
+                        found_values[sk] = (v, ttl)
+
+        closest = sorted(shortlist.items(), key=lambda na: na[0] ^ target)[:K]
+        return closest, found_values
+
+    # -- public API --------------------------------------------------------
+
+    async def store(self, key: str, value: object, subkey: str = "", ttl: float = 60.0) -> int:
+        """Store (replicated to the K closest nodes incl. possibly self)."""
+        self._sweep_storage()
+        target = key_id(key)
+        closest, _ = await self._lookup(target)
+        payload_args = {
+            "key": key,
+            "subkey": subkey,
+            "value": json.dumps(value),
+            "ttl": ttl,
+            "sender": self._self_info(),
+        }
+        # Always keep a local replica too: tiny swarms (N < K) stay robust.
+        rec = self.storage.setdefault(key, {})
+        rec[subkey] = (json.dumps(value), time.monotonic() + ttl)
+        ok = 1
+        for nid, addr in closest:
+            try:
+                await self.transport.call(addr, "dht.store", payload_args, timeout=5.0)
+                ok += 1
+            except (RPCError, OSError, asyncio.TimeoutError):
+                self.table.remove(nid)
+        return ok
+
+    async def get(self, key: str) -> Dict[str, object]:
+        """All live subkeys of ``key``, merged across replicas."""
+        target = key_id(key)
+        now = time.monotonic()
+        local = {
+            sk: (v, exp - now)
+            for sk, (v, exp) in self.storage.get(key, {}).items()
+            if exp > now
+        }
+        _, remote = await self._lookup(target, key=key)
+        merged = dict(local)
+        for sk, (v, ttl) in remote.items():
+            if sk not in merged or merged[sk][1] < ttl:
+                merged[sk] = (v, ttl)
+        return {sk: json.loads(v) for sk, (v, _) in merged.items()}
+
+    async def get_value(self, key: str, default: object = None) -> object:
+        rec = await self.get(key)
+        return rec.get("", default)

@@ -1,0 +1,173 @@
+"""Matchmaking: form an averaging group for one round.
+
+The averaging cohort problem (SURVEY.md §7 hard part a): volunteers at
+roughly the same training point must agree on WHO is in this round before any
+tensor moves, and a peer dying mid-formation must not wedge anyone.
+
+Protocol (leader-based, one DHT rendezvous key per round):
+
+1. every interested peer announces under ``avg/<round_no>`` (TTL'd);
+2. peers poll the key; the smallest peer_id present is the LEADER;
+3. the leader freezes the member list, stamps a round EPOCH
+   (hash of round key + members), and pushes ``avg.begin`` to each member;
+4. members wait for the begin; no begin within the timeout -> round skipped
+   (local training continues — averaging is best-effort, Moshpit-style).
+
+The epoch travels with every subsequent tensor exchange; a message from a
+stale or conflicting group is rejected by epoch mismatch rather than
+corrupting the round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import time
+from typing import Dict, List, Optional, Tuple
+
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.transport import Addr, Transport
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class Group:
+    epoch: str
+    members: List[Tuple[str, Addr]]  # sorted by peer_id; [0] is the leader
+    my_index: int
+
+    @property
+    def leader_id(self) -> str:
+        return self.members[0][0]
+
+    @property
+    def size(self) -> int:
+        return len(self.members)
+
+    def addr_of(self, peer_id: str) -> Addr:
+        for pid, addr in self.members:
+            if pid == peer_id:
+                return addr
+        raise KeyError(peer_id)
+
+
+class Matchmaker:
+    def __init__(self, transport: Transport, dht: DHTNode, peer_id: str):
+        self.transport = transport
+        self.dht = dht
+        self.peer_id = peer_id
+        self._begin_futures: Dict[str, asyncio.Future] = {}
+        transport.register("avg.begin", self._rpc_begin)
+
+    async def _rpc_begin(self, args: dict, payload: bytes):
+        fut = self._begin_futures.get(args["round_key"])
+        if fut is not None and not fut.done():
+            fut.set_result(args)
+        else:
+            # Begin can arrive before our form_group() registers the future.
+            self._begin_futures[args["round_key"]] = done = asyncio.Future()
+            done.set_result(args)
+        return {"ok": True}, b""
+
+    @staticmethod
+    def _epoch(round_key: str, member_ids: List[str], nonce: str) -> str:
+        return hashlib.sha1(
+            (round_key + "|" + ",".join(member_ids) + "|" + nonce).encode()
+        ).hexdigest()[:16]
+
+    async def form_group(
+        self,
+        round_key: str,
+        min_group: int = 2,
+        max_group: int = 16,
+        join_timeout: float = 10.0,
+        settle: float = 0.5,
+    ) -> Optional[Group]:
+        """Rendezvous under ``round_key``.
+
+        The key is a CONSTANT per averaging mode (e.g. ``avg/sync``), not a
+        step number: volunteers at different local steps (fast peers, resumed
+        checkpoints) must still find each other. Round uniqueness comes from
+        the leader's nonce baked into the epoch, so two back-to-back rounds
+        under the same key can never mix tensors.
+        """
+        my_addr = list(self.transport.addr)
+        await self.dht.store(round_key, {"addr": my_addr}, subkey=self.peer_id, ttl=60.0)
+
+        fut = self._begin_futures.get(round_key)
+        if fut is None:
+            fut = self._begin_futures[round_key] = asyncio.Future()
+
+        deadline = time.monotonic() + join_timeout
+        members: List[Tuple[str, Addr]] = []
+        stable_since = None
+        try:
+            while time.monotonic() < deadline:
+                if fut.done():  # someone elected themselves leader already
+                    return self._group_from_begin(fut.result(), round_key)
+                rec = await self.dht.get(round_key)
+                current = sorted(
+                    (pid, tuple(info["addr"])) for pid, info in rec.items() if info is not None
+                )
+                if [m[0] for m in current] != [m[0] for m in members]:
+                    members = current
+                    stable_since = time.monotonic()
+                enough = len(members) >= min_group
+                stable = stable_since is not None and time.monotonic() - stable_since >= settle
+                full = len(members) >= max_group
+                if enough and (stable or full):
+                    if members[0][0] == self.peer_id:
+                        return await self._lead(round_key, members[:max_group])
+                    # not leader: fall through to awaiting begin
+                    break
+                await asyncio.sleep(0.1)
+
+            if not (len(members) >= min_group):
+                log.info("round %s: only %d peers, skipping", round_key, len(members))
+                return None
+            remaining = max(deadline - time.monotonic(), 2.0)
+            begin = await asyncio.wait_for(fut, timeout=remaining)
+            return self._group_from_begin(begin, round_key)
+        except asyncio.TimeoutError:
+            log.info("round %s: no begin from leader, skipping", round_key)
+            return None
+        finally:
+            self._begin_futures.pop(round_key, None)
+
+    def _group_from_begin(self, begin: dict, round_key: str) -> Optional[Group]:
+        members = [(pid, tuple(addr)) for pid, addr in begin["members"]]
+        ids = [pid for pid, _ in members]
+        if begin["epoch"] != self._epoch(round_key, ids, begin.get("nonce", "")):
+            log.warning("round %s: epoch mismatch in begin, skipping", round_key)
+            return None
+        if self.peer_id not in ids:
+            return None
+        return Group(epoch=begin["epoch"], members=members, my_index=ids.index(self.peer_id))
+
+    async def _lead(self, round_key: str, members: List[Tuple[str, Addr]]) -> Optional[Group]:
+        import uuid
+
+        ids = [pid for pid, _ in members]
+        nonce = uuid.uuid4().hex[:8]
+        epoch = self._epoch(round_key, ids, nonce)
+        begin = {
+            "round_key": round_key,
+            "epoch": epoch,
+            "nonce": nonce,
+            "members": [[pid, list(addr)] for pid, addr in members],
+        }
+        reached = []
+        for pid, addr in members:
+            if pid == self.peer_id:
+                continue
+            try:
+                await self.transport.call(addr, "avg.begin", begin, timeout=5.0)
+                reached.append(pid)
+            except Exception as e:
+                log.warning("round %s: member %s unreachable at begin: %s", round_key, pid, e)
+        if not reached:
+            return None
+        return Group(epoch=epoch, members=members, my_index=ids.index(self.peer_id))

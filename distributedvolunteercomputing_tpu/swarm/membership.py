@@ -1,0 +1,91 @@
+"""Swarm membership: join/leave, heartbeat liveness, churn handling.
+
+Reference parity (BASELINE.json:5): "a heartbeat and a join/leave handler"
+adapted to TPU-VM volunteers — on TPU the dominant churn source is VM
+PREEMPTION, so leave() is wired to SIGTERM (the preemption notice) as well as
+normal shutdown (see swarm.volunteer).
+
+Liveness is soft-state: each volunteer re-announces itself under the shared
+``peers`` DHT key with a TTL; death == record expiry. Nobody has to observe a
+crash — a kill -9'd volunteer vanishes from ``alive_peers()`` within one TTL
+(SURVEY.md §3-E).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional
+
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+PEERS_KEY = "peers"
+
+
+class SwarmMembership:
+    def __init__(
+        self,
+        dht: DHTNode,
+        peer_id: str,
+        ttl: float = 15.0,
+        extra_info: Optional[dict] = None,
+    ):
+        self.dht = dht
+        self.peer_id = peer_id
+        self.ttl = ttl
+        self.extra_info = extra_info or {}
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        self._left = False
+
+    def _record(self) -> dict:
+        return {
+            "addr": list(self.dht.transport.addr),
+            "t": time.time(),
+            **self.extra_info,
+        }
+
+    async def join(self) -> None:
+        """Announce and start heartbeating."""
+        self._left = False
+        await self.dht.store(PEERS_KEY, self._record(), subkey=self.peer_id, ttl=self.ttl)
+        if self._heartbeat_task is None:
+            self._heartbeat_task = asyncio.create_task(self._heartbeat_loop())
+        log.info("peer %s joined swarm", self.peer_id)
+
+    async def leave(self) -> None:
+        """Graceful leave: tombstone the record (preemption path calls this)."""
+        self._left = True
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            self._heartbeat_task = None
+        await self.dht.store(PEERS_KEY, None, subkey=self.peer_id, ttl=self.ttl)
+        log.info("peer %s left swarm", self.peer_id)
+
+    async def _heartbeat_loop(self) -> None:
+        # Re-announce at TTL/3: two missed beats still leave the record live.
+        try:
+            while not self._left:
+                await asyncio.sleep(self.ttl / 3.0)
+                try:
+                    await self.dht.store(
+                        PEERS_KEY, self._record(), subkey=self.peer_id, ttl=self.ttl
+                    )
+                except Exception as e:
+                    log.warning("heartbeat store failed: %s", e)
+        except asyncio.CancelledError:
+            pass
+
+    async def alive_peers(self, include_self: bool = True) -> Dict[str, dict]:
+        """Live peer_id -> record; tombstones (None) are filtered out."""
+        rec = await self.dht.get(PEERS_KEY)
+        out = {pid: info for pid, info in rec.items() if info is not None}
+        if not include_self:
+            out.pop(self.peer_id, None)
+        return out
+
+    def update_info(self, **kv: object) -> None:
+        """Update fields (e.g. current step) carried in the next heartbeat."""
+        self.extra_info.update(kv)

@@ -1,0 +1,226 @@
+"""Volunteer lifecycle: join swarm -> collaborative train loop -> leave.
+
+Reference call stack B (SURVEY.md §3): connect to coordinator, DHT join,
+announce, build model+optimizer on device, train with periodic averaging,
+and on SIGTERM/preemption leave cleanly and flush state.
+
+Threading model: the asyncio loop (swarm services: DHT, heartbeat, averaging
+RPC handlers) owns the MAIN thread; the blocking JAX train loop runs in a
+worker thread and bridges into the loop per averaging round via
+``run_coroutine_threadsafe``. On TPU-VMs the preemption notice arrives as
+SIGTERM (BASELINE.json:5) — handled exactly like a user Ctrl-C: stop flag,
+final checkpoint, tombstone, exit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import signal
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from distributedvolunteercomputing_tpu.models import get_model
+from distributedvolunteercomputing_tpu.swarm.averager import make_averager
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.transport import Transport
+from distributedvolunteercomputing_tpu.training.trainer import Trainer
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class VolunteerConfig:
+    model: str = "mnist_mlp"
+    model_overrides: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    coordinator: Optional[str] = None  # "host:port"; None = run standalone
+    host: str = "127.0.0.1"
+    port: int = 0
+    advertise_host: Optional[str] = None  # dialable address when binding 0.0.0.0
+    peer_id: str = ""
+    averaging: str = "none"  # none|sync|gossip|butterfly|byzantine
+    average_every: int = 10
+    min_group: int = 2
+    max_group: int = 16
+    batch_size: int = 32
+    optimizer: str = "adam"
+    lr: float = 1e-3
+    seed: int = 0
+    steps: int = 1000
+    target_loss: Optional[float] = None
+    metrics_path: Optional[str] = None
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 200
+    heartbeat_ttl: float = 15.0
+    join_timeout: float = 10.0
+    gather_timeout: float = 20.0
+    method: str = "mean"  # robust aggregation estimator for byzantine mode
+
+    def __post_init__(self):
+        if not self.peer_id:
+            self.peer_id = f"vol-{uuid.uuid4().hex[:8]}"
+
+
+class Volunteer:
+    def __init__(self, cfg: VolunteerConfig):
+        self.cfg = cfg
+        self.transport = Transport(cfg.host, cfg.port, advertise_host=cfg.advertise_host)
+        self.dht = DHTNode(self.transport)
+        self.membership: Optional[SwarmMembership] = None
+        self.averager = None
+        self.trainer: Optional[Trainer] = None
+        self._stop = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.summary: Dict[str, float] = {}
+
+    # -- averager bridge (called from the trainer thread) ------------------
+
+    def _averager_callback(self, params, step: int):
+        if self.averager is None or self._stop.is_set():
+            return None
+        samples_since = self.cfg.batch_size * self.cfg.average_every
+        fut = asyncio.run_coroutine_threadsafe(
+            self.averager.average(params, round_no=step, weight=float(samples_since)),
+            self._loop,
+        )
+        try:
+            return fut.result(timeout=self.cfg.join_timeout + self.cfg.gather_timeout + 15.0)
+        except Exception as e:
+            log.warning("averaging at step %d failed: %s", step, e)
+            return None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        await self.transport.start()
+        bootstrap = None
+        if self.cfg.coordinator:
+            host, port = self.cfg.coordinator.rsplit(":", 1)
+            bootstrap = [(host, int(port))]
+        await self.dht.start(bootstrap=bootstrap)
+        self.membership = SwarmMembership(
+            self.dht, self.cfg.peer_id, ttl=self.cfg.heartbeat_ttl,
+            extra_info={"model": self.cfg.model},
+        )
+        await self.membership.join()
+        if self.cfg.averaging != "none":
+            kw = dict(
+                min_group=self.cfg.min_group,
+                max_group=self.cfg.max_group,
+                join_timeout=self.cfg.join_timeout,
+                gather_timeout=self.cfg.gather_timeout,
+            )
+            if self.cfg.averaging == "byzantine" and self.cfg.method != "mean":
+                kw["method"] = self.cfg.method
+            self.averager = make_averager(
+                self.cfg.averaging, self.transport, self.dht, self.membership, **kw
+            )
+        bundle = get_model(self.cfg.model, **self.cfg.model_overrides)
+        on_step = None
+        if self.cfg.checkpoint_dir and self.cfg.checkpoint_every > 0:
+            from distributedvolunteercomputing_tpu.training.checkpoint import save
+
+            ckpt_dir, every = self.cfg.checkpoint_dir, self.cfg.checkpoint_every
+
+            def on_step(trainer, step_no):
+                # Periodic snapshot: a kill -9 between saves loses at most
+                # checkpoint_every steps, not the whole run.
+                if step_no % every == 0:
+                    save(trainer, ckpt_dir)
+
+        self.trainer = Trainer(
+            bundle,
+            batch_size=self.cfg.batch_size,
+            optimizer=self.cfg.optimizer,
+            lr=self.cfg.lr,
+            seed=self.cfg.seed,
+            average_every=self.cfg.average_every,
+            averager=self._averager_callback if self.averager else None,
+            metrics_path=self.cfg.metrics_path,
+            volunteer_id=self.cfg.peer_id,
+            total_steps=self.cfg.steps,
+            on_step=on_step,
+        )
+        if self.cfg.checkpoint_dir:
+            from distributedvolunteercomputing_tpu.training.checkpoint import maybe_restore
+
+            maybe_restore(self.trainer, self.cfg.checkpoint_dir)
+        log.info(
+            "volunteer %s up on %s:%d (model=%s averaging=%s)",
+            self.cfg.peer_id, *self.transport.addr, self.cfg.model, self.cfg.averaging,
+        )
+
+    async def _report_loop(self) -> None:
+        if not self.cfg.coordinator:
+            return
+        host, port = self.cfg.coordinator.rsplit(":", 1)
+        caddr = (host, int(port))
+        while not self._stop.is_set():
+            await asyncio.sleep(5.0)
+            try:
+                await self.transport.call(
+                    caddr,
+                    "coord.report",
+                    {
+                        "peer": self.cfg.peer_id,
+                        "step": int(self.trainer.state.step) if self.trainer else 0,
+                        "samples_per_sec": self.trainer.metrics.samples_per_sec()
+                        if self.trainer
+                        else 0.0,
+                        **{k: v for k, v in self.summary.items()},
+                    },
+                    timeout=5.0,
+                )
+            except Exception:
+                pass  # coordinator reachability is not correctness-critical
+
+    def _train_blocking(self) -> Dict[str, float]:
+        assert self.trainer is not None
+        result = self.trainer.run(
+            steps=self.cfg.steps,
+            target_loss=self.cfg.target_loss,
+            stop_flag=self._stop.is_set,
+        )
+        if self.cfg.checkpoint_dir:
+            from distributedvolunteercomputing_tpu.training.checkpoint import save
+
+            save(self.trainer, self.cfg.checkpoint_dir)
+        return result
+
+    async def run(self) -> Dict[str, float]:
+        await self.start()
+        report_task = asyncio.create_task(self._report_loop())
+        try:
+            self.summary = await asyncio.to_thread(self._train_blocking)
+            if self.averager is not None:
+                self.summary.update(self.averager.stats())
+            return self.summary
+        finally:
+            self._stop.set()
+            report_task.cancel()
+            try:
+                await self.membership.leave()
+            except Exception:
+                pass
+            await self.transport.close()
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM == TPU-VM preemption notice; SIGINT == operator stop."""
+
+        def _on_signal(signum, frame):
+            log.info("signal %d: stopping after current step (preemption-safe)", signum)
+            self._stop.set()
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+
+
+def run_volunteer(cfg: VolunteerConfig) -> Dict[str, float]:
+    vol = Volunteer(cfg)
+    vol.install_signal_handlers()
+    return asyncio.run(vol.run())

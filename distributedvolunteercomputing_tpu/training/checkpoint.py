@@ -1,0 +1,77 @@
+"""Checkpoint/resume (SURVEY.md §5): Orbax-backed local snapshots.
+
+Volunteer churn only makes sense if a stopped volunteer can come back
+(preemption -> restart on a fresh TPU-VM): ``save`` flushes the full
+TrainState (params, optimizer state, step, rng), ``maybe_restore`` loads the
+newest snapshot if one exists. Peer-pull resume (fetching newer params from
+live peers after a long absence) lives in swarm.volunteer.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+import jax
+import numpy as np
+
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _state_to_pytree(trainer) -> dict:
+    return {
+        "params": trainer.state.params,
+        "opt_state": trainer.state.opt_state,
+        "step": trainer.state.step,
+        "rng": trainer.state.rng,
+    }
+
+
+def save(trainer, ckpt_dir: str) -> str:
+    import orbax.checkpoint as ocp
+
+    step = int(trainer.state.step)
+    path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, _state_to_pytree(trainer), force=True)
+    log.info("checkpoint saved: %s", path)
+    return path
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(m.group(1))
+        for name in os.listdir(ckpt_dir)
+        if (m := _STEP_RE.match(name))
+    ]
+    return max(steps) if steps else None
+
+
+def maybe_restore(trainer, ckpt_dir: str) -> bool:
+    """Load the newest snapshot into the trainer, if any. Returns True if restored."""
+    import orbax.checkpoint as ocp
+
+    step = latest_step(ckpt_dir)
+    if step is None:
+        return False
+    path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
+    template = jax.tree_util.tree_map(np.asarray, _state_to_pytree(trainer))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path, item=template)
+    from distributedvolunteercomputing_tpu.training.steps import TrainState
+
+    trainer.state = TrainState(
+        params=jax.device_put(restored["params"]),
+        opt_state=jax.device_put(restored["opt_state"]),
+        step=jax.device_put(restored["step"]),
+        rng=jax.device_put(restored["rng"]),
+    )
+    log.info("restored checkpoint step %d from %s", step, path)
+    return True

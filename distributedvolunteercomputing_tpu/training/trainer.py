@@ -41,6 +41,7 @@ class Trainer:
         metrics_path: Optional[str] = None,
         volunteer_id: str = "local",
         total_steps: Optional[int] = None,
+        on_step: Optional[Callable[["Trainer", int], None]] = None,
     ):
         self.bundle = bundle
         self.batch_size = batch_size
@@ -54,6 +55,7 @@ class Trainer:
         self._step_fn = make_train_step(bundle.loss_fn, self.tx)
         self._data_rng = data_rng
         self.metrics = MetricsWriter(metrics_path, volunteer_id)
+        self.on_step = on_step
 
     def data_iter(self) -> Iterable[Batch]:
         rng = self._data_rng
@@ -95,16 +97,24 @@ class Trainer:
                 self.metrics.count_samples(self.batch_size)
 
             if self.averager is not None and step_no % self.average_every == 0:
-                averaged = self.averager(self.state.params, step_no)
+                # Only the bundle-selected payload crosses the WAN (full
+                # params by default; adapters only for LoRA models).
+                payload = self.bundle.avg_select(self.state.params)
+                averaged = self.averager(payload, step_no)
                 if averaged is not None:
+                    new_params = self.bundle.avg_merge(
+                        self.state.params,
+                        jax.tree_util.tree_map(np.asarray, averaged),
+                    )
                     self.state = TrainState(
-                        params=jax.device_put(
-                            jax.tree_util.tree_map(np.asarray, averaged)
-                        ),
+                        params=jax.device_put(new_params),
                         opt_state=self.state.opt_state,
                         step=self.state.step,
                         rng=self.state.rng,
                     )
+
+            if self.on_step is not None:
+                self.on_step(self, step_no)
 
             if at_log_point:
                 log.info(

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Volunteer entrypoint (reference-parity name, BASELINE.json:5).
+
+Starts one volunteer: joins the swarm via the coordinator's DHT, trains the
+chosen workload locally on this slice's TPU(s), and participates in the
+selected WAN averaging mode. The five reference configs (BASELINE.json:7-11)
+map to:
+
+    # 1: MNIST MLP, local SGD, no averaging
+    python run_volunteer.py --model mnist_mlp --averaging none --steps 500
+
+    # 2: ResNet-18, 2 volunteers, synchronous averaging
+    python run_volunteer.py --model cifar10_resnet18 --averaging sync \
+        --coordinator 127.0.0.1:9000
+
+    # 3: BERT MLM, async gossip        --model bert_mlm   --averaging gossip
+    # 4: GPT-2 small, butterfly        --model gpt2_small --averaging butterfly
+    # 5: Llama LoRA, Byzantine + churn --model llama_lora --averaging byzantine
+
+On TPU-VM preemption (SIGTERM) the volunteer checkpoints, tombstones its
+membership record, and exits cleanly.
+"""
+
+import argparse
+import json
+
+from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig, run_volunteer
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="mnist_mlp")
+    ap.add_argument("--model-override", action="append", default=[],
+                    help="key=value config override (repeatable), e.g. d_model=128")
+    ap.add_argument("--coordinator", default=None, help="host:port of the coordinator")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--advertise-host", default=None,
+                    help="dialable address to publish when binding 0.0.0.0")
+    ap.add_argument("--checkpoint-every", type=int, default=200)
+    ap.add_argument("--peer-id", default="")
+    ap.add_argument("--averaging", default="none",
+                    choices=["none", "sync", "gossip", "butterfly", "byzantine"])
+    ap.add_argument("--average-every", type=int, default=10)
+    ap.add_argument("--min-group", type=int, default=2)
+    ap.add_argument("--max-group", type=int, default=16)
+    ap.add_argument("--method", default="trimmed_mean",
+                    help="byzantine estimator: trimmed_mean|median|krum|geometric_median")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--optimizer", default="adam")
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=1000)
+    ap.add_argument("--target-loss", type=float, default=None)
+    ap.add_argument("--metrics", default=None)
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--join-timeout", type=float, default=10.0)
+    ap.add_argument("--gather-timeout", type=float, default=20.0)
+    args = ap.parse_args()
+
+    overrides = {}
+    for kv in args.model_override:
+        k, v = kv.split("=", 1)
+        try:
+            overrides[k] = json.loads(v)
+        except json.JSONDecodeError:
+            overrides[k] = v
+
+    cfg = VolunteerConfig(
+        model=args.model,
+        model_overrides=overrides,
+        coordinator=args.coordinator,
+        host=args.host,
+        port=args.port,
+        advertise_host=args.advertise_host,
+        peer_id=args.peer_id,
+        averaging=args.averaging,
+        average_every=args.average_every,
+        min_group=args.min_group,
+        max_group=args.max_group,
+        method=args.method,
+        batch_size=args.batch_size,
+        optimizer=args.optimizer,
+        lr=args.lr,
+        seed=args.seed,
+        steps=args.steps,
+        target_loss=args.target_loss,
+        metrics_path=args.metrics,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        join_timeout=args.join_timeout,
+        gather_timeout=args.gather_timeout,
+    )
+    summary = run_volunteer(cfg)
+    print("VOLUNTEER_DONE " + json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

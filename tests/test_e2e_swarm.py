@@ -1,0 +1,145 @@
+"""End-to-end swarm tests: real processes, real entrypoints, real churn.
+
+This is the reference's own test shape (SURVEY.md §4): N volunteer PROCESSES
+on localhost, a coordinator process, kill -9 mid-run — the whole L6-L2 stack
+through the actual CLI entrypoints.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY_MLP = ["--model-override", "d_hidden=16"]
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single CPU device is enough per volunteer
+    # Prevent the sandbox sitecustomize from registering the axon TPU plugin:
+    # plugin *registration* alone makes jax's backend discovery touch the TPU
+    # relay, which can hang every subprocess when the relay is busy/wedged.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def start_coordinator():
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "coordinator.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        m = re.match(r"COORDINATOR_READY (\S+)", line or "")
+        if m:
+            return proc, m.group(1)
+    proc.kill()
+    raise RuntimeError("coordinator did not become ready")
+
+
+def start_volunteer(coord_addr, peer_id, extra):
+    return subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "run_volunteer.py"),
+            "--coordinator", coord_addr,
+            "--peer-id", peer_id,
+            "--batch-size", "16",
+            "--lr", "0.01",
+            *TINY_MLP,
+            *extra,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+    )
+
+
+def wait_done(proc, timeout=180):
+    out, _ = proc.communicate(timeout=timeout)
+    for line in out.splitlines():
+        if line.startswith("VOLUNTEER_DONE "):
+            return json.loads(line[len("VOLUNTEER_DONE "):]), out
+    raise AssertionError(f"no VOLUNTEER_DONE in output:\n{out}")
+
+
+class TestSwarmE2E:
+    def test_two_volunteers_sync_averaging(self, tmp_path):
+        """Config-2 shape: 2 volunteers, synchronous GradientAverager."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                "--averaging", "sync", "--average-every", "10", "--steps", "40",
+                "--join-timeout", "25", "--gather-timeout", "25",
+            ]
+            v0 = start_volunteer(addr, "vol0", common + ["--seed", "0"])
+            v1 = start_volunteer(addr, "vol1", common + ["--seed", "1"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            assert s0["rounds_ok"] >= 2, out0
+            assert s1["rounds_ok"] >= 2, out1
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
+        finally:
+            coord.kill()
+
+    def test_churn_kill9_survivors_finish(self):
+        """Kill -9 one of three volunteers mid-run; survivors keep averaging."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                "--averaging", "sync", "--average-every", "8", "--steps", "48",
+                "--min-group", "2", "--join-timeout", "20", "--gather-timeout", "10",
+            ]
+            vols = [start_volunteer(addr, f"vol{i}", common + ["--seed", str(i)]) for i in range(3)]
+            time.sleep(12)  # let it train into the averaging phase
+            vols[2].send_signal(signal.SIGKILL)  # un-graceful death
+            s0, out0 = wait_done(vols[0])
+            s1, out1 = wait_done(vols[1])
+            assert s0["rounds_ok"] >= 1, out0
+            assert s1["rounds_ok"] >= 1, out1
+        finally:
+            coord.kill()
+            for v in vols:
+                if v.poll() is None:
+                    v.kill()
+
+    def test_sigterm_preemption_graceful(self, tmp_path):
+        """SIGTERM (TPU-VM preemption notice) -> checkpoint + clean exit."""
+        ckpt = str(tmp_path / "ckpt")
+        v = start_volunteer_standalone = subprocess.Popen(
+            [
+                sys.executable, os.path.join(REPO, "run_volunteer.py"),
+                "--peer-id", "preempt-me", "--steps", "100000", "--batch-size", "16",
+                *TINY_MLP, "--checkpoint-dir", ckpt,
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+        )
+        time.sleep(15)  # well into training
+        v.send_signal(signal.SIGTERM)
+        summary, out = wait_done(v, timeout=60)
+        assert v.returncode == 0, out
+        assert summary["steps"] > 0
+        assert os.path.isdir(ckpt) and os.listdir(ckpt), "no checkpoint written"
+
+    def test_checkpoint_resume(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        base = ["--steps", "20", "--checkpoint-dir", ckpt, *TINY_MLP, "--batch-size", "8"]
+        v1 = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "run_volunteer.py"), *base],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+        )
+        s1, out1 = wait_done(v1)
+        assert s1["steps"] == 20, out1
+        v2 = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "run_volunteer.py"),
+             "--steps", "5", "--checkpoint-dir", ckpt, *TINY_MLP, "--batch-size", "8"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+        )
+        s2, out2 = wait_done(v2)
+        assert s2["steps"] == 25, f"resume failed (expected 20+5):\n{out2}"

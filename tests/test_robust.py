@@ -1,0 +1,76 @@
+"""Robust aggregation ops vs. hand-computed numpy references (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.ops import robust
+
+
+@pytest.fixture
+def stack(np_rng):
+    return np_rng.normal(size=(6, 40)).astype(np.float32)
+
+
+def test_mean_weighted(stack):
+    w = np.array([1, 1, 2, 0, 0, 0], np.float64)
+    expect = (stack[0] + stack[1] + 2 * stack[2]) / 4
+    np.testing.assert_allclose(robust.mean(stack, w), expect, rtol=1e-6)
+
+
+def test_median_resists_one_attacker(stack):
+    poisoned = stack.copy()
+    poisoned[0] = 1e9  # malicious volunteer
+    out = robust.coordinate_median(poisoned)
+    assert np.abs(out).max() < 100.0
+
+
+def test_trimmed_mean_drops_extremes(stack):
+    poisoned = stack.copy()
+    poisoned[0] = 1e9
+    poisoned[1] = -1e9
+    out = robust.trimmed_mean(poisoned, trim=1)
+    clean = np.sort(poisoned, axis=0)[1:-1].mean(axis=0)
+    np.testing.assert_allclose(out, clean, rtol=1e-5)
+    assert np.abs(out).max() < 100.0
+
+
+def test_trimmed_mean_rejects_overtrim(stack):
+    with pytest.raises(ValueError):
+        robust.trimmed_mean(stack, trim=3)
+
+
+def test_trim_zero_is_mean(stack):
+    # sorting reorders the summation; equality is up to f32 rounding
+    np.testing.assert_allclose(
+        robust.trimmed_mean(stack, trim=0), stack.mean(0), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_krum_picks_clean_point(np_rng):
+    honest = np_rng.normal(size=(5, 20)).astype(np.float32) * 0.1
+    attacker = np.full((1, 20), 50.0, np.float32)
+    stack = np.concatenate([honest, attacker])
+    out = robust.krum(stack, n_byzantine=1)
+    assert np.abs(out).max() < 1.0
+
+
+def test_krum_degrades_to_median_for_tiny_groups(np_rng):
+    stack = np_rng.normal(size=(3, 10)).astype(np.float32)
+    np.testing.assert_allclose(
+        robust.krum(stack, n_byzantine=1), robust.coordinate_median(stack)
+    )
+
+
+def test_geometric_median_bounded_under_attack(np_rng):
+    honest = np_rng.normal(size=(6, 30)).astype(np.float32)
+    poisoned = np.concatenate([honest, np.full((2, 30), 1e6, np.float32)])
+    out = robust.geometric_median(poisoned)
+    assert np.abs(out).max() < 10.0
+
+
+def test_aggregate_dispatch_and_errors(stack):
+    np.testing.assert_allclose(robust.aggregate(stack, "mean"), stack.mean(0), rtol=1e-6)
+    with pytest.raises(KeyError):
+        robust.aggregate(stack, "nope")
+    with pytest.raises(ValueError):
+        robust.aggregate(stack[0], "mean")

@@ -1,0 +1,248 @@
+"""Transport / DHT / membership tests — in-process, real localhost sockets.
+
+The "multi-node-without-a-cluster" strategy (SURVEY.md §4): every node is a
+real asyncio TCP server on 127.0.0.1, so the wire protocol, timeouts, and
+churn behavior are exercised for real; only process isolation is elided
+(covered separately by the entrypoint e2e test).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm.coordinator import Coordinator
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestTransport:
+    def test_echo_roundtrip(self):
+        async def main():
+            server = Transport()
+
+            async def echo(args, payload):
+                return {"got": args["x"]}, payload[::-1]
+
+            server.register("echo", echo)
+            addr = await server.start()
+            client = Transport()
+            ret, payload = await client.call(addr, "echo", {"x": 42}, b"abc")
+            await server.close()
+            return ret, payload
+
+        ret, payload = run(main())
+        assert ret == {"got": 42}
+        assert payload == b"cba"
+
+    def test_large_binary_payload(self):
+        async def main():
+            server = Transport()
+
+            async def double(args, payload):
+                arr = np.frombuffer(payload, np.float32) * 2
+                return {}, arr.tobytes()
+
+            server.register("double", double)
+            addr = await server.start()
+            client = Transport()
+            data = np.arange(300_000, dtype=np.float32)
+            _, resp = await client.call(addr, "double", payload=data.tobytes())
+            await server.close()
+            return data, np.frombuffer(resp, np.float32)
+
+        data, resp = run(main())
+        np.testing.assert_allclose(resp, data * 2)
+
+    def test_unknown_method_raises(self):
+        async def main():
+            server = Transport()
+            addr = await server.start()
+            client = Transport()
+            try:
+                with pytest.raises(RPCError, match="no such method"):
+                    await client.call(addr, "nope")
+            finally:
+                await server.close()
+
+        run(main())
+
+    def test_handler_exception_propagates(self):
+        async def main():
+            server = Transport()
+
+            async def boom(args, payload):
+                raise ValueError("kaboom")
+
+            server.register("boom", boom)
+            addr = await server.start()
+            client = Transport()
+            try:
+                with pytest.raises(RPCError, match="kaboom"):
+                    await client.call(addr, "boom")
+            finally:
+                await server.close()
+
+        run(main())
+
+    def test_dead_peer_times_out(self):
+        async def main():
+            client = Transport()
+            with pytest.raises((OSError, asyncio.TimeoutError)):
+                await client.call(("127.0.0.1", 1), "ping", timeout=2.0)
+
+        run(main())
+
+
+async def _spawn_swarm(n, bootstrap_first=True):
+    nodes = []
+    for i in range(n):
+        node = DHTNode(Transport())
+        boot = [nodes[0].transport.addr] if (nodes and bootstrap_first) else []
+        await node.start(bootstrap=boot)
+        nodes.append(node)
+    return nodes
+
+
+async def _teardown(nodes):
+    for n in nodes:
+        await n.transport.close()
+
+
+class TestDHT:
+    def test_store_get_across_nodes(self):
+        async def main():
+            nodes = await _spawn_swarm(5)
+            try:
+                await nodes[1].store("model_version", {"step": 120}, ttl=30)
+                seen = await nodes[4].get_value("model_version")
+                return seen
+            finally:
+                await _teardown(nodes)
+
+        assert run(main()) == {"step": 120}
+
+    def test_subkey_merge_from_different_writers(self):
+        async def main():
+            nodes = await _spawn_swarm(4)
+            try:
+                for i, node in enumerate(nodes):
+                    await node.store("peers", {"rank": i}, subkey=f"peer{i}", ttl=30)
+                views = [await n.get("peers") for n in nodes]
+                return views
+            finally:
+                await _teardown(nodes)
+
+        views = run(main())
+        for view in views:
+            assert set(view) == {"peer0", "peer1", "peer2", "peer3"}
+            assert view["peer2"] == {"rank": 2}
+
+    def test_expiry(self):
+        async def main():
+            nodes = await _spawn_swarm(3)
+            try:
+                await nodes[0].store("ephemeral", "x", ttl=0.5)
+                now = await nodes[2].get_value("ephemeral")
+                await asyncio.sleep(0.8)
+                later = await nodes[2].get_value("ephemeral", default="GONE")
+                return now, later
+            finally:
+                await _teardown(nodes)
+
+        now, later = run(main())
+        assert now == "x"
+        assert later == "GONE"
+
+    def test_survives_node_death(self):
+        async def main():
+            nodes = await _spawn_swarm(6)
+            try:
+                await nodes[1].store("k", "v", ttl=30)
+                # kill half the swarm, including the bootstrap node
+                for victim in nodes[:3]:
+                    await victim.transport.close()
+                return await nodes[4].get_value("k", default="LOST")
+            finally:
+                await _teardown(nodes[3:])
+
+        # replication factor K=8 > swarm size, so every node holds a replica
+        assert run(main()) == "v"
+
+
+class TestMembership:
+    def test_join_heartbeat_leave(self):
+        async def main():
+            nodes = await _spawn_swarm(3)
+            try:
+                members = [
+                    SwarmMembership(node, f"vol{i}", ttl=2.0) for i, node in enumerate(nodes)
+                ]
+                for m in members:
+                    await m.join()
+                alive = await members[0].alive_peers()
+                await members[2].leave()
+                after_leave = await members[0].alive_peers()
+                return alive, after_leave
+            finally:
+                await _teardown(nodes)
+
+        alive, after_leave = run(main())
+        assert set(alive) == {"vol0", "vol1", "vol2"}
+        assert set(after_leave) == {"vol0", "vol1"}
+
+    def test_crashed_peer_expires(self):
+        async def main():
+            nodes = await _spawn_swarm(3)
+            try:
+                members = [
+                    SwarmMembership(node, f"vol{i}", ttl=1.2) for i, node in enumerate(nodes)
+                ]
+                for m in members:
+                    await m.join()
+                # simulate kill -9: no leave(), just stop heartbeats + socket
+                members[1]._heartbeat_task.cancel()
+                await nodes[1].transport.close()
+                await asyncio.sleep(1.6)
+                alive = await members[0].alive_peers()
+                return alive
+            finally:
+                await _teardown([nodes[0], nodes[2]])
+
+        alive = run(main())
+        assert "vol1" not in alive
+        assert {"vol0", "vol2"} <= set(alive)
+
+
+class TestCoordinator:
+    def test_status_aggregates(self):
+        async def main():
+            coord = Coordinator()
+            caddr = await coord.start()
+            try:
+                nodes = []
+                for i in range(3):
+                    node = DHTNode(Transport())
+                    await node.start(bootstrap=[caddr])
+                    nodes.append(node)
+                    m = SwarmMembership(node, f"vol{i}", ttl=10.0)
+                    await m.join()
+                    await node.transport.call(
+                        caddr,
+                        "coord.report",
+                        {"peer": f"vol{i}", "step": 10 * i, "samples_per_sec": 100.0},
+                    )
+                status, _ = await coord._rpc_status({}, b"")
+                await _teardown(nodes)
+                return status
+            finally:
+                await coord.close()
+
+        status = run(main())
+        assert status["n_alive"] == 3
+        assert status["swarm_samples_per_sec"] == pytest.approx(300.0)
