@@ -1,0 +1,33 @@
+"""Intra-slice parallelism: mesh construction, sharding rules, pjit steps.
+
+This is the TPU-native replacement for the reference's NCCL intra-node layer
+(BASELINE.json:5): collectives here are XLA-compiler-emitted over ICI, not
+hand-called NCCL ops. The swarm/ package handles the WAN (DCN) tier between
+volunteer slices; this package handles everything inside one slice:
+
+- ``mesh``       — device mesh construction (dp / tp / sp axes)
+- ``sharding``   — parameter partition rules (Megatron-style TP for the
+                   transformer zoo) and batch specs
+- ``train_step`` — the sharded train step: fwd/bwd/update in ONE compiled
+                   computation, gradient reduction over dp emitted by XLA
+"""
+
+from distributedvolunteercomputing_tpu.parallel.mesh import make_mesh
+from distributedvolunteercomputing_tpu.parallel.sharding import (
+    batch_sharding,
+    make_param_shardings,
+    partition_spec_for_path,
+)
+from distributedvolunteercomputing_tpu.parallel.train_step import (
+    make_sharded_train_step,
+    shard_train_state,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "make_param_shardings",
+    "partition_spec_for_path",
+    "make_sharded_train_step",
+    "shard_train_state",
+]

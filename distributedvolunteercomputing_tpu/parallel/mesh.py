@@ -1,0 +1,42 @@
+"""Device mesh construction for one volunteer slice.
+
+Axis convention (outer → inner): ``("dp", "sp", "tp")``.
+
+``tp`` is innermost so tensor-parallel collectives (the per-layer
+allreduces) land on ICI-adjacent chips; ``dp`` is outermost because its one
+gradient reduction per step tolerates the longest hops. ``sp`` (sequence
+parallelism for long context) sits between: its ppermute ring wants
+neighbours closer than dp but is far less chatty than tp.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+def make_mesh(
+    dp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(dp, sp, tp)`` mesh from the first dp*sp*tp local devices."""
+    if devices is None:
+        devices = jax.devices()
+    need = dp * sp * tp
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh dp={dp} sp={sp} tp={tp} needs {need} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(arr, AXES)
+
+
+def mesh_shape(mesh: Mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,94 @@
+"""Parameter partition rules: Megatron-style tensor parallelism by path.
+
+The model zoo stores params as plain nested dicts/lists (models/*.py), so
+partition specs are assigned by matching the pytree *path* against a small
+generic rule table that covers every transformer in the zoo:
+
+- column-parallel (shard the OUTPUT feature dim over ``tp``): qkv / wq / wk /
+  wv projections, mlp_in / w_gate / w_up — the matmul that *fans out*;
+- row-parallel (shard the INPUT feature dim over ``tp``): attn_out / wo /
+  mlp_out / w_down — the matmul that *fans in*, after which XLA emits the
+  layer's one allreduce over ICI;
+- everything else (embeddings, norms, biases of row-parallel layers, LoRA
+  adapters — rank ~8, not worth slicing) is replicated.
+
+This is the build-side TP addition documented in SURVEY.md §2 (reference is
+volunteer-DP only; TP within a slice is what `pjit` gives us for free).
+
+A rule only applies when the sharded dim is divisible by the mesh axis size;
+otherwise that dim silently falls back to replicated (e.g. GPT-2's vocab
+50257 is prime — the tied embedding stays replicated on any mesh).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec). First match wins; paths look like "blocks/3/qkv/w".
+# Column-parallel weights are [d_in, d_out] → P(None, "tp"); their biases
+# [d_out] → P("tp"). Row-parallel weights are [d_in, d_out] → P("tp", None);
+# their biases are full-size → replicated.
+_RULES: List[Tuple[str, P]] = [
+    (r".*/(qkv|mlp_in)/w$", P(None, "tp")),
+    (r".*/(qkv|mlp_in)/b$", P("tp")),
+    (r".*/(attn_out|mlp_out)/w$", P("tp", None)),
+    (r".*/(wq|wk|wv|w_gate|w_up)$", P(None, "tp")),
+    (r".*/(wo|w_down)$", P("tp", None)),
+    (r".*/lm_head$", P(None, "tp")),
+]
+
+
+def _path_str(path: Tuple[Any, ...]) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def _fit_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Pad the spec to the leaf's rank and drop axes that don't divide."""
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for dim in range(len(shape)):
+        axis = spec[dim] if dim < len(spec) else None
+        if axis is not None and shape[dim] % axis_sizes.get(axis, 1) != 0:
+            axis = None
+        out.append(axis)
+    return P(*out)
+
+
+def partition_spec_for_path(path_str: str, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    for pattern, spec in _RULES:
+        if re.match(pattern, "/" + path_str):
+            return _fit_spec(spec, shape, mesh)
+    return P()
+
+
+def make_param_shardings(mesh: Mesh, params: Any) -> Any:
+    """Pytree of NamedSharding matching ``params``, rules applied by path."""
+
+    def assign(path, leaf):
+        spec = partition_spec_for_path(_path_str(path), leaf.shape, mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def batch_sharding(mesh: Mesh, seq_axis: bool = False) -> Any:
+    """Sharding for a batch dict: leading dim over dp, optionally dim 1 over sp.
+
+    Every leaf of the zoo's batches is [B, ...] (images, tokens, targets,
+    masks), so one spec fits all leaves; token-model leaves are [B, T] and
+    long-context runs additionally split T over ``sp``.
+    """
+    spec = P("dp", "sp") if seq_axis else P("dp")
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,81 @@
+"""The multi-chip train step: one compiled computation per slice.
+
+Reference parity: the per-worker CUDA ``train_step`` plus NCCL intra-node
+allreduce (BASELINE.json:5) collapse here into a SINGLE ``jax.jit``
+computation over the slice mesh — fwd, bwd, the dp gradient reduction, and
+the optimizer update are all emitted by XLA with ICI collectives placed by
+GSPMD. No hand-written collective calls; the sharding annotations
+(parallel/sharding.py) are the entire parallelism specification.
+
+Host code only touches the result every K steps when the WAN averager
+(swarm/averager.py) ships the slice's params to other volunteers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributedvolunteercomputing_tpu.parallel.sharding import (
+    batch_sharding,
+    make_param_shardings,
+)
+from distributedvolunteercomputing_tpu.training.steps import (
+    Batch,
+    Metrics,
+    TrainState,
+    train_step_body,
+)
+
+
+def shard_train_state(
+    state: TrainState, mesh: Mesh, tx: Any
+) -> Tuple[TrainState, Any]:
+    """Place a host/single-device TrainState onto the mesh.
+
+    Params get their rule-derived shardings; the optimizer state is rebuilt
+    *inside* jit from the sharded params so GSPMD propagates each param's
+    sharding onto its Adam moments (no per-optimizer spec table needed).
+    Returns (sharded_state, param_shardings).
+    """
+    param_shardings = make_param_shardings(mesh, state.params)
+    params = jax.device_put(state.params, param_shardings)
+    replicated = NamedSharding(mesh, P())
+    rng = jax.device_put(state.rng, replicated)
+    step = jax.device_put(state.step, replicated)
+
+    @jax.jit
+    def rebuild(p, rng, step):
+        st = TrainState.create(p, tx, rng)
+        return TrainState(params=st.params, opt_state=st.opt_state, step=step, rng=rng)
+
+    return rebuild(params, rng, step), param_shardings
+
+
+def make_sharded_train_step(
+    loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
+    tx: Any,
+    mesh: Mesh,
+    donate: bool = True,
+    seq_sharded_batch: bool = False,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
+    """Build the jitted sharded ``(state, batch) -> (state, metrics)`` step.
+
+    The batch must be device_put with ``batch_sharding(mesh, ...)`` (leading
+    dim over dp); state via ``shard_train_state``. Gradient reduction across
+    dp is NOT explicit: params are replicated over dp, so XLA emits the psum
+    during backward — the TPU equivalent of the reference's NCCL allreduce.
+    """
+    bspec = batch_sharding(mesh, seq_axis=seq_sharded_batch)
+
+    def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
+        batch = jax.lax.with_sharding_constraint(batch, bspec)
+        return train_step_body(loss_fn, tx, state, batch)
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def put_batch(batch: Batch, mesh: Mesh, seq_sharded: bool = False) -> Batch:
+    return jax.device_put(batch, batch_sharding(mesh, seq_axis=seq_sharded))

@@ -45,17 +45,15 @@ class _Round:
 
     def __init__(self, expected: List[str]):
         self.expected = set(expected)
-        self.contribs: Dict[str, Tuple[float, np.ndarray]] = {}
+        # byzantine: peer -> (weight, buf); sync: (peer, token) -> (weight, buf).
+        self.contribs: Dict[Any, Tuple[float, np.ndarray]] = {}
+        # sync leader sets this to its issued-token table so the early "all
+        # contributions in" check can't be tripped by forged entries.
+        self.tokens: Optional[Dict[str, str]] = None
         self.full = asyncio.Event()
         self.result: Optional[np.ndarray] = None
         self.result_ready = asyncio.Event()
         self.t0 = time.monotonic()
-
-    def add(self, peer: str, weight: float, buf: np.ndarray) -> None:
-        if peer in self.expected:
-            self.contribs[peer] = (weight, buf)
-            if set(self.contribs) >= self.expected:
-                self.full.set()
 
 
 class AveragerBase:
@@ -75,6 +73,7 @@ class AveragerBase:
         join_timeout: float = 10.0,
         method: str = "mean",
         method_kw: Optional[dict] = None,
+        namespace: str = "",
     ):
         self.transport = transport
         self.dht = dht
@@ -87,6 +86,7 @@ class AveragerBase:
         self.join_timeout = join_timeout
         self.method = method
         self.method_kw = method_kw or {}
+        self.namespace = namespace
         self._specs = None
         self._treedef = None
         self._schema: Optional[str] = None
@@ -95,8 +95,15 @@ class AveragerBase:
 
     @property
     def round_key(self) -> str:
-        """Constant rendezvous key per mode — see Matchmaker.form_group."""
-        return f"avg/{self.mode}"
+        """Constant rendezvous key per mode+model — see Matchmaker.form_group.
+
+        The namespace (the model name, set by the Volunteer) keeps volunteers
+        training DIFFERENT models from ever rendezvousing into one group:
+        without it a bert volunteer could join a gpt2 round and every
+        exchange would be a wrong-size buffer.
+        """
+        ns = f"/{self.namespace}" if self.namespace else ""
+        return f"avg/{self.mode}{ns}"
 
     def _sweep_rounds(self, rounds: Dict[str, "_Round"], max_age: Optional[float] = None) -> None:
         """Evict stale round state (parked contributions hold param-sized
@@ -156,6 +163,11 @@ class SyncAverager(AveragerBase):
         self.transport.register("sync.contribute", self._rpc_contribute)
         self.transport.register("sync.fetch", self._rpc_fetch)
 
+    # A round accepts at most this many parked contributions: tokens are only
+    # validated at aggregation time, so without a cap a flooder could park
+    # unbounded param-sized buffers under fabricated (peer, token) pairs.
+    MAX_PARKED_CONTRIBS = 64
+
     async def _rpc_contribute(self, args: dict, payload: bytes):
         if not self._check_schema(args):
             raise RPCError("schema mismatch")
@@ -163,9 +175,22 @@ class SyncAverager(AveragerBase):
         if st is None:
             # Members can push before the leader enters its round: park it.
             st = self._rounds[args["epoch"]] = _Round([])
-        st.contribs[args["peer"]] = (float(args["weight"]), self._buf_from_payload(payload))
-        if st.expected and set(st.contribs) >= st.expected:
-            st.full.set()
+        # Keyed by (peer, token): a push can neither OVERWRITE another entry
+        # (no displacement of an honest contribution by a later forgery) nor
+        # PRE-BLOCK one (an early forgery under peer P doesn't stop P's real
+        # push landing under its correct token). At aggregation the leader
+        # keeps only the entry whose token it actually issued to that peer.
+        key = (args["peer"], args.get("token", ""))
+        if key not in st.contribs and len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
+            raise RPCError("round contribution cap reached")
+        st.contribs[key] = (float(args["weight"]), self._buf_from_payload(payload))
+        if st.expected:
+            valid = {
+                p for p, t in st.contribs
+                if st.tokens is None or st.tokens.get(p) == t
+            }
+            if valid >= st.expected:
+                st.full.set()
         return {"ok": True}, b""
 
     async def _rpc_fetch(self, args: dict, payload: bytes):
@@ -203,9 +228,15 @@ class SyncAverager(AveragerBase):
         if st is None:
             st = self._rounds[group.epoch] = _Round([])
         st.expected = set(member_ids)
-        st.contribs = {p: c for p, c in st.contribs.items() if p in st.expected}
-        st.contribs[self.peer_id] = (weight, buf)
-        if set(st.contribs) >= st.expected:
+        tokens = group.member_tokens or {}
+        st.tokens = tokens
+        # Keep only parked entries under the exact (peer, token) pairs we
+        # issued at begin — everything else is noise or forgery.
+        st.contribs = {
+            (p, t): c for (p, t), c in st.contribs.items() if tokens.get(p) == t
+        }
+        st.contribs[(self.peer_id, group.token)] = (weight, buf)
+        if {p for p, _ in st.contribs} >= st.expected:
             st.full.set()
         try:
             try:
@@ -213,8 +244,14 @@ class SyncAverager(AveragerBase):
             except asyncio.TimeoutError:
                 pass  # aggregate whoever made it
             # Drop contributions whose buffer doesn't match ours (model
-            # mismatch that slipped past the early-accept schema check).
-            good = {p: c for p, c in st.contribs.items() if c[1].size == buf.size}
+            # mismatch that slipped past the early-accept schema check) or
+            # whose token isn't the secret WE issued to that member at begin
+            # — a member cannot submit under another member's identity.
+            good = {
+                p: c
+                for (p, t), c in st.contribs.items()
+                if c[1].size == buf.size and tokens.get(p) == t
+            }
             if len(good) < self.min_group:
                 self.rounds_skipped += 1
                 # Fail members' pending fetches fast, then free the buffers.
@@ -248,6 +285,7 @@ class SyncAverager(AveragerBase):
             "peer": self.peer_id,
             "weight": weight,
             "schema": self._schema,
+            "token": group.token,
         }
         await self.transport.call(
             leader_addr, "sync.contribute", args, buf.tobytes(), timeout=self.gather_timeout
@@ -283,7 +321,10 @@ class GossipAverager(AveragerBase):
         if self._current is None:
             raise RPCError("peer has no params published yet")
         my_w, my_buf = self._current
-        self._inbox.append((float(args["weight"]), self._buf_from_payload(payload)))
+        inbuf = self._buf_from_payload(payload)
+        if inbuf.size != my_buf.size:
+            raise RPCError(f"buffer size {inbuf.size} != local {my_buf.size}")
+        self._inbox.append((float(args["weight"]), inbuf))
         return {"weight": my_w}, my_buf.tobytes()
 
     def _mix(self, w1, b1, w2, b2) -> Tuple[float, np.ndarray]:
@@ -296,11 +337,20 @@ class GossipAverager(AveragerBase):
         # 1. fold in whatever neighbours pushed since last time
         inbox, self._inbox = self._inbox, []
         for iw, ibuf in inbox:
+            if ibuf.size != buf.size:  # banked before our schema changed
+                continue
             w, buf = self._mix(w, buf, iw, ibuf)
         self._current = (w, buf)
-        # 2. push-pull with one random live peer
+        # 2. push-pull with one random live peer — same-model peers only
+        # (gossip has no rendezvous key, so the namespace filter happens here;
+        # records without a model field are accepted for compatibility)
         peers = await self.membership.alive_peers(include_self=False)
-        targets = [(pid, tuple(rec["addr"])) for pid, rec in peers.items() if "addr" in rec]
+        targets = [
+            (pid, tuple(rec["addr"]))
+            for pid, rec in peers.items()
+            if "addr" in rec
+            and (not self.namespace or rec.get("model", self.namespace) == self.namespace)
+        ]
         mixed = bool(inbox)
         if targets:
             pid, addr = self._rng.choice(targets)
@@ -312,7 +362,10 @@ class GossipAverager(AveragerBase):
                     buf.tobytes(),
                     timeout=self.gather_timeout,
                 )
-                w, buf = self._mix(w, buf, float(ret["weight"]), self._buf_from_payload(payload))
+                rbuf = self._buf_from_payload(payload)
+                if rbuf.size != buf.size:
+                    raise RPCError(f"peer buffer size {rbuf.size} != local {buf.size}")
+                w, buf = self._mix(w, buf, float(ret["weight"]), rbuf)
                 self._current = (w, buf)
                 mixed = True
             except (RPCError, OSError, asyncio.TimeoutError) as e:
@@ -370,7 +423,10 @@ class ButterflyAverager(AveragerBase):
         st = self._stage_state(args["epoch"], int(args["stage"]))
         # Wait until the local peer reaches this stage (it may be behind).
         await asyncio.wait_for(st["ready"].wait(), timeout=self.stage_timeout)
-        st["in"] = (float(args["weight"]), self._buf_from_payload(payload))
+        inbuf = self._buf_from_payload(payload)
+        if inbuf.size != st["buf"].size:
+            raise RPCError(f"buffer size {inbuf.size} != local {st['buf'].size}")
+        st["in"] = (float(args["weight"]), inbuf)
         st["done"].set()
         return {"weight": st["w"]}, st["buf"].tobytes()
 
@@ -421,6 +477,8 @@ class ButterflyAverager(AveragerBase):
                 else:
                     await asyncio.wait_for(st["done"].wait(), timeout=self.stage_timeout)
                     pw, pbuf = st["in"]
+                if pbuf.size != buf.size:
+                    raise RPCError(f"partner buffer size {pbuf.size} != local {buf.size}")
                 w, buf = self._mix(w, buf, pw, pbuf)
                 mixed_any = True
             except (RPCError, OSError, asyncio.TimeoutError) as e:
@@ -444,8 +502,11 @@ class ByzantineAverager(AveragerBase):
     independently applies the robust estimator (trimmed mean by default;
     median/krum/geometric_median via ``method=``) to whatever arrived by the
     deadline. A Byzantine peer can send garbage — the estimator bounds its
-    influence — but no single peer can forge the aggregate for others, which
-    a malicious leader could under leader-gather.
+    influence — and, unlike leader-gather, no single peer computes the
+    aggregate for others. Identity limits without a PKI: a contribution can
+    never claim the receiver's own id and can never overwrite an
+    already-received entry (first write wins), so impersonating an honest
+    peer requires beating its first push in a race, per round, per receiver.
     """
 
     mode = "byzantine"
@@ -459,12 +520,22 @@ class ByzantineAverager(AveragerBase):
     async def _rpc_contribute(self, args: dict, payload: bytes):
         if not self._check_schema(args):
             raise RPCError("schema mismatch")
+        peer = args["peer"]
+        # A remote push may never claim OUR identity, and may never REPLACE a
+        # contribution that already arrived (first write wins): with no PKI on
+        # the WAN an attacker can still race an honest peer's first push, but
+        # it cannot overwrite the honest value afterwards — and the robust
+        # estimator bounds whatever single rows it does land.
+        if peer == self.peer_id:
+            raise RPCError("contribution claims receiver's own identity")
         st = self._rounds.get(args["epoch"])
         if st is None:
             # Contribution can arrive before we enter the round: park it.
             st = self._rounds[args["epoch"]] = _Round([])
+        if peer in st.contribs:
+            raise RPCError("duplicate contribution for peer (first write wins)")
         buf = self._buf_from_payload(payload)
-        st.contribs[args["peer"]] = (float(args["weight"]), buf)
+        st.contribs[peer] = (float(args["weight"]), buf)
         if st.expected and set(st.contribs) >= st.expected:
             st.full.set()
         return {"ok": True}, b""

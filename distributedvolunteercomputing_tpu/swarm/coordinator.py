@@ -83,6 +83,7 @@ async def run_coordinator_forever(
     try:
         while True:
             await asyncio.sleep(10.0)
-            _, _ = await coord._rpc_status({}, b"")
+            status, _ = await coord._rpc_status({}, b"")
+            log.info("swarm status: %s", status)
     except asyncio.CancelledError:
         await coord.close()

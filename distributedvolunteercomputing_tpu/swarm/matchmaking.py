@@ -38,6 +38,12 @@ class Group:
     epoch: str
     members: List[Tuple[str, Addr]]  # sorted by peer_id; [0] is the leader
     my_index: int
+    # Leader-issued per-member secret: each member receives ONLY its own in
+    # its private begin message, and echoes it with every contribution, so a
+    # member cannot forge traffic under another member's id (the leader holds
+    # the full table in member_tokens; everyone else sees just their own).
+    token: str = ""
+    member_tokens: Optional[Dict[str, str]] = None
 
     @property
     def leader_id(self) -> str:
@@ -60,7 +66,13 @@ class Matchmaker:
         self.dht = dht
         self.peer_id = peer_id
         self._begin_futures: Dict[str, asyncio.Future] = {}
+        # Begins that arrived while no form_group() was waiting, stamped with
+        # arrival time: consumed only if still fresh (a begin parked after a
+        # round timed out must not leak into the NEXT round as a dead epoch).
+        self._parked_begins: Dict[str, Tuple[float, dict]] = {}
         transport.register("avg.begin", self._rpc_begin)
+
+    PARKED_BEGIN_TTL = 3.0
 
     async def _rpc_begin(self, args: dict, payload: bytes):
         fut = self._begin_futures.get(args["round_key"])
@@ -68,8 +80,7 @@ class Matchmaker:
             fut.set_result(args)
         else:
             # Begin can arrive before our form_group() registers the future.
-            self._begin_futures[args["round_key"]] = done = asyncio.Future()
-            done.set_result(args)
+            self._parked_begins[args["round_key"]] = (time.monotonic(), args)
         return {"ok": True}, b""
 
     @staticmethod
@@ -97,9 +108,17 @@ class Matchmaker:
         my_addr = list(self.transport.addr)
         await self.dht.store(round_key, {"addr": my_addr}, subkey=self.peer_id, ttl=60.0)
 
-        fut = self._begin_futures.get(round_key)
-        if fut is None:
-            fut = self._begin_futures[round_key] = asyncio.Future()
+        # form_group is serial per Matchmaker and always pops its future on
+        # exit, so no prior future can exist here.
+        fut = self._begin_futures[round_key] = asyncio.Future()
+        parked = self._parked_begins.pop(round_key, None)
+        if parked is not None and not fut.done():
+            ts, begin = parked
+            if time.monotonic() - ts <= self.PARKED_BEGIN_TTL:
+                fut.set_result(begin)
+            else:
+                log.info("round %s: dropping stale parked begin (%.1fs old)",
+                         round_key, time.monotonic() - ts)
 
         deadline = time.monotonic() + join_timeout
         members: List[Tuple[str, Addr]] = []
@@ -145,7 +164,12 @@ class Matchmaker:
             return None
         if self.peer_id not in ids:
             return None
-        return Group(epoch=begin["epoch"], members=members, my_index=ids.index(self.peer_id))
+        return Group(
+            epoch=begin["epoch"],
+            members=members,
+            my_index=ids.index(self.peer_id),
+            token=begin.get("token", ""),
+        )
 
     async def _lead(self, round_key: str, members: List[Tuple[str, Addr]]) -> Optional[Group]:
         import uuid
@@ -153,6 +177,8 @@ class Matchmaker:
         ids = [pid for pid, _ in members]
         nonce = uuid.uuid4().hex[:8]
         epoch = self._epoch(round_key, ids, nonce)
+        # One secret per member, delivered only in that member's begin.
+        tokens = {pid: uuid.uuid4().hex for pid in ids}
         begin = {
             "round_key": round_key,
             "epoch": epoch,
@@ -164,10 +190,18 @@ class Matchmaker:
             if pid == self.peer_id:
                 continue
             try:
-                await self.transport.call(addr, "avg.begin", begin, timeout=5.0)
+                await self.transport.call(
+                    addr, "avg.begin", {**begin, "token": tokens[pid]}, timeout=5.0
+                )
                 reached.append(pid)
             except Exception as e:
                 log.warning("round %s: member %s unreachable at begin: %s", round_key, pid, e)
         if not reached:
             return None
-        return Group(epoch=epoch, members=members, my_index=ids.index(self.peer_id))
+        return Group(
+            epoch=epoch,
+            members=members,
+            my_index=ids.index(self.peer_id),
+            token=tokens[self.peer_id],
+            member_tokens=tokens,
+        )

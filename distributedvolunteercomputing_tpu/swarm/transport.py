@@ -34,6 +34,7 @@ VERSION = 1
 TYPE_REQ, TYPE_RESP, TYPE_ERR = 1, 2, 3
 _HEADER = struct.Struct("!2sBBIQI")  # magic, version, type, meta_len, payload_len, payload_crc32
 MAX_PAYLOAD = 2 << 30  # 2 GiB guard
+MAX_META = 4 << 20  # 4 MiB: meta is a small JSON dict, never tensor data
 
 Addr = Tuple[str, int]
 Handler = Callable[[dict, bytes], Awaitable[Tuple[dict, bytes]]]
@@ -100,6 +101,8 @@ class Transport:
             raise RPCError(f"bad frame header: magic={magic!r} version={version}")
         if payload_len > MAX_PAYLOAD:
             raise RPCError(f"payload {payload_len} exceeds {MAX_PAYLOAD}")
+        if meta_len > MAX_META:
+            raise RPCError(f"meta {meta_len} exceeds {MAX_META}")
         meta = json.loads(await reader.readexactly(meta_len)) if meta_len else {}
         payload = await reader.readexactly(payload_len) if payload_len else b""
         if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:

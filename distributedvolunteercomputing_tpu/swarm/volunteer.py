@@ -117,6 +117,8 @@ class Volunteer:
             )
             if self.cfg.averaging == "byzantine" and self.cfg.method != "mean":
                 kw["method"] = self.cfg.method
+            # Namespace rounds by model so mixed swarms never cross-group.
+            kw["namespace"] = self.cfg.model
             self.averager = make_averager(
                 self.cfg.averaging, self.transport, self.dht, self.membership, **kw
             )

@@ -41,6 +41,27 @@ class TrainState:
         )
 
 
+def train_step_body(
+    loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
+    tx: optax.GradientTransformation,
+    state: TrainState,
+    batch: Batch,
+) -> Tuple[TrainState, Metrics]:
+    """The traced step math, shared by the single-device and sharded steps
+    (parallel/train_step.py) so the two paths can never diverge."""
+    rng, step_rng = jax.random.split(state.rng)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    (loss, metrics), grads = grad_fn(state.params, batch, step_rng)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    new_state = TrainState(
+        params=params, opt_state=opt_state, step=state.step + 1, rng=rng
+    )
+    metrics = dict(metrics)
+    metrics["grad_norm"] = optax.global_norm(grads)
+    return new_state, metrics
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
     tx: optax.GradientTransformation,
@@ -49,17 +70,7 @@ def make_train_step(
     """Build the jitted ``(state, batch) -> (state, metrics)`` step."""
 
     def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
-        rng, step_rng = jax.random.split(state.rng)
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, metrics), grads = grad_fn(state.params, batch, step_rng)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(
-            params=params, opt_state=opt_state, step=state.step + 1, rng=rng
-        )
-        metrics = dict(metrics)
-        metrics["grad_norm"] = optax.global_norm(grads)
-        return new_state, metrics
+        return train_step_body(loss_fn, tx, state, batch)
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 

@@ -1,0 +1,114 @@
+"""Sharded train step on the 8-device virtual CPU mesh (SURVEY.md §4).
+
+Validates: mesh construction, TP partition rules by path, divisibility
+fallback, and that a dp x tp sharded step computes the SAME numbers as the
+single-device step — sharding must be a pure performance annotation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributedvolunteercomputing_tpu.models import get_model
+from distributedvolunteercomputing_tpu.parallel import (
+    make_mesh,
+    make_param_shardings,
+    partition_spec_for_path,
+)
+from distributedvolunteercomputing_tpu.parallel.train_step import (
+    make_sharded_train_step,
+    put_batch,
+    shard_train_state,
+)
+from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+
+TINY_GPT2 = dict(vocab=128, max_len=32, d_model=64, n_heads=4, n_layers=2, d_ff=128, remat=False)
+
+
+def test_make_mesh_shapes(eight_devices):
+    mesh = make_mesh(dp=2, sp=1, tp=4)
+    assert mesh.axis_names == ("dp", "sp", "tp")
+    assert mesh.devices.shape == (2, 1, 4)
+    with pytest.raises(ValueError):
+        make_mesh(dp=4, sp=2, tp=4)  # 32 > 8
+
+
+def test_partition_rules(eight_devices):
+    mesh = make_mesh(dp=2, tp=4)
+    # column-parallel
+    assert partition_spec_for_path("blocks/0/qkv/w", (64, 192), mesh) == P(None, "tp")
+    assert partition_spec_for_path("blocks/3/wq", (64, 64), mesh) == P(None, "tp")
+    # row-parallel
+    assert partition_spec_for_path("blocks/0/attn_out/w", (64, 64), mesh) == P("tp", None)
+    assert partition_spec_for_path("blocks/1/w_down", (128, 64), mesh) == P("tp", None)
+    # default replicated
+    assert partition_spec_for_path("wte", (50257, 768), mesh) == P()
+    assert partition_spec_for_path("blocks/0/ln1/g", (64,), mesh) == P()
+
+
+def test_divisibility_fallback(eight_devices):
+    mesh = make_mesh(dp=2, tp=4)
+    # 50257 not divisible by 4 → the tp axis is dropped, not an error
+    assert partition_spec_for_path("lm_head", (64, 50257), mesh) == P(None, None)
+
+
+def test_param_shardings_cover_tree(eight_devices):
+    mesh = make_mesh(dp=2, tp=4)
+    bundle = get_model("gpt2_small", **TINY_GPT2)
+    params = bundle.init(jax.random.PRNGKey(0))
+    shardings = make_param_shardings(mesh, params)
+    qkv = shardings["blocks"][0]["qkv"]["w"]
+    assert qkv.spec == P(None, "tp")
+    assert shardings["wte"].spec == P()
+
+
+@pytest.mark.parametrize("dp,tp", [(8, 1), (2, 4)])
+def test_sharded_step_matches_single_device(eight_devices, dp, tp):
+    bundle = get_model("gpt2_small", **TINY_GPT2)
+    tx = make_optimizer("adam", lr=1e-3)
+    rng = jax.random.PRNGKey(0)
+    params = bundle.init(rng)
+    batch = bundle.make_batch(jax.random.PRNGKey(1), 16)
+
+    # single-device reference
+    ref_state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+    ref_step = make_train_step(bundle.loss_fn, tx, donate=False)
+    ref_state, ref_metrics = ref_step(ref_state, batch)
+
+    mesh = make_mesh(dp=dp, tp=tp)
+    state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+    state, _ = shard_train_state(state, mesh, tx)
+    step = make_sharded_train_step(bundle.loss_fn, tx, mesh, donate=False)
+    sbatch = put_batch(batch, mesh)
+    state, metrics = step(state, sbatch)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-4
+    )
+    # params after one step agree leaf-for-leaf
+    ref_leaf = ref_state.params["blocks"][0]["qkv"]["w"]
+    got_leaf = jax.device_get(state.params["blocks"][0]["qkv"]["w"])
+    np.testing.assert_allclose(got_leaf, np.asarray(ref_leaf), rtol=1e-3, atol=1e-5)
+    # and a second step runs (no recompilation blowups / donation issues)
+    state, metrics2 = step(state, sbatch)
+    assert float(metrics2["loss"]) == float(metrics2["loss"])
+
+
+def test_sharded_step_llama_lora(eight_devices):
+    bundle = get_model(
+        "llama_lora", vocab=256, max_len=32, d_model=64, n_heads=4, n_kv_heads=4,
+        n_layers=2, d_ff=128, lora_rank=4, remat=False,
+    )
+    tx = make_optimizer("adam", lr=1e-3)
+    mesh = make_mesh(dp=2, tp=4)
+    state = TrainState.create(bundle.init(jax.random.PRNGKey(0)), tx, jax.random.PRNGKey(2))
+    state, shardings = shard_train_state(state, mesh, tx)
+    assert shardings["base"]["blocks"][0]["wq"].spec == P(None, "tp")
+    assert shardings["base"]["lm_head"].spec == P(None, "tp")
+    step = make_sharded_train_step(bundle.loss_fn, tx, mesh, donate=False)
+    batch = put_batch(bundle.make_batch(jax.random.PRNGKey(1), 16), mesh)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
