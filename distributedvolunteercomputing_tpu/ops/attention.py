@@ -11,10 +11,46 @@ in bf16, reductions in f32, the standard TPU recipe.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# Attention core selection: "xla" (fused einsum-softmax-einsum), "flash"
+# (pallas kernel, ops/pallas_attention.py), or "auto" (flash on TPU for
+# mask-free sequences long enough to fill a block, xla otherwise).
+_impl = os.environ.get("DVC_ATTN_IMPL", "auto")
+
+
+def set_attention_impl(name: str) -> None:
+    """Select the attention core for subsequent TRACES.
+
+    The impl is read at trace time: computations already jitted (and cached
+    by shape) keep whatever core they were traced with — call this before
+    the first train step, not between steps.
+    """
+    global _impl
+    if name not in ("auto", "xla", "flash"):
+        raise ValueError(f"unknown attention impl {name!r}")
+    _impl = name
+
+
+def get_attention_impl() -> str:
+    return _impl
+
+
+def _route_to_flash(q: jax.Array, k: jax.Array, causal: bool, mask) -> bool:
+    if mask is not None:  # flash path has no additive-mask support
+        return False
+    if causal and q.shape[-2] != k.shape[-2]:
+        # The flash kernel's causal mask is top-left aligned (row i sees keys
+        # 0..i); this XLA core is bottom-right aligned for Tq != Tk. Only the
+        # square case agrees, so rectangular causal always takes the XLA path.
+        return False
+    if _impl == "flash":
+        return True
+    return _impl == "auto" and jax.default_backend() == "tpu" and q.shape[-2] >= 128
 
 
 def attention_core(
@@ -24,6 +60,10 @@ def attention_core(
     causal: bool = False,
     mask: Optional[jax.Array] = None,  # [B, 1|H, Tq, Tk] additive-able bool
 ) -> jax.Array:
+    if _route_to_flash(q, k, causal, mask):
+        from distributedvolunteercomputing_tpu.ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, causal)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:

@@ -1,0 +1,357 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+The reference's hot path is a CUDA ``train_step`` (BASELINE.json:5); its TPU
+equivalent for the transformer zoo is attention that never materialises the
+[Tq, Tk] score matrix in HBM. Forward is a block-wise online-softmax kernel
+(running max / denominator in f32, MXU matmuls in the input dtype); backward
+is the standard two-kernel flash recomputation (dq from k-blocks, dk/dv from
+q-blocks) using the saved logsumexp, wired up through ``jax.custom_vjp``.
+
+On non-TPU backends the kernels run in interpret mode, so the same code path
+is unit-testable on the CPU mesh (tests/conftest.py forces JAX_PLATFORMS=cpu).
+Numerics are validated against ops/attention.py's plain-XLA core in
+tests/test_pallas_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+# Per-row softmax stats (lse, delta) are carried with a broadcast 128-lane
+# trailing dim: Mosaic requires the last block dim to be 128-divisible or
+# full, and a [T]-shaped row vector satisfies neither (same layout as the
+# in-tree jax.experimental.pallas.ops.tpu.flash_attention).
+LANES = 128
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_seq(x: jax.Array, block: int) -> jax.Array:
+    t = x.shape[2]
+    pad = (-t) % block
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k, tk_valid):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, D]
+    tk_padded = k_ref.shape[2]
+    n_kblocks = tk_padded // block_k
+
+    if causal:
+        # Rows in this q block see keys up to (iq+1)*bq - 1; later k blocks
+        # are entirely masked, so don't visit them at all.
+        n_kblocks = jnp.minimum(n_kblocks, pl.cdiv((iq + 1) * block_q, block_k))
+
+    row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = col < tk_valid
+        if causal:
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q_ref.shape[3]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l_safe), (block_q, LANES))
+
+
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+    block_q: int, block_k: int, interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq, bk = min(block_q, tq), min(block_k, tk)
+    scale = 1.0 / (d ** 0.5)
+
+    qp, kp, vp = _pad_seq(q, bq), _pad_seq(k, bk), _pad_seq(v, bk)
+    tq_p, tk_p = qp.shape[2], kp.shape[2]
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk, tk_valid=tk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, tq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, iq: (i, j, iq, 0)),
+            pl.BlockSpec((1, 1, tk_p, d), lambda i, j, iq: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, tk_p, d), lambda i, j, iq: (i, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, iq: (i, j, iq, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda i, j, iq: (i, j, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq_p, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :tq], lse[:, :, :tq, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward: dq kernel (iterates k blocks) and dkv kernel (iterates q blocks)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, scale, causal, block_q, block_k, tk_valid,
+):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, 0:1]
+    delta = delta_ref[0, 0][:, 0:1]
+    tk_padded = k_ref.shape[2]
+    n_kblocks = tk_padded // block_k
+    if causal:
+        n_kblocks = jnp.minimum(n_kblocks, pl.cdiv((iq + 1) * block_q, block_k))
+
+    row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq):
+        kblk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = col < tk_valid
+        if causal:
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(
+        0, n_kblocks, body, jnp.zeros((block_q, q_ref.shape[3]), jnp.float32)
+    )
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, causal, block_q, block_k, tk_valid,
+):
+    jk = pl.program_id(2)
+    kblk = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+    vblk = v_ref[0, 0].astype(jnp.float32)
+    tq_padded = q_ref.shape[2]
+    n_qblocks = tq_padded // block_q
+    # Causal: q blocks strictly before this k block's first row see nothing.
+    start = (jk * block_k) // block_q if causal else 0
+
+    col = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    col_valid = col < tk_valid
+
+    def body(i, carry):
+        dk, dv = carry
+        qblk = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        doblk = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0:1]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0:1]
+        s = jax.lax.dot_general(
+            qblk, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        mask = col_valid
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, doblk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            doblk, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, qblk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    d = q_ref.shape[3]
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_qblocks, body, (dk0, dv0))
+    # q already carried `scale`, so ds.T @ (q*scale) is the full dk.
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    causal: bool, block_q: int, block_k: int, interpret: bool,
+    residuals, g,
+):
+    q, k, v, out, lse = residuals
+    do = g
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq, bk = min(block_q, tq), min(block_k, tk)
+    scale = 1.0 / (d ** 0.5)
+
+    # delta_i = sum_d dO_i O_i — the softmax-jacobian diagonal term.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qp, kp, vp = _pad_seq(q, bq), _pad_seq(k, bk), _pad_seq(v, bk)
+    dop = _pad_seq(do, bq)
+    tq_p, tk_p = qp.shape[2], kp.shape[2]
+    pad_q = tq_p - tq
+    if pad_q:
+        # Padded q rows must not contribute to dk/dv: exp(NEG_INF - 0) would
+        # be 1, so give them lse=+large instead so p == 0 exactly.
+        lse_p = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q)), constant_values=1e30)
+        delta_p = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q)))
+    else:
+        lse_p, delta_p = lse, delta
+    lse_p = jnp.broadcast_to(lse_p[..., None], (*lse_p.shape, LANES))
+    delta_p = jnp.broadcast_to(delta_p[..., None], (*delta_p.shape, LANES))
+
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda i, j, g_: (i, j, g_, 0))
+    kfull = pl.BlockSpec((1, 1, tk_p, d), lambda i, j, g_: (i, j, 0, 0))
+    qfull = pl.BlockSpec((1, 1, tq_p, d), lambda i, j, g_: (i, j, 0, 0))
+    vecq = pl.BlockSpec((1, 1, bq, LANES), lambda i, j, g_: (i, j, g_, 0))
+    vecq_full = pl.BlockSpec((1, 1, tq_p, LANES), lambda i, j, g_: (i, j, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, tk_valid=tk,
+        ),
+        grid=(b, h, tq_p // bq),
+        in_specs=[qspec, kfull, kfull, qspec, vecq, vecq],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, tq_p, d), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    kspec = pl.BlockSpec((1, 1, bk, d), lambda i, j, g_: (i, j, g_, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, tk_valid=tk,
+        ),
+        grid=(b, h, tk_p // bk),
+        in_specs=[qfull, kspec, kspec, qfull, vecq_full, vecq_full],
+        out_specs=[kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, tk_p, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    return dq[:, :, :tq], dk[:, :, :tk], dv[:, :, :tk]
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array,  # [B, H, Tq, D]
+    k: jax.Array,  # [B, H, Tk, D]
+    v: jax.Array,  # [B, H, Tk, D]
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in for ops.attention.attention_core (no additive mask support).
+
+    Causal masking is top-left aligned: row i attends keys 0..i. For
+    Tq != Tk this differs from attention_core's bottom-right alignment —
+    the router in ops/attention.py only sends square causal shapes here.
+    """
+    out, _ = _flash_forward(
+        q, k, v, causal, block_q, block_k,
+        _interpret_default() if interpret is None else interpret,
+    )
+    return out
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(
+        q, k, v, causal, block_q, block_k,
+        _interpret_default() if interpret is None else interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, residuals, g):
+    return _flash_backward(
+        causal, block_q, block_k,
+        _interpret_default() if interpret is None else interpret,
+        residuals, g,
+    )
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_mha(
+    q: jax.Array,  # [B, T, d_model] (already projected)
+    k: jax.Array,
+    v: jax.Array,
+    n_heads: int,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Multi-head wrapper matching ops.attention.multi_head_attention."""
+    from distributedvolunteercomputing_tpu.ops.attention import merge_heads, split_heads
+
+    out = flash_attention(
+        split_heads(q, n_heads), split_heads(k, n_heads), split_heads(v, n_heads),
+        causal, block_q, block_k,
+    )
+    return merge_heads(out)

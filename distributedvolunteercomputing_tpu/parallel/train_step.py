@@ -30,28 +30,59 @@ from distributedvolunteercomputing_tpu.training.steps import (
 )
 
 
+def _shard_opt_state_like_params(
+    opt_state: Any, param_shardings: Any, params_treedef: Any, replicated: Any
+) -> Any:
+    """Place optimizer state on the mesh, preserving its VALUES.
+
+    Optax states (e.g. Adam's mu/nu) embed whole params-shaped pytrees;
+    any subtree whose treedef equals the params' gets the params' per-leaf
+    shardings, everything else (step counts, scalars) is replicated. This
+    keeps a warm/restored optimizer state intact — re-initialising via
+    tx.init would silently zero the moments on resume.
+    """
+
+    def rec(node):
+        if jax.tree_util.tree_structure(node) == params_treedef:
+            return jax.tree_util.tree_map(jax.device_put, node, param_shardings)
+        if isinstance(node, tuple):  # optax states are (named)tuples
+            out = [rec(c) for c in node]
+            return type(node)(*out) if hasattr(node, "_fields") else tuple(out)
+        if isinstance(node, list):
+            return [rec(c) for c in node]
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if node is None:
+            return None
+        return jax.device_put(node, replicated)
+
+    return rec(opt_state)
+
+
 def shard_train_state(
-    state: TrainState, mesh: Mesh, tx: Any
+    state: TrainState, mesh: Mesh, tx: Any = None
 ) -> Tuple[TrainState, Any]:
     """Place a host/single-device TrainState onto the mesh.
 
-    Params get their rule-derived shardings; the optimizer state is rebuilt
-    *inside* jit from the sharded params so GSPMD propagates each param's
-    sharding onto its Adam moments (no per-optimizer spec table needed).
-    Returns (sharded_state, param_shardings).
+    Params get their rule-derived shardings; the optimizer state keeps its
+    values (warm moments survive a resume) with params-shaped subtrees
+    sharded exactly like their params. ``tx`` is unused and kept for
+    call-site compatibility. Returns (sharded_state, param_shardings).
     """
     param_shardings = make_param_shardings(mesh, state.params)
-    params = jax.device_put(state.params, param_shardings)
+    params_treedef = jax.tree_util.tree_structure(state.params)
     replicated = NamedSharding(mesh, P())
-    rng = jax.device_put(state.rng, replicated)
-    step = jax.device_put(state.step, replicated)
-
-    @jax.jit
-    def rebuild(p, rng, step):
-        st = TrainState.create(p, tx, rng)
-        return TrainState(params=st.params, opt_state=st.opt_state, step=step, rng=rng)
-
-    return rebuild(params, rng, step), param_shardings
+    return (
+        TrainState(
+            params=jax.device_put(state.params, param_shardings),
+            opt_state=_shard_opt_state_like_params(
+                state.opt_state, param_shardings, params_treedef, replicated
+            ),
+            step=jax.device_put(state.step, replicated),
+            rng=jax.device_put(state.rng, replicated),
+        ),
+        param_shardings,
+    )
 
 
 def make_sharded_train_step(

@@ -105,6 +105,12 @@ class AveragerBase:
         ns = f"/{self.namespace}" if self.namespace else ""
         return f"avg/{self.mode}{ns}"
 
+    # Distinct epochs a remote peer can allocate round state under between
+    # our own average() calls. Combined with MAX_PARKED_CONTRIBS this bounds
+    # attacker-driven memory to ROUNDS x CONTRIBS x payload even if the local
+    # trainer never averages again.
+    MAX_PARKED_ROUNDS = 32
+
     def _sweep_rounds(self, rounds: Dict[str, "_Round"], max_age: Optional[float] = None) -> None:
         """Evict stale round state (parked contributions hold param-sized
         buffers; a round nobody finishes must not leak them)."""
@@ -113,6 +119,22 @@ class AveragerBase:
         now = time.monotonic()
         for epoch in [e for e, st in rounds.items() if now - st.t0 > max_age]:
             del rounds[epoch]
+
+    def _get_or_park_round(self, rounds: Dict[str, "_Round"], epoch: str) -> "_Round":
+        """Round state for a remote-initiated epoch, swept + capped.
+
+        Contributions can legitimately arrive before the local peer enters
+        the round; but every unknown epoch string allocates a fresh _Round,
+        so sweep on each RPC (not only in average()) and refuse once the
+        number of remotely-created rounds hits the cap."""
+        st = rounds.get(epoch)
+        if st is None:
+            self._sweep_rounds(rounds)
+            parked = sum(1 for s in rounds.values() if not s.expected)
+            if parked >= self.MAX_PARKED_ROUNDS:
+                raise RPCError("parked round cap reached")
+            st = rounds[epoch] = _Round([])
+        return st
 
     # -- packing -----------------------------------------------------------
 
@@ -171,16 +193,21 @@ class SyncAverager(AveragerBase):
     async def _rpc_contribute(self, args: dict, payload: bytes):
         if not self._check_schema(args):
             raise RPCError("schema mismatch")
-        st = self._rounds.get(args["epoch"])
-        if st is None:
-            # Members can push before the leader enters its round: park it.
-            st = self._rounds[args["epoch"]] = _Round([])
+        # Members can push before the leader enters its round: park it
+        # (swept + capped against fabricated-epoch flooding).
+        st = self._get_or_park_round(self._rounds, args["epoch"])
         # Keyed by (peer, token): a push can neither OVERWRITE another entry
         # (no displacement of an honest contribution by a later forgery) nor
         # PRE-BLOCK one (an early forgery under peer P doesn't stop P's real
         # push landing under its correct token). At aggregation the leader
         # keeps only the entry whose token it actually issued to that peer.
         key = (args["peer"], args.get("token", ""))
+        if st.tokens is not None and st.tokens.get(key[0]) != key[1]:
+            # Leader has entered the round, so the issued-token table is
+            # known: reject forgeries OUTRIGHT rather than parking them —
+            # otherwise 64 fabricated keys fill the cap and pre-block every
+            # honest push for the rest of the round.
+            raise RPCError("invalid contribution token for this round")
         if key not in st.contribs and len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
             raise RPCError("round contribution cap reached")
         st.contribs[key] = (float(args["weight"]), self._buf_from_payload(payload))
@@ -528,10 +555,9 @@ class ByzantineAverager(AveragerBase):
         # estimator bounds whatever single rows it does land.
         if peer == self.peer_id:
             raise RPCError("contribution claims receiver's own identity")
-        st = self._rounds.get(args["epoch"])
-        if st is None:
-            # Contribution can arrive before we enter the round: park it.
-            st = self._rounds[args["epoch"]] = _Round([])
+        # Contribution can arrive before we enter the round: park it
+        # (swept + capped against fabricated-epoch flooding).
+        st = self._get_or_park_round(self._rounds, args["epoch"])
         if peer in st.contribs:
             raise RPCError("duplicate contribution for peer (first write wins)")
         buf = self._buf_from_payload(payload)

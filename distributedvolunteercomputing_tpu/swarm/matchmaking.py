@@ -27,7 +27,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
-from distributedvolunteercomputing_tpu.swarm.transport import Addr, Transport
+from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -73,6 +73,10 @@ class Matchmaker:
         transport.register("avg.begin", self._rpc_begin)
 
     PARKED_BEGIN_TTL = 3.0
+    # Distinct round_keys a remote peer can park begins under; entries are
+    # also swept by TTL on every begin RPC, so keys that never reach a
+    # form_group() cannot accumulate for the process lifetime.
+    MAX_PARKED_BEGINS = 64
 
     async def _rpc_begin(self, args: dict, payload: bytes):
         fut = self._begin_futures.get(args["round_key"])
@@ -80,7 +84,18 @@ class Matchmaker:
             fut.set_result(args)
         else:
             # Begin can arrive before our form_group() registers the future.
-            self._parked_begins[args["round_key"]] = (time.monotonic(), args)
+            now = time.monotonic()
+            for k in [
+                k for k, (ts, _) in self._parked_begins.items()
+                if now - ts > self.PARKED_BEGIN_TTL
+            ]:
+                del self._parked_begins[k]
+            if (
+                args["round_key"] not in self._parked_begins
+                and len(self._parked_begins) >= self.MAX_PARKED_BEGINS
+            ):
+                raise RPCError("parked begin cap reached")
+            self._parked_begins[args["round_key"]] = (now, args)
         return {"ok": True}, b""
 
     @staticmethod

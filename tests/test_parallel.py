@@ -112,3 +112,31 @@ def test_sharded_step_llama_lora(eight_devices):
     batch = put_batch(bundle.make_batch(jax.random.PRNGKey(1), 16), mesh)
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_shard_train_state_preserves_warm_opt_state(eight_devices):
+    # A checkpoint-resumed state has non-zero Adam moments; placing it on the
+    # mesh must keep their VALUES (re-initialising would silently cold-start
+    # the optimizer while keeping step/rng — a loss spike with no error).
+    bundle = get_model("gpt2_small", **TINY_GPT2)
+    tx = make_optimizer("adam", lr=1e-2)
+    state = TrainState.create(bundle.init(jax.random.PRNGKey(0)), tx, jax.random.PRNGKey(1))
+    step1 = make_train_step(bundle.loss_fn, tx, donate=False)
+    batch = bundle.make_batch(jax.random.PRNGKey(2), 4)
+    for _ in range(2):
+        state, _ = step1(state, batch)
+
+    warm_flat = [np.asarray(x) for x in jax.tree_util.tree_leaves(state.opt_state)]
+    assert any(np.abs(x).max() > 0 for x in warm_flat if x.ndim > 0)
+
+    mesh = make_mesh(dp=4, tp=2)
+    sharded, shardings = shard_train_state(state, mesh, tx)
+    for before, after in zip(warm_flat, jax.tree_util.tree_leaves(sharded.opt_state)):
+        np.testing.assert_array_equal(before, np.asarray(after))
+    assert int(sharded.step) == 2
+    # params-shaped moment subtrees carry the params' shardings
+    mu = jax.tree_util.tree_leaves(sharded.opt_state)[1]
+    step2 = make_sharded_train_step(bundle.loss_fn, tx, mesh)
+    with mesh:
+        sharded, m = step2(sharded, put_batch(batch, mesh))
+    assert np.isfinite(float(m["loss"]))
