@@ -11,11 +11,29 @@ in bf16, reductions in f32, the standard TPU recipe.
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# Active sequence-parallel context: (mesh, axis_name) or None. When set, the
+# attention core routes to ring attention (parallel/ring_attention.py) so the
+# model code is unchanged between single-device and sp-sharded runs. Set by
+# make_sharded_train_step at TRACE time (it wraps the step body), or manually.
+_seq_ctx = None
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh, axis: str = "sp"):
+    global _seq_ctx
+    prev = _seq_ctx
+    _seq_ctx = (mesh, axis)
+    try:
+        yield
+    finally:
+        _seq_ctx = prev
 
 # Attention core selection: "xla" (fused einsum-softmax-einsum), "flash"
 # (pallas kernel, ops/pallas_attention.py), or "auto" (flash on TPU for
@@ -60,6 +78,11 @@ def attention_core(
     causal: bool = False,
     mask: Optional[jax.Array] = None,  # [B, 1|H, Tq, Tk] additive-able bool
 ) -> jax.Array:
+    if _seq_ctx is not None and mask is None and q.shape[-2] == k.shape[-2]:
+        from distributedvolunteercomputing_tpu.parallel.ring_attention import ring_attention_bhtd
+
+        mesh, axis = _seq_ctx
+        return ring_attention_bhtd(q, k, v, mesh, axis, causal)
     if _route_to_flash(q, k, causal, mask):
         from distributedvolunteercomputing_tpu.ops.pallas_attention import flash_attention
 

@@ -10,6 +10,8 @@ volunteer slices; this package handles everything inside one slice:
                    transformer zoo) and batch specs
 - ``train_step`` — the sharded train step: fwd/bwd/update in ONE compiled
                    computation, gradient reduction over dp emitted by XLA
+- ``ring_attention`` — sequence-parallel exact attention over the sp axis
+                   (ppermute ring; long-context path)
 """
 
 from distributedvolunteercomputing_tpu.parallel.mesh import make_mesh
@@ -17,6 +19,10 @@ from distributedvolunteercomputing_tpu.parallel.sharding import (
     batch_sharding,
     make_param_shardings,
     partition_spec_for_path,
+)
+from distributedvolunteercomputing_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_bhtd,
 )
 from distributedvolunteercomputing_tpu.parallel.train_step import (
     make_sharded_train_step,
@@ -30,4 +36,6 @@ __all__ = [
     "partition_spec_for_path",
     "make_sharded_train_step",
     "shard_train_state",
+    "ring_attention",
+    "ring_attention_bhtd",
 ]

@@ -98,11 +98,23 @@ def make_sharded_train_step(
     dim over dp); state via ``shard_train_state``. Gradient reduction across
     dp is NOT explicit: params are replicated over dp, so XLA emits the psum
     during backward — the TPU equivalent of the reference's NCCL allreduce.
+
+    With ``seq_sharded_batch`` and an ``sp`` mesh axis of size > 1, the step
+    body is traced under the sequence-parallel context, so every attention in
+    the model routes to ring attention (parallel/ring_attention.py) over sp.
     """
     bspec = batch_sharding(mesh, seq_axis=seq_sharded_batch)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    use_ring = seq_sharded_batch and axis_sizes.get("sp", 1) > 1
 
     def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
         batch = jax.lax.with_sharding_constraint(batch, bspec)
+        if use_ring:
+            # Context is consulted at trace time — this body IS the trace.
+            from distributedvolunteercomputing_tpu.ops.attention import sequence_parallel
+
+            with sequence_parallel(mesh, "sp"):
+                return train_step_body(loss_fn, tx, state, batch)
         return train_step_body(loss_fn, tx, state, batch)
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
