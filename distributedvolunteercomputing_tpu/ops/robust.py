@@ -33,7 +33,17 @@ def mean(stack: np.ndarray, weights: Optional[np.ndarray] = None) -> np.ndarray:
     return (stack * w[:, None].astype(stack.dtype)).sum(axis=0)
 
 
+# Below this size the numpy paths win (thread spawn isn't free); above it the
+# native threaded column-sort beats numpy's full-matrix sort ~2x.
+_NATIVE_CUTOFF = 1 << 16
+
+
 def coordinate_median(stack: np.ndarray) -> np.ndarray:
+    if stack.dtype == np.float32 and stack.size >= _NATIVE_CUTOFF:
+        from distributedvolunteercomputing_tpu import native
+
+        if native.available():
+            return native.coordinate_median(np.ascontiguousarray(stack))
     return np.median(stack, axis=0).astype(stack.dtype)
 
 
@@ -41,6 +51,11 @@ def trimmed_mean(stack: np.ndarray, trim: int = 1) -> np.ndarray:
     n = stack.shape[0]
     if 2 * trim >= n:
         raise ValueError(f"trim={trim} too large for n={n}")
+    if stack.dtype == np.float32 and stack.size >= _NATIVE_CUTOFF:
+        from distributedvolunteercomputing_tpu import native
+
+        if native.available():
+            return native.trimmed_mean(np.ascontiguousarray(stack), trim)
     srt = np.sort(stack, axis=0)
     return srt[trim : n - trim].mean(axis=0)
 

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from distributedvolunteercomputing_tpu import native
 from distributedvolunteercomputing_tpu.ops import robust
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.matchmaking import Group, Matchmaker
@@ -74,7 +75,10 @@ class AveragerBase:
         method: str = "mean",
         method_kw: Optional[dict] = None,
         namespace: str = "",
+        wire: str = "f32",
     ):
+        if wire not in ("f32", "bf16"):
+            raise ValueError(f"unknown wire dtype {wire!r}")
         self.transport = transport
         self.dht = dht
         self.membership = membership
@@ -87,6 +91,12 @@ class AveragerBase:
         self.method = method
         self.method_kw = method_kw or {}
         self.namespace = namespace
+        # Wire codec for WAN payloads: "bf16" halves DCN traffic (the
+        # averaging round's dominant cost at param scale) at bf16 rounding
+        # error — acceptable for PARAMETER averaging in this genre. Part of
+        # the schema hash, so mixed-wire swarms reject each other's rounds
+        # instead of mis-decoding bytes.
+        self.wire = wire
         self._specs = None
         self._treedef = None
         self._schema: Optional[str] = None
@@ -143,7 +153,7 @@ class AveragerBase:
         if self._schema is None:
             self._specs, self._treedef = specs, treedef
             self._schema = hashlib.sha1(
-                repr([(s.shape, s.dtype) for s in specs]).encode()
+                repr([(s.shape, s.dtype) for s in specs] + [self.wire]).encode()
             ).hexdigest()[:16]
         return buf
 
@@ -156,7 +166,23 @@ class AveragerBase:
         # early-arriving contribution from a faster peer is normal).
         return self._schema is None or args.get("schema") == self._schema
 
+    def _to_wire(self, buf: np.ndarray) -> bytes:
+        if self.wire == "bf16":
+            return native.f32_to_bf16(buf).tobytes()
+        return buf.tobytes()
+
+    def _wire_roundtrip(self, buf: np.ndarray) -> np.ndarray:
+        """The local buffer as PEERS see it after the wire codec. Pairwise
+        protocols (butterfly) mix this instead of the raw f32 buffer so both
+        sides of a pair operate on identical inputs; idempotent (a bf16
+        round-trip of bf16-representable values is exact)."""
+        if self.wire == "bf16":
+            return native.bf16_to_f32(native.f32_to_bf16(buf))
+        return buf
+
     def _buf_from_payload(self, payload: bytes) -> np.ndarray:
+        if self.wire == "bf16":
+            return native.bf16_to_f32(np.frombuffer(payload, np.uint16))
         return np.frombuffer(payload, np.float32).copy()
 
     # -- public API --------------------------------------------------------
@@ -229,7 +255,7 @@ class SyncAverager(AveragerBase):
         await asyncio.wait_for(st.result_ready.wait(), timeout=self.gather_timeout + 3.0)
         if st.result is None:
             raise RPCError("round skipped by leader (too few contributions)")
-        return {"ok": True}, st.result.tobytes()
+        return {"ok": True}, self._to_wire(st.result)
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
@@ -288,12 +314,18 @@ class SyncAverager(AveragerBase):
                 )
                 return None
             peers = sorted(good)
-            stack = np.stack([good[p][1] for p in peers])
-            weights = np.array([good[p][0] for p in peers])
-            kw = dict(self.method_kw)
             if self.method == "mean":
-                kw["weights"] = weights
-            st.result = robust.aggregate(stack, self.method, **kw)
+                # Streaming weighted accumulation (native axpy when built):
+                # no [n_peers, D] stack copy for the common path.
+                total_w = float(sum(good[p][0] for p in peers))
+                acc = np.zeros(buf.size, np.float32)
+                for p in peers:
+                    w_p, buf_p = good[p]
+                    native.weighted_sum_inplace(acc, buf_p, w_p / total_w)
+                st.result = acc
+            else:
+                stack = np.stack([good[p][1] for p in peers])
+                st.result = robust.aggregate(stack, self.method, **dict(self.method_kw))
             st.result_ready.set()
             self.rounds_ok += 1
             # Keep state around long enough for members to fetch.
@@ -315,7 +347,7 @@ class SyncAverager(AveragerBase):
             "token": group.token,
         }
         await self.transport.call(
-            leader_addr, "sync.contribute", args, buf.tobytes(), timeout=self.gather_timeout
+            leader_addr, "sync.contribute", args, self._to_wire(buf), timeout=self.gather_timeout
         )
         _, payload = await self.transport.call(
             leader_addr, "sync.fetch", {"epoch": group.epoch}, timeout=self.gather_timeout + 6.0
@@ -352,7 +384,7 @@ class GossipAverager(AveragerBase):
         if inbuf.size != my_buf.size:
             raise RPCError(f"buffer size {inbuf.size} != local {my_buf.size}")
         self._inbox.append((float(args["weight"]), inbuf))
-        return {"weight": my_w}, my_buf.tobytes()
+        return {"weight": my_w}, self._to_wire(my_buf)
 
     def _mix(self, w1, b1, w2, b2) -> Tuple[float, np.ndarray]:
         total = w1 + w2
@@ -386,7 +418,7 @@ class GossipAverager(AveragerBase):
                     addr,
                     "gossip.exchange",
                     {"peer": self.peer_id, "weight": w, "schema": self._schema},
-                    buf.tobytes(),
+                    self._to_wire(buf),
                     timeout=self.gather_timeout,
                 )
                 rbuf = self._buf_from_payload(payload)
@@ -455,13 +487,16 @@ class ButterflyAverager(AveragerBase):
             raise RPCError(f"buffer size {inbuf.size} != local {st['buf'].size}")
         st["in"] = (float(args["weight"]), inbuf)
         st["done"].set()
-        return {"weight": st["w"]}, st["buf"].tobytes()
+        return {"weight": st["w"]}, self._to_wire(st["buf"])
 
     @staticmethod
     def _mix(w1: float, b1: np.ndarray, w2: float, b2: np.ndarray) -> Tuple[float, np.ndarray]:
         total = w1 + w2
         # Same expression on both sides of the pair -> bitwise-identical
         # results (float + and * are commutative), so the pair stays in sync.
+        # With wire=bf16 this holds because average() round-trips the LOCAL
+        # buffer through the codec before mixing — each side mixes the same
+        # (quantized-mine, quantized-theirs) pair.
         return total, (b1 * (w1 / total) + b2 * (w2 / total))
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
@@ -482,6 +517,7 @@ class ButterflyAverager(AveragerBase):
             if partner_idx >= n:
                 continue
             partner_id, partner_addr = group.members[partner_idx]
+            buf = self._wire_roundtrip(buf)
             st = self._stage_state(group.epoch, s)
             st["buf"], st["w"] = buf, w
             st["ready"].set()
@@ -497,7 +533,7 @@ class ButterflyAverager(AveragerBase):
                             "weight": w,
                             "schema": self._schema,
                         },
-                        buf.tobytes(),
+                        self._to_wire(buf),
                         timeout=self.stage_timeout,
                     )
                     pw, pbuf = float(ret["weight"]), self._buf_from_payload(payload)
@@ -593,7 +629,7 @@ class ByzantineAverager(AveragerBase):
         async def push(addr):
             try:
                 await self.transport.call(
-                    addr, "byz.contribute", args, buf.tobytes(), timeout=self.gather_timeout
+                    addr, "byz.contribute", args, self._to_wire(buf), timeout=self.gather_timeout
                 )
             except (RPCError, OSError, asyncio.TimeoutError) as e:
                 log.info("byz push to %s failed: %s", addr, e)

@@ -44,6 +44,7 @@ class VolunteerConfig:
     peer_id: str = ""
     averaging: str = "none"  # none|sync|gossip|butterfly|byzantine
     average_every: int = 10
+    wire: str = "f32"  # f32|bf16 — WAN payload codec (bf16 halves DCN bytes)
     min_group: int = 2
     max_group: int = 16
     batch_size: int = 32
@@ -114,6 +115,7 @@ class Volunteer:
                 max_group=self.cfg.max_group,
                 join_timeout=self.cfg.join_timeout,
                 gather_timeout=self.cfg.gather_timeout,
+                wire=self.cfg.wire,
             )
             if self.cfg.averaging == "byzantine" and self.cfg.method != "mean":
                 kw["method"] = self.cfg.method

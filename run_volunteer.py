@@ -42,6 +42,8 @@ def main() -> None:
     ap.add_argument("--averaging", default="none",
                     choices=["none", "sync", "gossip", "butterfly", "byzantine"])
     ap.add_argument("--average-every", type=int, default=10)
+    ap.add_argument("--wire", default="f32", choices=("f32", "bf16"),
+                    help="WAN payload codec; bf16 halves DCN traffic")
     ap.add_argument("--min-group", type=int, default=2)
     ap.add_argument("--max-group", type=int, default=16)
     ap.add_argument("--method", default="trimmed_mean",
@@ -76,6 +78,7 @@ def main() -> None:
         peer_id=args.peer_id,
         averaging=args.averaging,
         average_every=args.average_every,
+        wire=args.wire,
         min_group=args.min_group,
         max_group=args.max_group,
         method=args.method,
@@ -91,6 +94,13 @@ def main() -> None:
         join_timeout=args.join_timeout,
         gather_timeout=args.gather_timeout,
     )
+    if cfg.averaging != "none":
+        # Build/load the native host core BEFORE the event loop exists: the
+        # lazy path builds on a background thread, but a volunteer should
+        # start its first round with the library already warm.
+        from distributedvolunteercomputing_tpu import native
+
+        native.ensure_built()
     summary = run_volunteer(cfg)
     print("VOLUNTEER_DONE " + json.dumps(summary), flush=True)
 

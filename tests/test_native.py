@@ -1,0 +1,142 @@
+"""Native C++ host core: build, numerics vs numpy/zlib, and the bf16 wire
+path end-to-end through a 2-volunteer sync averaging round."""
+
+import asyncio
+import zlib
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not native.ensure_built():
+        pytest.skip("no C++ toolchain in this environment")
+    return native.get_lib()
+
+
+def test_crc32_cross_implementation(lib):
+    rng = np.random.default_rng(0)
+    # (4<<20)+21 exercises the THREADED path (>= 2 MiB) and its GF(2)
+    # chunk-combine — the subtlest code in the library.
+    for size in (0, 1, 7, 8, 1000, (1 << 20) + 13, (4 << 20) + 21):
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        assert native.crc32_native(data) == (zlib.crc32(data) & 0xFFFFFFFF)
+        assert native.crc32_native(data, 99) == (zlib.crc32(data, 99) & 0xFFFFFFFF)
+
+
+def test_bf16_codec_matches_ml_dtypes(lib):
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    x = np.concatenate(
+        [
+            rng.standard_normal(4096).astype(np.float32),
+            np.array([0.0, -0.0, np.inf, -np.inf, 1e-40, 3.4e38], np.float32),
+        ]
+    )
+    bits = native.f32_to_bf16(x)
+    ref = x.astype(ml_dtypes.bfloat16).view(np.uint16)
+    np.testing.assert_array_equal(bits, ref)
+    back = native.bf16_to_f32(bits)
+    np.testing.assert_array_equal(back, ref.view(ml_dtypes.bfloat16).astype(np.float32))
+
+
+def test_bf16_codec_nan(lib):
+    x = np.array([np.nan], np.float32)
+    back = native.bf16_to_f32(native.f32_to_bf16(x))
+    assert np.isnan(back[0])
+
+
+def test_robust_reduce_matches_numpy(lib):
+    rng = np.random.default_rng(2)
+    for n_peers in (3, 4, 8):
+        stack = rng.standard_normal((n_peers, 70000)).astype(np.float32)
+        np.testing.assert_allclose(
+            native.coordinate_median(stack), np.median(stack, axis=0), rtol=1e-6, atol=1e-7
+        )
+        srt = np.sort(stack, axis=0)
+        np.testing.assert_allclose(
+            native.trimmed_mean(stack, 1), srt[1 : n_peers - 1].mean(axis=0),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_weighted_sum(lib):
+    rng = np.random.default_rng(3)
+    acc = rng.standard_normal(50000).astype(np.float32)
+    x = rng.standard_normal(50000).astype(np.float32)
+    ref = acc + np.float32(0.25) * x
+    native.weighted_sum_inplace(acc, x, 0.25)
+    np.testing.assert_allclose(acc, ref, rtol=1e-6)
+
+
+def test_robust_ops_route_through_native(lib):
+    from distributedvolunteercomputing_tpu.ops import robust
+
+    rng = np.random.default_rng(4)
+    stack = rng.standard_normal((5, 100000)).astype(np.float32)
+    np.testing.assert_allclose(
+        robust.coordinate_median(stack), np.median(stack, axis=0), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_bf16_wire_end_to_end():
+    """Two volunteers average over localhost with the bf16 wire codec; the
+    result must be the true mean to bf16 rounding tolerance."""
+    from tests.test_averaging import make_tree, spawn_volunteers, teardown
+
+    from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+
+    async def scenario():
+        vols = await spawn_volunteers(2, SyncAverager, wire="bf16")
+        try:
+            r = await asyncio.gather(
+                vols[0][3].average(make_tree(1.0), 0),
+                vols[1][3].average(make_tree(3.0), 0),
+            )
+        finally:
+            await teardown(vols)
+        return r
+
+    r0, r1 = asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+    assert r0 is not None and r1 is not None
+    for r in (r0, r1):
+        np.testing.assert_allclose(r["w"], np.full((4, 3), 2.0), rtol=1e-2)
+        np.testing.assert_allclose(r["b"]["x"], np.full((5,), 4.0), rtol=1e-2)
+
+
+def test_mixed_wire_schema_rejection():
+    """An f32 volunteer and a bf16 volunteer must NOT mis-decode each other:
+    the wire dtype is part of the schema, so the round degrades instead."""
+    from tests.test_averaging import make_tree, spawn_volunteers, teardown
+
+    from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+
+    async def scenario():
+        vols = await spawn_volunteers(2, SyncAverager)
+        # Rebuild vol1's averager with bf16 wire on the same swarm.
+        t, dht, mem, _ = vols[1]
+        vols[1] = (t, dht, mem, SyncAverager(t, dht, mem, wire="bf16",
+                                             join_timeout=4.0, gather_timeout=4.0))
+        try:
+            r = await asyncio.gather(
+                vols[0][3].average(make_tree(1.0), 0),
+                vols[1][3].average(make_tree(3.0), 0),
+            )
+        finally:
+            await teardown(vols)
+        return r
+
+    r0, r1 = asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+    # Either both rounds degrade to None (schema mismatch) or each returns its
+    # own subset — but NEVER a garbled cross-decode. A successful 2-party
+    # average with mismatched wire dtypes would be silent corruption.
+    for r in (r0, r1):
+        if r is not None:
+            vals = np.asarray(r["w"])
+            assert np.isfinite(vals).all()
+            # must equal one side's own contribution, not a corrupt mix
+            assert np.allclose(vals, 1.0) or np.allclose(vals, 3.0)
