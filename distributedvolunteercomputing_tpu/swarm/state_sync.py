@@ -1,0 +1,143 @@
+"""Peer-pull state synchronisation: join the swarm at the swarm's step.
+
+The capability that makes churn recovery real (SURVEY.md §5
+checkpoint/resume): a volunteer that (re)joins — fresh process, restored
+preemption, long absence — pulls the freshest params straight from a live
+peer instead of training from its cold init and poisoning the next averaging
+round with stale weights (the hivemind ``load_state_from_peers`` role, done
+the swarm's way: DHT announcement + one transport RPC).
+
+Protocol:
+- every provider periodically announces ``state/<namespace>`` in the DHT
+  with its current step (subkey = peer_id, TTL'd like heartbeats);
+- a puller reads the key, targets the highest announced step above its own,
+  and issues ``state.fetch``; the payload is the flattened f32 param buffer
+  (always f32 — a one-off fetch shouldn't inherit the bf16 wire's rounding);
+- the puller validates the buffer length against ITS OWN param schema before
+  adopting (a wrong-model payload can't be loaded), and walks down the
+  candidate list on failure — a dead or lagging peer costs one timeout.
+
+Optimizer moments are NOT transferred: a pulled state resumes with a cold
+optimizer at the correct step (the standard trade — moments are 2x params of
+extra WAN bytes for marginal benefit after averaging rounds resync anyway).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer, unflatten_from_buffer
+
+log = get_logger(__name__)
+
+# (step, params_tree) supplier — reads the live trainer state.
+StateProvider = Callable[[], Tuple[int, Any]]
+
+
+class StateSyncService:
+    def __init__(
+        self,
+        transport: Transport,
+        dht: DHTNode,
+        peer_id: str,
+        namespace: str,
+        announce_ttl: float = 30.0,
+        fetch_timeout: float = 60.0,
+    ):
+        self.transport = transport
+        self.dht = dht
+        self.peer_id = peer_id
+        self.namespace = namespace
+        self.announce_ttl = announce_ttl
+        self.fetch_timeout = fetch_timeout
+        self._provider: Optional[StateProvider] = None
+        transport.register("state.fetch", self._rpc_fetch)
+
+    @property
+    def key(self) -> str:
+        return f"state/{self.namespace}"
+
+    def set_provider(self, provider: StateProvider) -> None:
+        self._provider = provider
+
+    # -- provider side -----------------------------------------------------
+
+    async def announce(self) -> None:
+        """Publish (addr, step) under the state key; call periodically."""
+        if self._provider is None:
+            return
+        step, _ = self._provider()
+        await self.dht.store(
+            self.key,
+            {"addr": list(self.transport.addr), "step": int(step)},
+            subkey=self.peer_id,
+            ttl=self.announce_ttl,
+        )
+
+    async def _rpc_fetch(self, args: dict, payload: bytes):
+        if self._provider is None:
+            raise RPCError("no state to serve yet")
+        step, tree = self._provider()
+
+        def _serialize() -> bytes:
+            buf, _, _ = flatten_to_buffer(tree)
+            return buf.tobytes()
+
+        # Param-sized flatten+copy off the event loop: serving state must not
+        # stall heartbeats/averaging RPCs for the duration of a big memcpy.
+        return {"step": int(step)}, await asyncio.to_thread(_serialize)
+
+    # -- puller side -------------------------------------------------------
+
+    async def _candidates(self, min_step: int) -> List[Tuple[int, str, Addr]]:
+        records = await self.dht.get(self.key)
+        out = []
+        for pid, rec in records.items():
+            if pid == self.peer_id or not isinstance(rec, dict):
+                continue
+            try:
+                step = int(rec["step"])
+                host, port = rec["addr"]
+                addr = (str(host), int(port))
+            except (KeyError, TypeError, ValueError):
+                continue
+            if step > min_step:
+                out.append((step, pid, addr))
+        out.sort(reverse=True)  # freshest first
+        return out
+
+    async def pull(
+        self, local_tree: Any, local_step: int, min_lead: int = 1
+    ) -> Optional[Tuple[int, Any]]:
+        """Fetch params from the freshest peer at least ``min_lead`` steps
+        ahead; returns (step, tree) or None (nobody ahead / all fetches
+        failed — both normal, the caller just trains on)."""
+        _, specs, treedef = flatten_to_buffer(local_tree)
+        expect = int(sum(s.size for s in specs))
+        for step, pid, addr in await self._candidates(local_step + min_lead - 1):
+            try:
+                ret, payload = await self.transport.call(
+                    addr, "state.fetch", {"peer": self.peer_id},
+                    timeout=self.fetch_timeout,
+                )
+                buf = np.frombuffer(payload, np.float32)
+                if buf.size != expect:
+                    log.warning(
+                        "state pull from %s: buffer %d != local schema %d (skipping)",
+                        pid, buf.size, expect,
+                    )
+                    continue
+                got_step = int(ret.get("step", step))
+                log.info("pulled state at step %d from %s", got_step, pid)
+                # No defensive copy: unflatten's astype copies each chunk out
+                # of the read-only frombuffer view.
+                return got_step, unflatten_from_buffer(buf, specs, treedef)
+            except (RPCError, OSError, asyncio.TimeoutError, ValueError) as e:
+                log.info("state pull from %s failed (%s); trying next", pid, e)
+        return None

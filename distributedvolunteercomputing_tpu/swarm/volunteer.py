@@ -26,6 +26,7 @@ from distributedvolunteercomputing_tpu.models import get_model
 from distributedvolunteercomputing_tpu.swarm.averager import make_averager
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.state_sync import StateSyncService
 from distributedvolunteercomputing_tpu.swarm.transport import Transport
 from distributedvolunteercomputing_tpu.training.trainer import Trainer
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
@@ -73,6 +74,7 @@ class Volunteer:
         self.dht = DHTNode(self.transport)
         self.membership: Optional[SwarmMembership] = None
         self.averager = None
+        self.state_sync: Optional[StateSyncService] = None
         self.trainer: Optional[Trainer] = None
         self._stop = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -154,18 +156,47 @@ class Volunteer:
             from distributedvolunteercomputing_tpu.training.checkpoint import maybe_restore
 
             maybe_restore(self.trainer, self.cfg.checkpoint_dir)
+        if self.cfg.averaging != "none":
+            # Peer-pull state sync: catch up to the swarm BEFORE the first
+            # step, so a (re)joining volunteer's first averaging round
+            # contributes swarm-current weights, not a cold init (or a
+            # checkpoint from before a long absence).
+            self.state_sync = StateSyncService(
+                self.transport, self.dht, self.cfg.peer_id, namespace=self.cfg.model
+            )
+            # The provider reads the trainer's HOST snapshot, never the live
+            # TrainState: the jitted step donates its input buffers, so
+            # touching state.params from this (asyncio) thread mid-training
+            # would hit deleted arrays.
+            self.state_sync.set_provider(lambda: self.trainer.host_snapshot())
+            pulled = await self.state_sync.pull(
+                self.trainer.state.params, int(self.trainer.state.step)
+            )
+            if pulled is not None:
+                step, params = pulled
+                self.trainer.adopt_params(params, step=step)
+            await self.state_sync.announce()
         log.info(
             "volunteer %s up on %s:%d (model=%s averaging=%s)",
             self.cfg.peer_id, *self.transport.addr, self.cfg.model, self.cfg.averaging,
         )
 
     async def _report_loop(self) -> None:
-        if not self.cfg.coordinator:
-            return
-        host, port = self.cfg.coordinator.rsplit(":", 1)
-        caddr = (host, int(port))
+        caddr = None
+        if self.cfg.coordinator:
+            host, port = self.cfg.coordinator.rsplit(":", 1)
+            caddr = (host, int(port))
         while not self._stop.is_set():
             await asyncio.sleep(5.0)
+            if self.state_sync is not None:
+                try:
+                    # Re-announce our step so rejoining peers can find the
+                    # freshest provider (TTL'd, like heartbeats).
+                    await self.state_sync.announce()
+                except Exception:
+                    pass
+            if caddr is None:
+                continue
             try:
                 await self.transport.call(
                     caddr,

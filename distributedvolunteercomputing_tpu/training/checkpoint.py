@@ -4,7 +4,7 @@ Volunteer churn only makes sense if a stopped volunteer can come back
 (preemption -> restart on a fresh TPU-VM): ``save`` flushes the full
 TrainState (params, optimizer state, step, rng), ``maybe_restore`` loads the
 newest snapshot if one exists. Peer-pull resume (fetching newer params from
-live peers after a long absence) lives in swarm.volunteer.
+live peers after a long absence) lives in swarm.state_sync.
 """
 
 from __future__ import annotations

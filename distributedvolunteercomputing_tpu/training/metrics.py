@@ -29,17 +29,25 @@ class MetricsWriter:
         """Cheap path: bump the sample counter without touching metric values."""
         self._samples += n
 
-    def record(self, step: int, metrics: Dict[str, Any], n_samples: int = 0) -> None:
-        self._samples += n_samples
+    def _emit(self, step: int, fields: Dict[str, Any]) -> None:
         if self._fh is not None:
             rec = {
                 "t": round(time.monotonic() - self._t0, 4),
                 "volunteer": self.volunteer_id,
                 "step": step,
-                **{k: float(v) for k, v in metrics.items()},
+                **fields,
             }
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()
+
+    def record(self, step: int, metrics: Dict[str, Any], n_samples: int = 0) -> None:
+        self._samples += n_samples
+        self._emit(step, {k: float(v) for k, v in metrics.items()})
+
+    def record_event(self, step: int, event: str, fields: Dict[str, Any]) -> None:
+        """Non-metric timeline record (e.g. one averaging round's wall-clock
+        and outcome); same JSONL stream, tagged by ``event``."""
+        self._emit(step, {"event": event, **fields})
 
     def samples_per_sec(self) -> float:
         """Rate since the previous call (windowed, not lifetime)."""

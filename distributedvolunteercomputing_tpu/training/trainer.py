@@ -56,6 +56,40 @@ class Trainer:
         self._data_rng = data_rng
         self.metrics = MetricsWriter(metrics_path, volunteer_id)
         self.on_step = on_step
+        # Host-side (step, params) snapshot for concurrent readers (the
+        # state-sync provider serves fetches from the asyncio thread while
+        # the train step DONATES the live state's buffers — reading
+        # self.state.params cross-thread would hit deleted arrays). Updated
+        # at safe points only; tuple assignment keeps readers consistent.
+        self._snapshot: Any = None
+        self._take_snapshot(0)
+
+    def adopt_params(self, params: Any, step: Optional[int] = None) -> None:
+        """Replace params (and optionally the step counter) in place — the
+        peer-pull state sync path. The optimizer state is NOT reset: at
+        adoption time it is either cold-init (fresh process) or the restored
+        moments, and averaging rounds re-sync it functionally either way."""
+        import jax.numpy as jnp
+
+        self.state = TrainState(
+            params=jax.device_put(params),
+            opt_state=self.state.opt_state,
+            step=self.state.step if step is None else jnp.asarray(step, jnp.int32),
+            rng=self.state.rng,
+        )
+        self._take_snapshot(int(self.state.step))
+
+    def _take_snapshot(self, step_no: int) -> None:
+        """D2H copy of params at a point where the buffers are live (between
+        steps, on the trainer thread). One copy per averaging interval."""
+        self._snapshot = (
+            step_no,
+            jax.tree_util.tree_map(np.asarray, self.state.params),
+        )
+
+    def host_snapshot(self):
+        """(step, host params pytree) — safe to read from any thread."""
+        return self._snapshot
 
     def data_iter(self) -> Iterable[Batch]:
         rng = self._data_rng
@@ -100,7 +134,14 @@ class Trainer:
                 # Only the bundle-selected payload crosses the WAN (full
                 # params by default; adapters only for LoRA models).
                 payload = self.bundle.avg_select(self.state.params)
+                t_avg = time.monotonic()
                 averaged = self.averager(payload, step_no)
+                # Round wall-clock is THE WAN-tier health number (compute vs
+                # averaging split, SURVEY.md §5 tracing): record it per round.
+                self.metrics.record_event(
+                    step_no, "avg_round",
+                    {"avg_s": time.monotonic() - t_avg, "ok": averaged is not None},
+                )
                 if averaged is not None:
                     new_params = self.bundle.avg_merge(
                         self.state.params,
@@ -112,6 +153,9 @@ class Trainer:
                         step=self.state.step,
                         rng=self.state.rng,
                     )
+                # Refresh the cross-thread snapshot at the averaging cadence
+                # (post-merge, so state-sync serves the averaged weights).
+                self._take_snapshot(step_no)
 
             if self.on_step is not None:
                 self.on_step(self, step_no)

@@ -1,0 +1,112 @@
+"""Peer-pull state sync: a joining volunteer adopts the swarm's params."""
+
+import asyncio
+
+import numpy as np
+
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.state_sync import StateSyncService
+from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+def tree(v, n=7):
+    return {"w": np.full((n, 3), v, np.float32), "b": np.full((2,), v * 3, np.float32)}
+
+
+async def _node(boot=None, peer_id="p", ns="m"):
+    t = Transport()
+    dht = DHTNode(t)
+    await dht.start(bootstrap=[boot] if boot else None)
+    svc = StateSyncService(t, dht, peer_id, namespace=ns, fetch_timeout=10.0)
+    return t, dht, svc
+
+
+def test_pull_adopts_freshest_peer():
+    async def scenario():
+        ta, _, a = await _node(peer_id="a")
+        tb, _, b = await _node(boot=ta.addr, peer_id="b")
+        tc, _, c = await _node(boot=ta.addr, peer_id="c")
+        try:
+            a.set_provider(lambda: (50, tree(5.0)))
+            b.set_provider(lambda: (80, tree(8.0)))
+            await a.announce()
+            await b.announce()
+            pulled = await c.pull(tree(0.0), local_step=0)
+            assert pulled is not None
+            step, t = pulled
+            assert step == 80
+            np.testing.assert_array_equal(t["w"], np.full((7, 3), 8.0))
+            # nobody ahead of step 100 -> None
+            assert await c.pull(tree(0.0), local_step=100) is None
+        finally:
+            for tt in (ta, tb, tc):
+                await tt.close()
+
+    run(scenario())
+
+
+def test_pull_rejects_wrong_schema_and_falls_back():
+    async def scenario():
+        ta, _, a = await _node(peer_id="a")
+        tb, _, b = await _node(boot=ta.addr, peer_id="b")
+        tc, _, c = await _node(boot=ta.addr, peer_id="c")
+        try:
+            # b is "fresher" but serves a different-shaped model: must be
+            # skipped, falling back to a.
+            a.set_provider(lambda: (50, tree(5.0)))
+            b.set_provider(lambda: (90, tree(9.0, n=13)))
+            await a.announce()
+            await b.announce()
+            pulled = await c.pull(tree(0.0), local_step=0)
+            assert pulled is not None
+            step, t = pulled
+            assert step == 50
+            np.testing.assert_array_equal(t["w"], np.full((7, 3), 5.0))
+        finally:
+            for tt in (ta, tb, tc):
+                await tt.close()
+
+    run(scenario())
+
+
+def test_volunteer_pull_on_join(tmp_path):
+    """In-process volunteers: #2 joins after #1 trained ahead, and must start
+    from #1's announced step instead of step 0."""
+    from distributedvolunteercomputing_tpu.swarm.volunteer import Volunteer, VolunteerConfig
+
+    async def scenario():
+        cfg1 = VolunteerConfig(
+            model="mnist_mlp", averaging="sync", steps=0, peer_id="v1",
+            min_group=2,
+        )
+        v1 = Volunteer(cfg1)
+        await v1.start()
+        # Simulate v1 being 40 steps into training (adopt_params refreshes
+        # the host snapshot the state-sync provider serves), then announce.
+        v1.trainer.adopt_params(v1.trainer.state.params, step=40)
+        await v1.state_sync.announce()
+
+        cfg2 = VolunteerConfig(
+            model="mnist_mlp", averaging="sync", steps=0, peer_id="v2",
+            coordinator="{}:{}".format(*v1.transport.addr), min_group=2,
+        )
+        v2 = Volunteer(cfg2)
+        try:
+            await v2.start()
+            assert int(v2.trainer.state.step) == 40
+            import jax
+
+            for got, want in zip(
+                jax.tree_util.tree_leaves(v2.trainer.state.params),
+                jax.tree_util.tree_leaves(v1.trainer.state.params),
+            ):
+                np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+        finally:
+            await v2.transport.close()
+            await v1.transport.close()
+
+    run(scenario())
