@@ -32,7 +32,11 @@ import numpy as np
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
-from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer, unflatten_from_buffer
+from distributedvolunteercomputing_tpu.utils.pytree import (
+    flatten_to_buffer,
+    tree_specs,
+    unflatten_from_buffer,
+)
 
 log = get_logger(__name__)
 
@@ -118,7 +122,8 @@ class StateSyncService:
         """Fetch params from the freshest peer at least ``min_lead`` steps
         ahead; returns (step, tree) or None (nobody ahead / all fetches
         failed — both normal, the caller just trains on)."""
-        _, specs, treedef = flatten_to_buffer(local_tree)
+        # Schema only — no param-sized buffer materialized on the pull side.
+        specs, treedef = tree_specs(local_tree)
         expect = int(sum(s.size for s in specs))
         for step, pid, addr in await self._candidates(local_step + min_lead - 1):
             try:

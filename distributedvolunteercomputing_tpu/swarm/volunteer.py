@@ -45,6 +45,7 @@ class VolunteerConfig:
     peer_id: str = ""
     averaging: str = "none"  # none|sync|gossip|butterfly|byzantine
     average_every: int = 10
+    average_what: str = "params"  # params (local-SGD) | grads (GradientAverager)
     wire: str = "f32"  # f32|bf16 — WAN payload codec (bf16 halves DCN bytes)
     min_group: int = 2
     max_group: int = 16
@@ -85,7 +86,10 @@ class Volunteer:
     def _averager_callback(self, params, step: int):
         if self.averager is None or self._stop.is_set():
             return None
-        samples_since = self.cfg.batch_size * self.cfg.average_every
+        # Weight = samples behind this contribution: one batch for a
+        # gradient round, average_every batches for a parameter round.
+        per_round = 1 if self.cfg.average_what == "grads" else self.cfg.average_every
+        samples_since = self.cfg.batch_size * per_round
         fut = asyncio.run_coroutine_threadsafe(
             self.averager.average(params, round_no=step, weight=float(samples_since)),
             self._loop,
@@ -147,6 +151,7 @@ class Volunteer:
             seed=self.cfg.seed,
             average_every=self.cfg.average_every,
             averager=self._averager_callback if self.averager else None,
+            average_what=self.cfg.average_what,
             metrics_path=self.cfg.metrics_path,
             volunteer_id=self.cfg.peer_id,
             total_steps=self.cfg.steps,

@@ -73,5 +73,8 @@ def maybe_restore(trainer, ckpt_dir: str) -> bool:
         step=jax.device_put(restored["step"]),
         rng=jax.device_put(restored["rng"]),
     )
+    # Refresh the cross-thread snapshot: the state-sync provider must
+    # announce/serve the RESTORED step, not the cold init from __init__.
+    trainer._take_snapshot(step)
     log.info("restored checkpoint step %d from %s", step, path)
     return True

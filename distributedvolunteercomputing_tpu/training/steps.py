@@ -75,6 +75,45 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def make_grad_step(
+    loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
+) -> Callable[[TrainState, Batch], Tuple[Any, Metrics, jax.Array]]:
+    """Gradient-averaging mode, half 1: fwd/bwd WITHOUT the update.
+
+    The reference's synchronous GradientAverager semantics (BASELINE.json:5)
+    average GRADIENTS across volunteers before any optimizer sees them; that
+    forces the grads out to host between bwd and update, so the fused step
+    splits into (grad_step, apply_step). State is NOT donated here — the
+    same state is consumed again by apply_step."""
+
+    def step(state: TrainState, batch: Batch) -> Tuple[Any, Metrics, jax.Array]:
+        rng, step_rng = jax.random.split(state.rng)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, metrics), grads = grad_fn(state.params, batch, step_rng)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return grads, metrics, rng
+
+    return jax.jit(step)
+
+
+def make_apply_step(
+    tx: optax.GradientTransformation,
+    donate: bool = True,
+) -> Callable[[TrainState, Any, jax.Array], TrainState]:
+    """Gradient-averaging mode, half 2: optimizer update from (possibly
+    swarm-averaged) grads."""
+
+    def apply(state: TrainState, grads: Any, rng: jax.Array) -> TrainState:
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(
+            params=params, opt_state=opt_state, step=state.step + 1, rng=rng
+        )
+
+    return jax.jit(apply, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(
     loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
 ) -> Callable[[Any, Batch, jax.Array], Metrics]:

@@ -18,7 +18,12 @@ import numpy as np
 from distributedvolunteercomputing_tpu.models.registry import Batch, ModelBundle
 from distributedvolunteercomputing_tpu.training.metrics import MetricsWriter
 from distributedvolunteercomputing_tpu.training.optim import make_optimizer
-from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+from distributedvolunteercomputing_tpu.training.steps import (
+    TrainState,
+    make_apply_step,
+    make_grad_step,
+    make_train_step,
+)
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -38,21 +43,37 @@ class Trainer:
         seed: int = 0,
         average_every: int = 10,
         averager: Optional[AveragerFn] = None,
+        # params: local-SGD, averaged every `average_every` steps.
+        # grads: GradientAverager semantics, averaged EVERY step
+        #        (average_every then only sets the host-snapshot cadence).
+        average_what: str = "params",
         metrics_path: Optional[str] = None,
         volunteer_id: str = "local",
         total_steps: Optional[int] = None,
         on_step: Optional[Callable[["Trainer", int], None]] = None,
     ):
+        if average_what not in ("params", "grads"):
+            raise ValueError(f"unknown average_what {average_what!r}")
         self.bundle = bundle
         self.batch_size = batch_size
         self.average_every = average_every
         self.averager = averager
+        self.average_what = average_what
         rng = jax.random.PRNGKey(seed)
         init_rng, data_rng, state_rng = jax.random.split(rng, 3)
         self.tx = make_optimizer(optimizer, lr=lr, total_steps=total_steps)
         params = bundle.init(init_rng)
         self.state = TrainState.create(params, self.tx, state_rng)
-        self._step_fn = make_train_step(bundle.loss_fn, self.tx)
+        # Gradient-averaging mode splits the step so grads can cross the WAN
+        # between bwd and the optimizer (reference GradientAverager
+        # semantics); the fused donate-everything step covers the rest.
+        self._grads_mode = averager is not None and average_what == "grads"
+        if self._grads_mode:
+            self._grad_fn = make_grad_step(bundle.loss_fn)
+            self._apply_fn = make_apply_step(self.tx)
+            self._step_fn = None
+        else:
+            self._step_fn = make_train_step(bundle.loss_fn, self.tx)
         self._data_rng = data_rng
         self.metrics = MetricsWriter(metrics_path, volunteer_id)
         self.on_step = on_step
@@ -120,9 +141,31 @@ class Trainer:
                 log.info("stop flag set; exiting train loop at step %d", int(self.state.step))
                 break
             batch = next(it)
-            self.state, m = self._step_fn(self.state, batch)
+            step_no = start_step + ran_steps + 1
+            if self._grads_mode:
+                # GradientAverager semantics are PER-STEP: every local
+                # gradient is averaged before any optimizer sees it (skipping
+                # steps would let replica params drift with nothing ever
+                # re-contracting them — that's what params mode is for).
+                grads, m, next_rng = self._grad_fn(self.state, batch)
+                payload = self.bundle.avg_select(grads)
+                t_avg = time.monotonic()
+                averaged = self.averager(payload, step_no)
+                self.metrics.record_event(
+                    step_no, "avg_round",
+                    {"avg_s": time.monotonic() - t_avg, "ok": averaged is not None,
+                     "what": "grads"},
+                )
+                if averaged is not None:
+                    grads = self.bundle.avg_merge(
+                        grads, jax.tree_util.tree_map(np.asarray, averaged)
+                    )
+                self.state = self._apply_fn(self.state, grads, next_rng)
+                if step_no % self.average_every == 0:
+                    self._take_snapshot(step_no)
+            else:
+                self.state, m = self._step_fn(self.state, batch)
             ran_steps += 1
-            step_no = start_step + ran_steps
             at_log_point = bool(log_every) and step_no % log_every == 0
             if sync_every_step or at_log_point:
                 last_loss = float(m["loss"])
@@ -130,7 +173,11 @@ class Trainer:
             else:
                 self.metrics.count_samples(self.batch_size)
 
-            if self.averager is not None and step_no % self.average_every == 0:
+            if (
+                self.averager is not None
+                and not self._grads_mode
+                and step_no % self.average_every == 0
+            ):
                 # Only the bundle-selected payload crosses the WAN (full
                 # params by default; adapters only for LoRA models).
                 payload = self.bundle.avg_select(self.state.params)

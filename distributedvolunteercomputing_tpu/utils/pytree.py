@@ -34,6 +34,21 @@ class TensorSpec:
         return n
 
 
+def tree_specs(tree: Any) -> Tuple[List[TensorSpec], Any]:
+    """(specs, treedef) of a pytree WITHOUT materializing the flat buffer —
+    for callers that only need the schema (e.g. validating an incoming
+    buffer's length before adopting it)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    specs = [
+        TensorSpec(
+            tuple(np.shape(x)),
+            str(x.dtype) if hasattr(x, "dtype") else str(np.asarray(x).dtype),
+        )
+        for x in leaves
+    ]
+    return specs, treedef
+
+
 def flatten_to_buffer(tree: Any) -> Tuple[np.ndarray, List[TensorSpec], Any]:
     """Flatten a pytree of arrays into one contiguous float32 host buffer.
 

@@ -42,6 +42,8 @@ def main() -> None:
     ap.add_argument("--averaging", default="none",
                     choices=["none", "sync", "gossip", "butterfly", "byzantine"])
     ap.add_argument("--average-every", type=int, default=10)
+    ap.add_argument("--average-what", default="params", choices=("params", "grads"),
+                    help="params = local-SGD periodic averaging; grads = GradientAverager")
     ap.add_argument("--wire", default="f32", choices=("f32", "bf16"),
                     help="WAN payload codec; bf16 halves DCN traffic")
     ap.add_argument("--min-group", type=int, default=2)
@@ -78,6 +80,7 @@ def main() -> None:
         peer_id=args.peer_id,
         averaging=args.averaging,
         average_every=args.average_every,
+        average_what=args.average_what,
         wire=args.wire,
         min_group=args.min_group,
         max_group=args.max_group,

@@ -88,6 +88,27 @@ class TestSwarmE2E:
         finally:
             coord.kill()
 
+    def test_two_volunteers_grad_averaging_bf16_wire(self):
+        """GradientAverager semantics end-to-end: grads averaged every step
+        over the bf16 wire; both volunteers converge in lockstep."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                # grads mode averages EVERY step — keep the run short.
+                "--averaging", "sync", "--average-what", "grads", "--wire", "bf16",
+                "--steps", "8",
+                "--join-timeout", "25", "--gather-timeout", "25",
+            ]
+            v0 = start_volunteer(addr, "gvol0", common + ["--seed", "0"])
+            v1 = start_volunteer(addr, "gvol1", common + ["--seed", "1"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            assert s0["rounds_ok"] >= 2, out0
+            assert s1["rounds_ok"] >= 2, out1
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
+        finally:
+            coord.kill()
+
     def test_churn_kill9_survivors_finish(self):
         """Kill -9 one of three volunteers mid-run; survivors keep averaging."""
         coord, addr = start_coordinator()
