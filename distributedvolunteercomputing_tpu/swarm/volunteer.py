@@ -125,8 +125,11 @@ class Volunteer:
             )
             if self.cfg.averaging == "byzantine" and self.cfg.method != "mean":
                 kw["method"] = self.cfg.method
-            # Namespace rounds by model so mixed swarms never cross-group.
-            kw["namespace"] = self.cfg.model
+            # Namespace rounds by model AND by what is averaged: a grads-mode
+            # peer must never rendezvous with a params-mode peer on the same
+            # model — averaging a gradient tree against a parameter tree
+            # would silently destroy both.
+            kw["namespace"] = f"{self.cfg.model}/{self.cfg.average_what}"
             self.averager = make_averager(
                 self.cfg.averaging, self.transport, self.dht, self.membership, **kw
             )

@@ -41,25 +41,43 @@ class TrainState:
         )
 
 
+def grad_half(
+    loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
+    state: TrainState,
+    batch: Batch,
+) -> Tuple[Any, Metrics, jax.Array]:
+    """fwd/bwd half of the step: (grads, metrics, next_rng)."""
+    rng, step_rng = jax.random.split(state.rng)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    (_, metrics), grads = grad_fn(state.params, batch, step_rng)
+    metrics = dict(metrics)
+    metrics["grad_norm"] = optax.global_norm(grads)
+    return grads, metrics, rng
+
+
+def apply_half(
+    tx: optax.GradientTransformation,
+    state: TrainState,
+    grads: Any,
+    rng: jax.Array,
+) -> TrainState:
+    """Optimizer-update half of the step."""
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params=params, opt_state=opt_state, step=state.step + 1, rng=rng)
+
+
 def train_step_body(
     loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
     tx: optax.GradientTransformation,
     state: TrainState,
     batch: Batch,
 ) -> Tuple[TrainState, Metrics]:
-    """The traced step math, shared by the single-device and sharded steps
-    (parallel/train_step.py) so the two paths can never diverge."""
-    rng, step_rng = jax.random.split(state.rng)
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-    (loss, metrics), grads = grad_fn(state.params, batch, step_rng)
-    updates, opt_state = tx.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
-    new_state = TrainState(
-        params=params, opt_state=opt_state, step=state.step + 1, rng=rng
-    )
-    metrics = dict(metrics)
-    metrics["grad_norm"] = optax.global_norm(grads)
-    return new_state, metrics
+    """The traced step math, shared by the single-device step, the sharded
+    step (parallel/train_step.py), and — via its two halves — the split
+    grad/apply steps of gradient-averaging mode, so no path can diverge."""
+    grads, metrics, rng = grad_half(loss_fn, state, batch)
+    return apply_half(tx, state, grads, rng), metrics
 
 
 def make_train_step(
@@ -85,16 +103,7 @@ def make_grad_step(
     forces the grads out to host between bwd and update, so the fused step
     splits into (grad_step, apply_step). State is NOT donated here — the
     same state is consumed again by apply_step."""
-
-    def step(state: TrainState, batch: Batch) -> Tuple[Any, Metrics, jax.Array]:
-        rng, step_rng = jax.random.split(state.rng)
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, metrics), grads = grad_fn(state.params, batch, step_rng)
-        metrics = dict(metrics)
-        metrics["grad_norm"] = optax.global_norm(grads)
-        return grads, metrics, rng
-
-    return jax.jit(step)
+    return jax.jit(lambda state, batch: grad_half(loss_fn, state, batch))
 
 
 def make_apply_step(
@@ -103,15 +112,10 @@ def make_apply_step(
 ) -> Callable[[TrainState, Any, jax.Array], TrainState]:
     """Gradient-averaging mode, half 2: optimizer update from (possibly
     swarm-averaged) grads."""
-
-    def apply(state: TrainState, grads: Any, rng: jax.Array) -> TrainState:
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(
-            params=params, opt_state=opt_state, step=state.step + 1, rng=rng
-        )
-
-    return jax.jit(apply, donate_argnums=(0,) if donate else ())
+    return jax.jit(
+        lambda state, grads, rng: apply_half(tx, state, grads, rng),
+        donate_argnums=(0,) if donate else (),
+    )
 
 
 def make_eval_step(

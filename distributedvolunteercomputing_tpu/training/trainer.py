@@ -9,6 +9,7 @@ no averaging, BASELINE.json:7) is just ``averager=None``.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
@@ -118,6 +119,20 @@ class Trainer:
             rng, k = jax.random.split(rng)
             yield self.bundle.make_batch(k, self.batch_size)
 
+    def _run_average_round(self, tree: Any, step_no: int, what: str) -> Optional[Any]:
+        """One WAN round: select payload -> averager -> record -> merge.
+        Returns the merged tree, or None when no group formed / round failed."""
+        payload = self.bundle.avg_select(tree)
+        t_avg = time.monotonic()
+        averaged = self.averager(payload, step_no)
+        self.metrics.record_event(
+            step_no, "avg_round",
+            {"avg_s": time.monotonic() - t_avg, "ok": averaged is not None, "what": what},
+        )
+        if averaged is None:
+            return None
+        return self.bundle.avg_merge(tree, jax.tree_util.tree_map(np.asarray, averaged))
+
     def run(
         self,
         steps: int,
@@ -127,6 +142,18 @@ class Trainer:
     ) -> Dict[str, float]:
         """Train for ``steps`` (or until ``target_loss``); returns summary."""
         it = iter(self.data_iter())
+        # Tracing hook (SURVEY.md §5): DVC_PROFILE_DIR=<dir> captures a
+        # jax.profiler trace of steps [DVC_PROFILE_START, +DVC_PROFILE_STEPS)
+        # — past warmup/compile, so the trace shows steady-state step time
+        # and the compute-vs-averaging split. View with tensorboard/xprof.
+        profile_dir = os.environ.get("DVC_PROFILE_DIR")
+        profile_start = int(os.environ.get("DVC_PROFILE_START", "10"))
+        profile_steps = int(os.environ.get("DVC_PROFILE_STEPS", "10"))
+        profiling = False
+        # Grads mode averages every step; after a FAILED round (no group —
+        # e.g. the only partner died) skip averaging for average_every steps
+        # instead of paying a full matchmaking timeout per step.
+        avg_skip_until = 0
         # Materialising metrics forces a host<->device sync that breaks JAX's
         # async dispatch pipelining — only pay for it when something consumes
         # the value (target check, JSONL record, or a log line).
@@ -142,24 +169,21 @@ class Trainer:
                 break
             batch = next(it)
             step_no = start_step + ran_steps + 1
+            if profile_dir and not profiling and i == profile_start:
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
             if self._grads_mode:
                 # GradientAverager semantics are PER-STEP: every local
                 # gradient is averaged before any optimizer sees it (skipping
                 # steps would let replica params drift with nothing ever
                 # re-contracting them — that's what params mode is for).
                 grads, m, next_rng = self._grad_fn(self.state, batch)
-                payload = self.bundle.avg_select(grads)
-                t_avg = time.monotonic()
-                averaged = self.averager(payload, step_no)
-                self.metrics.record_event(
-                    step_no, "avg_round",
-                    {"avg_s": time.monotonic() - t_avg, "ok": averaged is not None,
-                     "what": "grads"},
-                )
-                if averaged is not None:
-                    grads = self.bundle.avg_merge(
-                        grads, jax.tree_util.tree_map(np.asarray, averaged)
-                    )
+                if step_no >= avg_skip_until:
+                    merged = self._run_average_round(grads, step_no, "grads")
+                    if merged is not None:
+                        grads = merged
+                    else:
+                        avg_skip_until = step_no + self.average_every
                 self.state = self._apply_fn(self.state, grads, next_rng)
                 if step_no % self.average_every == 0:
                     self._take_snapshot(step_no)
@@ -204,6 +228,12 @@ class Trainer:
                 # (post-merge, so state-sync serves the averaged weights).
                 self._take_snapshot(step_no)
 
+            if profiling and i + 1 >= profile_start + profile_steps:
+                jax.block_until_ready(m["loss"])
+                jax.profiler.stop_trace()
+                profiling = False
+                log.info("profiler trace written to %s", profile_dir)
+
             if self.on_step is not None:
                 self.on_step(self, step_no)
 
@@ -217,6 +247,8 @@ class Trainer:
             if target_loss is not None and last_loss <= target_loss:
                 log.info("target loss %.4f reached at step %d", target_loss, step_no)
                 break
+        if profiling:  # loop ended inside the trace window
+            jax.profiler.stop_trace()
         if m is not None:
             last_loss = float(m["loss"])  # sync once at the end regardless
         wall = time.monotonic() - t_start
