@@ -1,0 +1,87 @@
+"""Fault injection for swarm tests (SURVEY.md §5): a Transport that lies.
+
+ChaosTransport wraps the real TCP transport with seeded, tunable faults on
+the OUTBOUND path:
+
+- ``drop_rate``   — a call fails with OSError before touching the network
+                    (peer unreachable / mid-round death);
+- ``delay_s``     — uniform random delay before each call (WAN jitter,
+                    stragglers; drives timeout paths without sleeping tests
+                    for real-world durations);
+- ``corrupt_rate``— one payload byte is flipped AFTER the frame checksum is
+                    computed, so the corruption is wire-level and must be
+                    caught by the receiver's CRC — this validates the
+                    integrity machinery itself, not just error handling.
+
+Rates are attributes, so a test can flip a node from lossy to healthy
+mid-scenario deterministically. Production code never imports this module.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+from typing import Optional
+
+from distributedvolunteercomputing_tpu.swarm.transport import (
+    _HEADER,
+    MAGIC,
+    VERSION,
+    Addr,
+    Transport,
+)
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class ChaosTransport(Transport):
+    def __init__(
+        self,
+        *args,
+        drop_rate: float = 0.0,
+        delay_s: float = 0.0,
+        corrupt_rate: float = 0.0,
+        seed: int = 0,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.drop_rate = drop_rate
+        self.delay_s = delay_s
+        self.corrupt_rate = corrupt_rate
+        self._chaos = random.Random(seed)
+
+    # Overrides the base class staticmethod — called as self._write_frame at
+    # every send site, so instance dispatch picks this up for both the
+    # client and server halves of this node.
+    async def _write_frame(self, writer, ftype: int, meta: dict, payload: bytes) -> None:  # type: ignore[override]
+        if payload and self.corrupt_rate and self._chaos.random() < self.corrupt_rate:
+            import zlib
+
+            meta_b = json.dumps(meta).encode()
+            crc = zlib.crc32(payload) & 0xFFFFFFFF  # checksum of the TRUE payload
+            bad = bytearray(payload)
+            pos = self._chaos.randrange(len(bad))
+            bad[pos] ^= 0xFF
+            log.debug("chaos: corrupting payload byte %d", pos)
+            writer.write(_HEADER.pack(MAGIC, VERSION, ftype, len(meta_b), len(bad), crc))
+            writer.write(meta_b)
+            writer.write(bytes(bad))
+            await writer.drain()
+            return
+        await Transport._write_frame(writer, ftype, meta, payload)
+
+    async def call(
+        self,
+        addr: Addr,
+        method: str,
+        args: Optional[dict] = None,
+        payload: bytes = b"",
+        timeout: float = 30.0,
+    ):
+        if self.drop_rate and self._chaos.random() < self.drop_rate:
+            raise OSError(f"chaos: dropped call {method} to {addr}")
+        if self.delay_s:
+            await asyncio.sleep(self._chaos.random() * self.delay_s)
+        return await super().call(addr, method, args=args, payload=payload, timeout=timeout)

@@ -118,6 +118,19 @@ class Transport:
                     ftype, meta, payload = await self._read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
+                except RPCError as e:
+                    # Corrupt frame (bad magic / CRC mismatch / oversize):
+                    # the stream position is untrustworthy past this point,
+                    # so report the reason and drop the connection — the
+                    # caller can then distinguish corruption from a
+                    # disconnect (the Byzantine path needs that signal).
+                    try:
+                        await self._write_frame(
+                            writer, TYPE_ERR, {"rid": "", "error": f"bad frame: {e}"}, b""
+                        )
+                    except Exception:
+                        pass
+                    return
                 if ftype != TYPE_REQ:
                     return
                 method = meta.get("method", "")
@@ -168,10 +181,13 @@ class Transport:
                     writer, TYPE_REQ, {"rid": rid, "method": method, "args": args or {}}, payload
                 )
                 ftype, meta, resp_payload = await self._read_frame(reader)
-                if meta.get("rid") != rid:
-                    raise RPCError("response rid mismatch")
+                # Errors first: a frame-level rejection (corrupt request) has
+                # no rid to echo; per-call connections mean nothing else can
+                # be in flight, so this cannot mask a stale response.
                 if ftype == TYPE_ERR:
                     raise RPCError(meta.get("error", "unknown remote error"))
+                if meta.get("rid") != rid:
+                    raise RPCError("response rid mismatch")
                 return meta.get("ret", {}), resp_payload
             finally:
                 writer.close()

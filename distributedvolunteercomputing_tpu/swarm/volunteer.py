@@ -50,6 +50,7 @@ class VolunteerConfig:
     min_group: int = 2
     max_group: int = 16
     batch_size: int = 32
+    data_path: Optional[str] = None  # .npz real-data file; None = synthetic
     optimizer: str = "adam"
     lr: float = 1e-3
     seed: int = 0
@@ -146,8 +147,19 @@ class Volunteer:
                 if step_no % every == 0:
                     save(trainer, ckpt_dir)
 
+        data = None
+        if self.cfg.data_path:
+            from distributedvolunteercomputing_tpu.training.data import npz_batch_iter
+
+            # Seeded per-peer so volunteers shard the shuffle order, not the
+            # data: every volunteer sees the full file in a different order.
+            data = npz_batch_iter(
+                self.cfg.data_path, self.cfg.batch_size,
+                seed=hash(self.cfg.peer_id) & 0x7FFFFFFF,
+            )
         self.trainer = Trainer(
             bundle,
+            data=data,
             batch_size=self.cfg.batch_size,
             optimizer=self.cfg.optimizer,
             lr=self.cfg.lr,

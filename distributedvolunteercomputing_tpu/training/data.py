@@ -15,10 +15,11 @@ batches works (see training.trainer.Trainer).
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Iterator, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _PROTO_SEED = 1234  # class prototypes are global constants of the task
 _BIGRAM_SEED = 4321
@@ -63,6 +64,38 @@ def synthetic_lm_batch(rng: jax.Array, batch_size: int, seq_len: int, vocab: int
     """Causal LM batch: predict tokens[1:] from tokens[:-1]."""
     toks = synthetic_token_stream(rng, batch_size, seq_len + 1, vocab)
     return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+def npz_batch_iter(
+    path: str, batch_size: int, seed: int = 0
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Endless shuffled minibatches from an ``.npz`` of aligned arrays.
+
+    The real-data swap-in (file keys become batch-dict keys, so they must
+    match the model's schema: ``x``/``y`` for image models, ``tokens``/
+    ``targets`` for LMs, plus ``mask`` for MLM). Each pass reshuffles;
+    the trailing partial batch is dropped (jit caches per batch shape —
+    a ragged final batch would force a recompile every epoch).
+    """
+    data = {k: np.asarray(v) for k, v in np.load(path).items()}
+    if not data:
+        raise ValueError(f"{path}: empty npz")
+    n = len(next(iter(data.values())))
+    for k, v in data.items():
+        if len(v) != n:
+            raise ValueError(f"{path}: key {k!r} has {len(v)} rows, expected {n}")
+    if n < batch_size:
+        raise ValueError(f"{path}: {n} examples < batch_size {batch_size}")
+
+    def gen() -> Iterator[Dict[str, np.ndarray]]:
+        rng = np.random.default_rng(seed)
+        while True:
+            idx = rng.permutation(n)
+            for s in range(0, n - batch_size + 1, batch_size):
+                sel = idx[s : s + batch_size]
+                yield {k: v[sel] for k, v in data.items()}
+
+    return gen()
 
 
 def synthetic_mlm_batch(

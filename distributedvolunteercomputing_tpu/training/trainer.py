@@ -52,6 +52,7 @@ class Trainer:
         volunteer_id: str = "local",
         total_steps: Optional[int] = None,
         on_step: Optional[Callable[["Trainer", int], None]] = None,
+        data: Optional[Iterable[Batch]] = None,  # overrides the synthetic stream
     ):
         if average_what not in ("params", "grads"):
             raise ValueError(f"unknown average_what {average_what!r}")
@@ -76,6 +77,7 @@ class Trainer:
         else:
             self._step_fn = make_train_step(bundle.loss_fn, self.tx)
         self._data_rng = data_rng
+        self._data = data
         self.metrics = MetricsWriter(metrics_path, volunteer_id)
         self.on_step = on_step
         # Host-side (step, params) snapshot for concurrent readers (the
@@ -141,7 +143,7 @@ class Trainer:
         stop_flag: Optional[Callable[[], bool]] = None,
     ) -> Dict[str, float]:
         """Train for ``steps`` (or until ``target_loss``); returns summary."""
-        it = iter(self.data_iter())
+        it = iter(self._data) if self._data is not None else iter(self.data_iter())
         # Tracing hook (SURVEY.md §5): DVC_PROFILE_DIR=<dir> captures a
         # jax.profiler trace of steps [DVC_PROFILE_START, +DVC_PROFILE_STEPS)
         # — past warmup/compile, so the trace shows steady-state step time

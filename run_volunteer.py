@@ -51,6 +51,8 @@ def main() -> None:
     ap.add_argument("--method", default="trimmed_mean",
                     help="byzantine estimator: trimmed_mean|median|krum|geometric_median")
     ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--data", default=None,
+                    help=".npz of aligned arrays (keys = the model's batch schema); default synthetic")
     ap.add_argument("--optimizer", default="adam")
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--seed", type=int, default=0)
@@ -86,6 +88,7 @@ def main() -> None:
         max_group=args.max_group,
         method=args.method,
         batch_size=args.batch_size,
+        data_path=args.data,
         optimizer=args.optimizer,
         lr=args.lr,
         seed=args.seed,

@@ -1,0 +1,117 @@
+"""Fault injection: the swarm must degrade, never hang or mis-decode."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+from distributedvolunteercomputing_tpu.swarm.chaos import ChaosTransport
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=90))
+
+
+def test_corrupt_frame_rejected_by_crc():
+    """A wire-corrupted payload must be caught by the receiver's CRC, not
+    decoded into garbage tensors."""
+
+    async def scenario():
+        server = Transport()
+
+        async def echo(args, payload):
+            return {"n": len(payload)}, payload
+
+        server.register("echo", echo)
+        await server.start()
+        client = ChaosTransport(corrupt_rate=1.0, seed=7)
+        await client.start()
+        try:
+            with pytest.raises(RPCError, match="CRC|corrupt"):
+                await client.call(server.addr, "echo", {}, b"x" * 1024, timeout=10)
+        finally:
+            await client.close()
+            await server.close()
+
+    run(scenario())
+
+
+def test_lossy_peer_degrades_then_recovers():
+    """With a fully lossy link the round returns None within its timeouts
+    (no hang); healing the link makes the next round succeed."""
+
+    async def scenario():
+        def make_node(peer_id, boot=None, **chaos):
+            async def build():
+                t = ChaosTransport(seed=3, **chaos)
+                dht = DHTNode(t)
+                await dht.start(bootstrap=[boot] if boot else None)
+                mem = SwarmMembership(dht, peer_id, ttl=10.0)
+                await mem.join()
+                avg = SyncAverager(t, dht, mem, join_timeout=4.0, gather_timeout=4.0)
+                return t, avg
+
+            return build()
+
+        ta, avg_a = await make_node("a")
+        # Join healthy (bootstrap/membership need the network), THEN break
+        # the link — modelling a peer whose WAN degrades after joining.
+        tb, avg_b = await make_node("b", boot=ta.addr)
+        tree_a = {"w": np.full((8,), 1.0, np.float32)}
+        tree_b = {"w": np.full((8,), 3.0, np.float32)}
+        try:
+            tb.drop_rate = 1.0
+            # b drops every outbound call: neither side completes a round,
+            # both come back (bounded by timeouts), nobody wedges.
+            r = await asyncio.gather(
+                avg_a.average(tree_a, 0), avg_b.average(tree_b, 0)
+            )
+            assert r == [None, None]
+
+            tb.drop_rate = 0.0  # link healed
+            r2 = await asyncio.gather(
+                avg_a.average(tree_a, 1), avg_b.average(tree_b, 1)
+            )
+            assert r2[0] is not None and r2[1] is not None
+            np.testing.assert_allclose(r2[0]["w"], np.full((8,), 2.0), rtol=1e-6)
+        finally:
+            await ta.close()
+            await tb.close()
+
+    run(scenario())
+
+
+def test_delay_jitter_still_averages():
+    """Sub-timeout WAN jitter slows rounds but must not break them."""
+
+    async def scenario():
+        t0 = ChaosTransport(seed=1, delay_s=0.3)
+        dht0 = DHTNode(t0)
+        await dht0.start()
+        mem0 = SwarmMembership(dht0, "j0", ttl=10.0)
+        await mem0.join()
+        a0 = SyncAverager(t0, dht0, mem0, join_timeout=8.0, gather_timeout=8.0)
+
+        t1 = ChaosTransport(seed=2, delay_s=0.3)
+        dht1 = DHTNode(t1)
+        await dht1.start(bootstrap=[t0.addr])
+        mem1 = SwarmMembership(dht1, "j1", ttl=10.0)
+        await mem1.join()
+        a1 = SyncAverager(t1, dht1, mem1, join_timeout=8.0, gather_timeout=8.0)
+
+        try:
+            r = await asyncio.gather(
+                a0.average({"w": np.full((4,), 0.0, np.float32)}, 0),
+                a1.average({"w": np.full((4,), 4.0, np.float32)}, 0),
+            )
+            assert r[0] is not None and r[1] is not None
+            np.testing.assert_allclose(r[0]["w"], np.full((4,), 2.0), rtol=1e-6)
+        finally:
+            await t0.close()
+            await t1.close()
+
+    run(scenario())
