@@ -1,0 +1,54 @@
+"""Platform pinning that survives the sandbox's eager jax pre-import.
+
+The sandbox's sitecustomize registers the axon TPU PJRT plugin at interpreter
+startup, importing jax and pinning ``jax_platforms`` before any user code
+runs — so setting ``JAX_PLATFORMS`` in the environment (or in ``os.environ``
+from Python) is silently ignored. The only reliable override is
+``jax.config.update("jax_platforms", ...)`` applied before the first backend
+init. This helper is the single home for that workaround; bench.py,
+__graft_entry__.py, and tests/conftest.py all route through it so a future
+sitecustomize change has one place to fix.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def pin_platform(
+    platform: Optional[str] = None, min_host_devices: Optional[int] = None
+) -> Optional[str]:
+    """Pin jax's platform at the config level; optionally guarantee N virtual
+    CPU devices.
+
+    ``platform=None`` honors the ``JAX_PLATFORMS`` env var if set (restoring
+    its expected semantics), otherwise leaves the platform alone.
+    ``min_host_devices`` appends ``--xla_force_host_platform_device_count`` to
+    ``XLA_FLAGS`` when absent — effective only if called before the first
+    backend init. Returns the platform pinned, or None if untouched.
+    """
+    if min_host_devices is not None:
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={min_host_devices}"
+            )
+        elif int(m.group(1)) < min_host_devices:
+            # A smaller existing count wouldn't give the promised minimum;
+            # raise it (effective only before the first backend init).
+            os.environ["XLA_FLAGS"] = (
+                flags[: m.start()]
+                + f"--xla_force_host_platform_device_count={min_host_devices}"
+                + flags[m.end() :]
+            )
+
+    want = platform if platform is not None else os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    return want or None

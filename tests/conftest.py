@@ -8,17 +8,15 @@ localhost processes.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses spawned by swarm tests
 
 # The sandbox's sitecustomize imports jax at interpreter startup (to register
 # the axon TPU plugin), so jax.config has already snapshotted JAX_PLATFORMS —
-# override via config, not just env.
-import jax  # noqa: E402
+# override via config, not just env (utils/jaxenv.py is the single home for
+# this workaround).
+from distributedvolunteercomputing_tpu.utils.jaxenv import pin_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_platform("cpu", min_host_devices=8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
