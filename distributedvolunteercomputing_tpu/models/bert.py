@@ -43,12 +43,14 @@ def _layer_init(rng: jax.Array, cfg: BertConfig) -> common.Params:
 
 
 def init(rng: jax.Array, cfg: BertConfig) -> common.Params:
-    keys = jax.random.split(rng, cfg.n_layers + 3)
+    keys = jax.random.split(rng, 4)
     return {
         "wte": common.embed_init(keys[0], cfg.vocab, cfg.d_model),
         "wpe": common.embed_init(keys[1], cfg.max_len, cfg.d_model, scale=0.01),
         "ln_emb": common.layernorm_init(cfg.d_model),
-        "blocks": [_layer_init(keys[3 + i], cfg) for i in range(cfg.n_layers)],
+        "blocks": common.stacked_init(
+            lambda k: _layer_init(k, cfg), keys[3], cfg.n_layers
+        ),
         "mlm_dense": common.dense_init(keys[2], cfg.d_model, cfg.d_model, scale=0.02),
         "ln_mlm": common.layernorm_init(cfg.d_model),
     }
@@ -63,24 +65,31 @@ def _block(p: common.Params, x: jax.Array, cfg: BertConfig) -> jax.Array:
     return common.layernorm(p["ln2"], x + h)
 
 
-def forward(params: common.Params, tokens: jax.Array, cfg: BertConfig) -> jax.Array:
+def hidden(params: common.Params, tokens: jax.Array, cfg: BertConfig) -> jax.Array:
+    """MLM-head hidden states [B, T, d] (before the tied vocab projection)."""
     dtype = common.compute_dtype()
     t = tokens.shape[1]
     x = (params["wte"][tokens] + params["wpe"][:t][None]).astype(dtype)
     x = common.layernorm(params["ln_emb"], x)
-    blk = jax.checkpoint(lambda p, h: _block(p, h, cfg)) if cfg.remat else (
-        lambda p, h: _block(p, h, cfg)
+    x = common.scan_blocks(
+        lambda p, h: _block(p, h, cfg), params["blocks"], x, remat=cfg.remat
     )
-    for p in params["blocks"]:
-        x = blk(p, x)
     h = jax.nn.gelu(common.dense(params["mlm_dense"], x))
-    h = common.layernorm(params["ln_mlm"], h)
-    return jnp.einsum("btd,vd->btv", h, params["wte"].astype(dtype)).astype(jnp.float32)
+    return common.layernorm(params["ln_mlm"], h)
+
+
+def forward(params: common.Params, tokens: jax.Array, cfg: BertConfig) -> jax.Array:
+    h = hidden(params, tokens, cfg)
+    return jnp.einsum(
+        "btd,vd->btv", h, params["wte"].astype(h.dtype)
+    ).astype(jnp.float32)
 
 
 def loss_fn(
     params: common.Params, batch: Dict[str, jax.Array], rng: jax.Array, cfg: BertConfig
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    logits = forward(params, batch["tokens"], cfg)
-    loss = common.softmax_xent(logits, batch["targets"], mask=batch["mask"])
+    h = hidden(params, batch["tokens"], cfg)
+    loss = common.lm_xent_chunked(
+        h, params["wte"], batch["targets"], mask=batch["mask"], head_layout="vd"
+    )
     return loss, {"loss": loss}

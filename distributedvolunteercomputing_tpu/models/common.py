@@ -83,6 +83,90 @@ def softmax_xent(logits: jax.Array, labels: jax.Array, mask: Optional[jax.Array]
     return jnp.mean(nll)
 
 
+def stacked_init(layer_init, rng: jax.Array, n_layers: int) -> Params:
+    """Init ``n_layers`` identical layers as ONE stacked pytree (leading axis
+    = layer). The zoo's transformers scan over this stack (``lax.scan``)
+    instead of unrolling a Python loop, so the XLA program contains each
+    block's HLO once — smaller programs, faster compiles, and the layout the
+    TPU sharding rules (parallel/sharding.py) expect for block weights."""
+    keys = jax.random.split(rng, n_layers)
+    return jax.vmap(layer_init)(keys)
+
+
+def scan_blocks(body, blocks: Params, x: jax.Array, remat: bool = True) -> jax.Array:
+    """Run ``x`` through stacked ``blocks`` with ``lax.scan``; ``body`` is
+    ``(layer_params, x) -> x``. With ``remat`` each layer's activations are
+    rematerialized in backward (checkpoint-per-scan-step), the standard
+    O(sqrt)-free layerwise remat that keeps HBM at one layer's activations."""
+    fn = jax.checkpoint(body) if remat else body
+
+    def step(h, p):
+        return fn(p, h), None
+
+    x, _ = jax.lax.scan(step, x, blocks)
+    return x
+
+
+def _project_vocab(x: jax.Array, head: jax.Array, head_layout: str) -> jax.Array:
+    # f32 accumulation out of the MXU regardless of the bf16 inputs.
+    eq = "...d,vd->...v" if head_layout == "vd" else "...d,dv->...v"
+    return jnp.einsum(eq, x, head.astype(x.dtype), preferred_element_type=jnp.float32)
+
+
+def lm_xent_chunked(
+    x: jax.Array,
+    head: jax.Array,
+    labels: jax.Array,
+    mask: Optional[jax.Array] = None,
+    chunk: int = 128,
+    head_layout: str = "vd",
+) -> jax.Array:
+    """Mean LM cross-entropy WITHOUT materializing the [B, T, V] f32 logits.
+
+    For GPT-2-small shapes (B=8, T=1024, V=50257) the full logits tensor is
+    1.6 GB f32 — and its backward residuals double that. This scans over T in
+    ``chunk``-sized slices with a checkpointed body, so peak memory is one
+    [B, chunk, V] buffer (~206 MB at chunk=128) and the backward pass
+    recomputes each chunk's logits instead of saving them.
+
+    ``head`` is the projection matrix: [V, d] (``head_layout="vd"``, tied
+    embeddings — GPT-2/BERT) or [d, V] (``"dv"``, a separate lm_head — Llama).
+    ``mask`` is an optional 0/1 token mask (MLM objective).
+    """
+    b, t, _ = x.shape
+    if t % chunk != 0:
+        chunk = t  # tiny test configs: single chunk, same math
+    n = t // chunk
+    if n <= 1:
+        logits = _project_vocab(x, head, head_layout)
+        return softmax_xent(logits, labels, mask)
+
+    # [n, B, chunk, ...] so scan's leading axis is the chunk index.
+    xs = jnp.moveaxis(x.reshape(b, n, chunk, x.shape[-1]), 1, 0)
+    ls = jnp.moveaxis(labels.reshape(b, n, chunk), 1, 0)
+    ms = (
+        jnp.moveaxis(mask.astype(jnp.float32).reshape(b, n, chunk), 1, 0)
+        if mask is not None
+        else jnp.ones((n, 1, 1), jnp.float32) * 0  # placeholder, unused
+    )
+    use_mask = mask is not None
+
+    def body(carry, xc_lc_mc):
+        nll_sum, denom = carry
+        xc, lc, mc = xc_lc_mc
+        logits = _project_vocab(xc, head, head_layout)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        nll = logz - gold
+        if use_mask:
+            return (nll_sum + jnp.sum(nll * mc), denom + jnp.sum(mc)), None
+        return (nll_sum + jnp.sum(nll), denom + nll.size * 1.0), None
+
+    zero = jnp.zeros((), jnp.float32)
+    (nll_sum, denom), _ = jax.lax.scan(jax.checkpoint(body), (zero, zero), (xs, ls, ms))
+    return nll_sum / jnp.maximum(denom, 1.0)
+
+
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
 

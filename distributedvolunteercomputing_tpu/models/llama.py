@@ -77,19 +77,26 @@ def _lora_layer_init(rng: jax.Array, cfg: LlamaConfig) -> common.Params:
 
 
 def init(rng: jax.Array, cfg: LlamaConfig) -> common.Params:
-    keys = jax.random.split(rng, cfg.n_layers + 2)
+    keys = jax.random.split(rng, 3)
     base = {
         "wte": common.embed_init(keys[0], cfg.vocab, cfg.d_model),
-        "blocks": [_layer_init(keys[2 + i], cfg) for i in range(cfg.n_layers)],
+        "blocks": common.stacked_init(
+            lambda k: _layer_init(k, cfg), keys[2], cfg.n_layers
+        ),
         "ln_f": common.rmsnorm_init(cfg.d_model),
         "lm_head": _no_bias_dense_init(keys[1], cfg.d_model, cfg.vocab),
     }
     if cfg.lora_rank <= 0:
         return base
-    lora_keys = jax.random.split(jax.random.fold_in(rng, 1), cfg.n_layers)
     return {
         "base": base,
-        "lora": {"blocks": [_lora_layer_init(lora_keys[i], cfg) for i in range(cfg.n_layers)]},
+        "lora": {
+            "blocks": common.stacked_init(
+                lambda k: _lora_layer_init(k, cfg),
+                jax.random.fold_in(rng, 1),
+                cfg.n_layers,
+            )
+        },
     }
 
 
@@ -120,30 +127,41 @@ def _block(p: common.Params, lp: common.Params, x: jax.Array, cfg: LlamaConfig) 
     return x + (gate * up) @ p["w_down"].astype(dtype)
 
 
-def forward(params: common.Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def _trunk(params: common.Params, tokens: jax.Array, cfg: LlamaConfig):
+    """Shared fwd trunk: returns (final hidden [B,T,d], frozen-or-not base)."""
     lora_enabled = cfg.lora_rank > 0
     base = params["base"] if lora_enabled else params
-    lora_p = params["lora"] if lora_enabled else None
     if lora_enabled:
         # Freeze the base: its backward pass is pruned entirely by XLA.
         base = jax.tree_util.tree_map(jax.lax.stop_gradient, base)
     dtype = common.compute_dtype()
     x = base["wte"][tokens].astype(dtype)
-    blk = jax.checkpoint(lambda p, lp, h: _block(p, lp, h, cfg)) if cfg.remat else (
-        lambda p, lp, h: _block(p, lp, h, cfg)
-    )
-    for i, p in enumerate(base["blocks"]):
-        lp = lora_p["blocks"][i] if lora_enabled else None
-        x = blk(p, lp, x)
-    x = common.rmsnorm(base["ln_f"], x)
-    return (x @ base["lm_head"].astype(dtype)).astype(jnp.float32)
+    if lora_enabled:
+        x = common.scan_blocks(
+            lambda pl, h: _block(pl[0], pl[1], h, cfg),
+            (base["blocks"], params["lora"]["blocks"]),
+            x,
+            remat=cfg.remat,
+        )
+    else:
+        x = common.scan_blocks(
+            lambda p, h: _block(p, None, h, cfg), base["blocks"], x, remat=cfg.remat
+        )
+    return common.rmsnorm(base["ln_f"], x), base
+
+
+def forward(params: common.Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    x, base = _trunk(params, tokens, cfg)
+    return (x @ base["lm_head"].astype(x.dtype)).astype(jnp.float32)
 
 
 def loss_fn(
     params: common.Params, batch: Dict[str, jax.Array], rng: jax.Array, cfg: LlamaConfig
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    logits = forward(params, batch["tokens"], cfg)
-    loss = common.softmax_xent(logits, batch["targets"])
+    x, base = _trunk(params, batch["tokens"], cfg)
+    loss = common.lm_xent_chunked(
+        x, base["lm_head"], batch["targets"], head_layout="dv"
+    )
     return loss, {"loss": loss}
 
 

@@ -28,10 +28,13 @@ from typing import Any, List, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# (path regex, spec). First match wins; paths look like "blocks/3/qkv/w".
-# Column-parallel weights are [d_in, d_out] → P(None, "tp"); their biases
-# [d_out] → P("tp"). Row-parallel weights are [d_in, d_out] → P("tp", None);
-# their biases are full-size → replicated.
+# (path regex, spec). First match wins; paths look like "blocks/qkv/w"
+# (stacked scan-over-layers layout: leaves carry a leading n_layers axis).
+# Column-parallel weights are [L, d_in, d_out] → sharded on d_out; their
+# biases [L, d_out] → sharded on d_out. Row-parallel weights are sharded on
+# d_in; their biases are full-size → replicated. Specs below are written for
+# the TRAILING dims and right-aligned by _fit_spec, so the same rule covers a
+# stacked leaf and an unstacked one (e.g. lm_head, which has no layer axis).
 _RULES: List[Tuple[str, P]] = [
     (r".*/(qkv|mlp_in)/w$", P(None, "tp")),
     (r".*/(qkv|mlp_in)/b$", P("tp")),
@@ -55,11 +58,15 @@ def _path_str(path: Tuple[Any, ...]) -> str:
 
 
 def _fit_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
-    """Pad the spec to the leaf's rank and drop axes that don't divide."""
+    """RIGHT-align the spec to the leaf's rank (leading dims replicated) and
+    drop axes that don't divide. Right-alignment is what makes one rule serve
+    both stacked [L, d_in, d_out] block weights and unstacked [d_in, d_out]
+    ones: the feature dims are always the trailing dims."""
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    pad = len(shape) - len(spec)
     out = []
     for dim in range(len(shape)):
-        axis = spec[dim] if dim < len(spec) else None
+        axis = spec[dim - pad] if dim >= pad else None
         if axis is not None and shape[dim] % axis_sizes.get(axis, 1) != 0:
             axis = None
         out.append(axis)

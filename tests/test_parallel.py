@@ -38,15 +38,19 @@ def test_make_mesh_shapes(eight_devices):
 
 def test_partition_rules(eight_devices):
     mesh = make_mesh(dp=2, tp=4)
-    # column-parallel
+    # column-parallel, stacked scan-over-layers layout (leading L axis)
+    assert partition_spec_for_path("blocks/qkv/w", (2, 64, 192), mesh) == P(None, None, "tp")
+    assert partition_spec_for_path("blocks/wq", (2, 64, 64), mesh) == P(None, None, "tp")
+    # same rules right-align onto unstacked leaves
     assert partition_spec_for_path("blocks/0/qkv/w", (64, 192), mesh) == P(None, "tp")
-    assert partition_spec_for_path("blocks/3/wq", (64, 64), mesh) == P(None, "tp")
     # row-parallel
-    assert partition_spec_for_path("blocks/0/attn_out/w", (64, 64), mesh) == P("tp", None)
-    assert partition_spec_for_path("blocks/1/w_down", (128, 64), mesh) == P("tp", None)
+    assert partition_spec_for_path("blocks/attn_out/w", (2, 64, 64), mesh) == P(None, "tp", None)
+    assert partition_spec_for_path("blocks/w_down", (2, 128, 64), mesh) == P(None, "tp", None)
+    # stacked column-parallel bias: shard the trailing feature dim
+    assert partition_spec_for_path("blocks/qkv/b", (2, 192), mesh) == P(None, "tp")
     # default replicated
     assert partition_spec_for_path("wte", (50257, 768), mesh) == P()
-    assert partition_spec_for_path("blocks/0/ln1/g", (64,), mesh) == P()
+    assert partition_spec_for_path("blocks/ln1/g", (2, 64), mesh) == P()
 
 
 def test_divisibility_fallback(eight_devices):
@@ -60,8 +64,8 @@ def test_param_shardings_cover_tree(eight_devices):
     bundle = get_model("gpt2_small", **TINY_GPT2)
     params = bundle.init(jax.random.PRNGKey(0))
     shardings = make_param_shardings(mesh, params)
-    qkv = shardings["blocks"][0]["qkv"]["w"]
-    assert qkv.spec == P(None, "tp")
+    qkv = shardings["blocks"]["qkv"]["w"]
+    assert qkv.spec == P(None, None, "tp")
     assert shardings["wte"].spec == P()
 
 
@@ -89,8 +93,8 @@ def test_sharded_step_matches_single_device(eight_devices, dp, tp):
         float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-4
     )
     # params after one step agree leaf-for-leaf
-    ref_leaf = ref_state.params["blocks"][0]["qkv"]["w"]
-    got_leaf = jax.device_get(state.params["blocks"][0]["qkv"]["w"])
+    ref_leaf = ref_state.params["blocks"]["qkv"]["w"]
+    got_leaf = jax.device_get(state.params["blocks"]["qkv"]["w"])
     np.testing.assert_allclose(got_leaf, np.asarray(ref_leaf), rtol=1e-3, atol=1e-5)
     # and a second step runs (no recompilation blowups / donation issues)
     state, metrics2 = step(state, sbatch)
@@ -106,7 +110,7 @@ def test_sharded_step_llama_lora(eight_devices):
     mesh = make_mesh(dp=2, tp=4)
     state = TrainState.create(bundle.init(jax.random.PRNGKey(0)), tx, jax.random.PRNGKey(2))
     state, shardings = shard_train_state(state, mesh, tx)
-    assert shardings["base"]["blocks"][0]["wq"].spec == P(None, "tp")
+    assert shardings["base"]["blocks"]["wq"].spec == P(None, None, "tp")
     assert shardings["base"]["lm_head"].spec == P(None, "tp")
     step = make_sharded_train_step(bundle.loss_fn, tx, mesh, donate=False)
     batch = put_batch(bundle.make_batch(jax.random.PRNGKey(1), 16), mesh)
