@@ -8,15 +8,24 @@ publishes no numbers ("published": {}, BASELINE.json:13), so vs_baseline is
 reported against this framework's own first recorded number (ratchet), 1.0
 when no prior record exists.
 
-Hardening (round-1 failure was an unhandled `Unable to initialize backend
-'axon'` — BENCH_r01 rc=1 with no JSON at all):
-  - backend init is retried with exponential backoff (DVC_BENCH_INIT_RETRIES);
-  - OOM during compile/warmup auto-halves the batch down to 1 and reports the
-    batch actually used;
-  - on persistent failure a diagnostic JSON line is still printed (value 0.0,
-    "error" field) and the exit code is nonzero;
-  - tokens/sec and estimated MFU (6 * n_params * tokens/sec / peak bf16
-    FLOP/s) are reported next to samples/sec/chip for LM workloads.
+Failure-mode history on the axon "TPU v5 lite" chip (BENCH_r01/r02 + the
+round-2 judge's hands-on bisect):
+  - r01: backend init raised `Unavailable` → handled by in-child init retries.
+  - r02: `ResourceExhausted` in the FORWARD pass at batch=1 on a chip where a
+    single 15 GB allocation succeeds — i.e. NOT activation-memory-driven, so
+    batch halving can never fix it. The identical config passes in some fresh
+    processes (state/order-dependent backend quirk), so retries must happen at
+    FRESH-CHILD granularity: every attempt below is its own process.
+  - also observed: silent hangs in backend init (r01 MULTICHIP rc=124) →
+    every attempt runs under a hard per-attempt deadline carved from the
+    total budget (DVC_BENCH_BUDGET), and hang kills salvage any JSON the
+    child printed before stalling in libtpu teardown.
+
+The attempt ladder keeps the METRIC fixed (same model, same batch) and only
+shrinks the program if plain fresh retries fail: attempts 3+ cast params to
+bf16 (halves every param/optimizer allocation). On failure the child reports
+the failing stage (init/opt_init/warmup/measure) and device.memory_stats()
+so the next round never diagnoses blind.
 """
 
 from __future__ import annotations
@@ -50,13 +59,165 @@ def _peak_flops(device_kind: str) -> float | None:
     return None
 
 
-def _is_oom(err: BaseException) -> bool:
-    msg = str(err)
-    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "OOM" in msg
-
-
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
+
+
+def _memory_stats() -> dict | None:
+    """Best-effort device memory stats for failure diagnostics."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return None
+        keep = (
+            "bytes_in_use",
+            "peak_bytes_in_use",
+            "bytes_limit",
+            "largest_alloc_size",
+            "num_allocs",
+        )
+        return {k: int(v) for k, v in stats.items() if k in keep}
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------- parent ----
+
+# Attempt ladder: env overrides per fresh child. The first two attempts are
+# the unmodified flagship config — the r02 bisect showed the identical config
+# passes in some fresh processes, so a plain fresh retry has a real success
+# path that in-child batch-halving lacked. Later rungs shrink allocations
+# without changing the metric's batch size.
+_LADDER = (
+    {},
+    {},
+    {"DVC_BENCH_PARAM_DTYPE": "bfloat16"},
+    {"DVC_BENCH_PARAM_DTYPE": "bfloat16", "DVC_BENCH_ITERS": "10"},
+)
+
+
+def main() -> int:
+    if os.environ.get("DVC_BENCH_CHILD") == "1":
+        return _bench_main()
+
+    import subprocess
+
+    budget = float(os.environ.get("DVC_BENCH_BUDGET", "540"))
+    model_name = os.environ.get("DVC_BENCH_MODEL", "gpt2_small")
+    n_attempts = max(int(os.environ.get("DVC_BENCH_ATTEMPTS", str(len(_LADDER)))), 1)
+    t_start = time.monotonic()
+    last_diag: dict | None = None
+    last_err = "bench child never ran"
+
+    for attempt in range(n_attempts):
+        remaining = budget - (time.monotonic() - t_start)
+        attempts_left = n_attempts - attempt
+        if remaining < 45 and attempt > 0:
+            last_err = f"budget exhausted before attempt {attempt + 1}"
+            break
+        # First attempt gets the biggest slice: the dominant cost is the
+        # one-off XLA compile (tens of seconds on this chip), and a
+        # too-tight deadline would misclassify slow-compile as hang.
+        deadline = max(remaining / attempts_left, 45.0)
+        if attempt == 0 and n_attempts > 1:
+            deadline = max(deadline, remaining * 0.45)
+        overrides = _LADDER[min(attempt, len(_LADDER) - 1)]
+        env = dict(os.environ, DVC_BENCH_CHILD="1", **overrides)
+        print(
+            f"bench: attempt {attempt + 1}/{n_attempts} deadline={deadline:.0f}s "
+            f"overrides={overrides}",
+            file=sys.stderr,
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                timeout=deadline,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired as exc:
+            # The child may have printed its result and then hung in libtpu
+            # teardown — salvage the measurement from the captured output.
+            partial = exc.stdout or ""
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            ok = _passthrough_json(partial)
+            if ok is not None:
+                return ok
+            # A diagnostic JSON (value 0.0 with stage/memory_stats) printed
+            # before the child stalled in teardown is still the best evidence
+            # we have — keep it for the final report.
+            salvage_lines = [l for l in partial.splitlines() if l.startswith("{")]
+            salvaged = _parse_last(salvage_lines) if salvage_lines else None
+            if salvaged:
+                last_diag = salvaged
+            child_err = exc.stderr or b""
+            if isinstance(child_err, bytes):
+                child_err = child_err.decode(errors="replace")
+            last_err = (
+                f"attempt {attempt + 1}: child hung past {deadline:.0f}s deadline; "
+                f"stderr tail: {child_err[-200:]!r}"
+            )
+            print(f"bench: {last_err}", file=sys.stderr)
+            continue
+        sys.stderr.write(proc.stderr)
+        json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        if json_lines:
+            payload = _parse_last(json_lines)
+            if payload and payload.get("value", 0) > 0:
+                for line in proc.stdout.splitlines():
+                    print(line)
+                return proc.returncode
+            # Diagnostic JSON from a failed child: keep it, try next rung.
+            if payload:
+                last_diag = payload
+                last_err = str(payload.get("error", "unknown child failure"))[:300]
+            print(f"bench: attempt {attempt + 1} failed: {last_err}", file=sys.stderr)
+            continue
+        last_err = (
+            f"attempt {attempt + 1}: child exited rc={proc.returncode} without JSON "
+            f"(signal/native crash likely); stderr tail: {proc.stderr[-300:]!r}"
+        )
+        print(f"bench: {last_err}", file=sys.stderr)
+
+    diag = last_diag or {}
+    _emit(
+        {
+            "metric": f"samples/sec/volunteer-chip ({model_name})",
+            "value": 0.0,
+            "unit": "samples/sec/chip",
+            "vs_baseline": 0.0,
+            "error": last_err[:600],
+            "stage": diag.get("stage"),
+            "memory_stats": diag.get("memory_stats"),
+            "attempts": n_attempts,
+        }
+    )
+    return 1
+
+
+def _parse_last(json_lines: list[str]) -> dict | None:
+    try:
+        return json.loads(json_lines[-1])
+    except ValueError:
+        return None
+
+
+def _passthrough_json(stdout: str) -> int | None:
+    """If a (possibly hung) child printed a success JSON line, pass it on."""
+    json_lines = [l for l in stdout.splitlines() if l.startswith("{")]
+    payload = _parse_last(json_lines) if json_lines else None
+    if payload and payload.get("value", 0) > 0:
+        for line in json_lines:
+            print(line)
+        return 0
+    return None
+
+
+# ----------------------------------------------------------------- child ----
 
 
 def _devices_with_retry(retries: int, base_delay: float):
@@ -100,118 +261,6 @@ def _devices_with_retry(retries: int, base_delay: float):
     raise last
 
 
-def _run_once(bundle, tx, batch_size: int, warmup: int, iters: int) -> dict:
-    """One full measurement at a fixed batch size. Raises on OOM (caller
-    halves and retries). State is rebuilt per attempt because the jitted step
-    donates it."""
-    import jax
-
-    from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
-
-    params = bundle.init(jax.random.PRNGKey(1))
-    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
-    state = TrainState.create(params, tx, jax.random.PRNGKey(2))
-    del params  # donated into state's first step
-    step = make_train_step(bundle.loss_fn, tx)
-    batch = bundle.make_batch(jax.random.PRNGKey(0), batch_size)
-
-    for _ in range(warmup):
-        state, m = step(state, batch)
-    # float() (host copy), not block_until_ready: on some backends execution
-    # errors (e.g. OOM) only surface when the value is materialized, and a
-    # benchmark that times a failed computation reports fiction.
-    float(m["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, batch)
-    final_loss = float(m["loss"])
-    dt = time.perf_counter() - t0
-    if not math.isfinite(final_loss):
-        raise RuntimeError(f"non-finite loss during benchmark: {final_loss}")
-
-    # The single-volunteer step runs on the default device only; divide by the
-    # devices the computation actually uses, not everything visible.
-    n_chips = len(m["loss"].sharding.device_set)
-    return {
-        "dt": dt,
-        "loss": final_loss,
-        "n_chips": n_chips,
-        "n_params": n_params,
-    }
-
-
-def main() -> int:
-    """Watchdog wrapper: run the measurement in a child process with a hard
-    deadline. The axon TPU plugin can HANG (not fail) inside backend init —
-    observed this round: jax.devices() blocked >300s with the plugin
-    registered — and a hang in the driver's bench run burns its whole timeout
-    (round-1 MULTICHIP rc=124 was the same pathology). The child inherits
-    stdout, so on success its JSON line is the only output."""
-    if os.environ.get("DVC_BENCH_CHILD") == "1":
-        return _bench_main()
-
-    import subprocess
-
-    deadline = float(os.environ.get("DVC_BENCH_DEADLINE", "540"))
-    attempts = max(int(os.environ.get("DVC_BENCH_HANG_RETRIES", "1")), 1)
-    model_name = os.environ.get("DVC_BENCH_MODEL", "gpt2_small")
-    env = dict(os.environ, DVC_BENCH_CHILD="1")
-    last_err = "bench child never ran"
-    for attempt in range(attempts):
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env,
-                timeout=deadline,
-                capture_output=True,
-                text=True,
-            )
-        except subprocess.TimeoutExpired as exc:
-            # The child may have printed its result and then hung in libtpu
-            # teardown — salvage the measurement from the captured output.
-            partial = exc.stdout or ""
-            if isinstance(partial, bytes):
-                partial = partial.decode(errors="replace")
-            json_lines = [l for l in partial.splitlines() if l.startswith("{")]
-            if json_lines:
-                for line in json_lines:
-                    print(line)
-                return 0
-            last_err = (
-                f"bench child hung past {deadline:.0f}s deadline "
-                f"(attempt {attempt + 1}/{attempts}; TPU backend init never returned)"
-            )
-            print(f"bench: {last_err}", file=sys.stderr)
-            continue
-        sys.stderr.write(proc.stderr)
-        # Pass the child's JSON line through; if the child died hard (SIGABRT
-        # from libtpu, OS OOM-kill) without printing one, synthesize the
-        # diagnostic so the driver never sees "nonzero rc, zero JSON" again
-        # (that was the round-1 failure shape).
-        emitted_json = False
-        for line in proc.stdout.splitlines():
-            if line.startswith("{"):
-                emitted_json = True
-            print(line)
-        if emitted_json:
-            return proc.returncode
-        last_err = (
-            f"bench child exited rc={proc.returncode} without emitting JSON "
-            f"(signal/native crash likely); stderr tail: {proc.stderr[-300:]!r}"
-        )
-    _emit(
-        {
-            "metric": f"samples/sec/volunteer-chip ({model_name})",
-            "value": 0.0,
-            "unit": "samples/sec/chip",
-            "vs_baseline": 0.0,
-            "error": last_err[:600],
-        }
-    )
-    return 1
-
-
 def _bench_main() -> int:
     model_name = os.environ.get("DVC_BENCH_MODEL", "gpt2_small")
     batch_size = int(os.environ.get("DVC_BENCH_BATCH", "8"))
@@ -219,54 +268,91 @@ def _bench_main() -> int:
     iters = int(os.environ.get("DVC_BENCH_ITERS", "20"))
     retries = max(int(os.environ.get("DVC_BENCH_INIT_RETRIES", "3")), 1)
     base_delay = float(os.environ.get("DVC_BENCH_INIT_BACKOFF", "5"))
+    param_dtype = os.environ.get("DVC_BENCH_PARAM_DTYPE", "")
     metric_name = f"samples/sec/volunteer-chip ({model_name})"
+    stage = "backend_init"
 
-    try:
-        devs = _devices_with_retry(retries, base_delay)
-    except Exception as err:
+    def fail(err: BaseException | str) -> int:
         _emit(
             {
                 "metric": metric_name,
                 "value": 0.0,
                 "unit": "samples/sec/chip",
                 "vs_baseline": 0.0,
-                "error": f"backend init failed after {retries} attempts: {err}"[:500],
+                "error": f"{type(err).__name__}: {err}"[:500]
+                if isinstance(err, BaseException)
+                else str(err)[:500],
+                "stage": stage,
+                "memory_stats": _memory_stats(),
+                "param_dtype": param_dtype or "float32",
+                "batch_size": batch_size,
             }
         )
         return 1
 
+    t_child = time.monotonic()
+
+    def progress(msg: str) -> None:
+        print(f"bench-child [{time.monotonic() - t_child:5.1f}s]: {msg}", file=sys.stderr, flush=True)
+
+    try:
+        devs = _devices_with_retry(retries, base_delay)
+    except Exception as err:
+        return fail(err)
+    progress(f"backend up: {devs[0].device_kind}")
+
+    import jax
+    import jax.numpy as jnp
+
     from distributedvolunteercomputing_tpu.models import get_model
     from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+    from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
 
     bundle = get_model(model_name)
     tx = make_optimizer("adamw", lr=1e-4)
 
-    bs = batch_size
-    result = None
-    while True:
-        try:
-            result = _run_once(bundle, tx, bs, warmup, iters)
-            break
-        except Exception as err:
-            if _is_oom(err) and bs > 1:
-                print(
-                    f"bench: OOM at batch={bs}, retrying at {bs // 2}",
-                    file=sys.stderr,
-                )
-                bs //= 2
-                continue
-            _emit(
-                {
-                    "metric": metric_name,
-                    "value": 0.0,
-                    "unit": "samples/sec/chip",
-                    "vs_baseline": 0.0,
-                    "error": f"{type(err).__name__}: {err}"[:500],
-                }
+    try:
+        stage = "init"
+        params = bundle.init(jax.random.PRNGKey(1))
+        if param_dtype:
+            dt = jnp.dtype(param_dtype)
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params,
             )
-            return 1
+        n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+        stage = "opt_init"
+        state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+        del params  # donated into state's first step
+        step = make_train_step(bundle.loss_fn, tx)
+        batch = bundle.make_batch(jax.random.PRNGKey(0), batch_size)
 
-    samples_per_sec_chip = bs * iters / result["dt"] / result["n_chips"]
+        progress(f"state built ({n_params / 1e6:.1f}M params); compiling")
+        stage = "warmup"
+        for _ in range(warmup):
+            state, m = step(state, batch)
+        # float() (host copy), not block_until_ready: on some backends
+        # execution errors (e.g. OOM) only surface when the value is
+        # materialized, and a benchmark that times a failed computation
+        # reports fiction.
+        float(m["loss"])
+
+        progress("warmup done; measuring")
+        stage = "measure"
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, batch)
+        final_loss = float(m["loss"])
+        dt_s = time.perf_counter() - t0
+        if not math.isfinite(final_loss):
+            raise RuntimeError(f"non-finite loss during benchmark: {final_loss}")
+    except Exception as err:
+        return fail(err)
+
+    # The single-volunteer step runs on the default device only; divide by the
+    # devices the computation actually uses, not everything visible.
+    n_chips = len(m["loss"].sharding.device_set)
+    samples_per_sec_chip = batch_size * iters / dt_s / n_chips
 
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".bench_baseline.json"
@@ -278,36 +364,43 @@ def _bench_main() -> int:
             prior = json.load(fh)
     except (OSError, ValueError):
         pass
-    # Ratchet only against a record at the SAME effective batch size —
-    # comparing a full-batch run against an OOM-halved record (or vice versa)
-    # reports batch-size arithmetic, not a perf delta.
+    # Ratchet only against a record at the SAME batch size AND param dtype —
+    # comparing across either reports configuration arithmetic, not a perf
+    # delta (the ladder's bf16 rung is faster by construction).
+    dtype_key = param_dtype or "float32"
     if (
         prior.get("model") == model_name
         and prior.get("value")
-        and prior.get("batch_size") == bs
+        and prior.get("batch_size") == batch_size
+        and prior.get("param_dtype", "float32") == dtype_key
     ):
         vs_baseline = samples_per_sec_chip / float(prior["value"])
     elif prior.get("model") != model_name or not prior.get("value"):
         try:
             with open(baseline_path, "w") as fh:
                 json.dump(
-                    {"model": model_name, "value": samples_per_sec_chip, "batch_size": bs},
+                    {
+                        "model": model_name,
+                        "value": samples_per_sec_chip,
+                        "batch_size": batch_size,
+                        "param_dtype": dtype_key,
+                    },
                     fh,
                 )
         except OSError:
             pass
 
     payload = {
-        "metric": f"samples/sec/volunteer-chip ({model_name}, bs={bs})",
+        "metric": f"samples/sec/volunteer-chip ({model_name}, bs={batch_size})",
         "value": round(samples_per_sec_chip, 3),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
-        "batch_size": bs,
-        "requested_batch_size": batch_size,
-        "n_chips": result["n_chips"],
+        "batch_size": batch_size,
+        "n_chips": n_chips,
         "device_kind": devs[0].device_kind,
-        "loss": round(result["loss"], 4),
-        "n_params": result["n_params"],
+        "loss": round(final_loss, 4),
+        "n_params": n_params,
+        "param_dtype": param_dtype or "float32",
     }
     seq_len = getattr(bundle.config, "max_len", None)
     if seq_len:
@@ -317,9 +410,7 @@ def _bench_main() -> int:
         if peak:
             # 6ND convention (fwd 2ND + bwd 4ND); remat recompute not counted,
             # so this is a lower bound on hardware utilization.
-            payload["est_mfu"] = round(
-                6.0 * result["n_params"] * tokens_per_sec / peak, 4
-            )
+            payload["est_mfu"] = round(6.0 * n_params * tokens_per_sec / peak, 4)
     _emit(payload)
     return 0
 
