@@ -201,7 +201,13 @@ def bf16_to_f32(bits: np.ndarray) -> np.ndarray:
 def weighted_sum_inplace(acc: np.ndarray, x: np.ndarray, w: float) -> None:
     """acc += w * x over float32 buffers — the sync leader's streaming
     weighted-mean accumulation (swarm/averager.py _lead_round)."""
-    assert acc.dtype == np.float32 and x.dtype == np.float32 and acc.size == x.size
+    # ValueError, not assert: this guards the native kernel's dtype/size
+    # contract (out-of-bounds read if violated) and must survive `python -O`.
+    if acc.dtype != np.float32 or x.dtype != np.float32 or acc.size != x.size:
+        raise ValueError(
+            f"weighted_sum_inplace needs matching float32 buffers, got "
+            f"{acc.dtype}[{acc.size}] += w * {x.dtype}[{x.size}]"
+        )
     lib = get_lib()
     if lib is not None and acc.flags.c_contiguous and x.flags.c_contiguous:
         lib.dvc_weighted_sum(_ptr(acc, ctypes.c_float), _ptr(x, ctypes.c_float), w, acc.size)

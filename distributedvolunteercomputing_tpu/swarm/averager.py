@@ -120,6 +120,10 @@ class AveragerBase:
     # attacker-driven memory to ROUNDS x CONTRIBS x payload even if the local
     # trainer never averages again.
     MAX_PARKED_ROUNDS = 32
+    # Per-round cap on parked contributions (param-sized buffers under
+    # unvalidated peer ids). One bound for every subclass that parks — a
+    # per-subclass copy is how the byz path shipped uncapped in round 1.
+    MAX_PARKED_CONTRIBS = 64
 
     def _sweep_rounds(self, rounds: Dict[str, "_Round"], max_age: Optional[float] = None) -> None:
         """Evict stale round state (parked contributions hold param-sized
@@ -210,11 +214,6 @@ class SyncAverager(AveragerBase):
         self._rounds: Dict[str, _Round] = {}
         self.transport.register("sync.contribute", self._rpc_contribute)
         self.transport.register("sync.fetch", self._rpc_fetch)
-
-    # A round accepts at most this many parked contributions: tokens are only
-    # validated at aggregation time, so without a cap a flooder could park
-    # unbounded param-sized buffers under fabricated (peer, token) pairs.
-    MAX_PARKED_CONTRIBS = 64
 
     async def _rpc_contribute(self, args: dict, payload: bytes):
         if not self._check_schema(args):
@@ -594,8 +593,14 @@ class ByzantineAverager(AveragerBase):
         # Contribution can arrive before we enter the round: park it
         # (swept + capped against fabricated-epoch flooding).
         st = self._get_or_park_round(self._rounds, args["epoch"])
+        if st.expected and peer not in st.expected:
+            # Round membership is known: reject outsiders outright instead of
+            # parking them (they'd be dropped at aggregation anyway).
+            raise RPCError("peer is not a member of this round")
         if peer in st.contribs:
             raise RPCError("duplicate contribution for peer (first write wins)")
+        if not st.expected and len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
+            raise RPCError("round contribution cap reached")
         buf = self._buf_from_payload(payload)
         st.contribs[peer] = (float(args["weight"]), buf)
         if st.expected and set(st.contribs) >= st.expected:

@@ -53,7 +53,8 @@ class VolunteerConfig:
     data_path: Optional[str] = None  # .npz real-data file; None = synthetic
     optimizer: str = "adam"
     lr: float = 1e-3
-    seed: int = 0
+    seed: int = 0  # per-volunteer: data order + step rng
+    init_seed: int = 0  # TASK-constant: shared initial params (see Trainer)
     steps: int = 1000
     target_loss: Optional[float] = None
     metrics_path: Optional[str] = None
@@ -149,13 +150,17 @@ class Volunteer:
 
         data = None
         if self.cfg.data_path:
+            import zlib
+
             from distributedvolunteercomputing_tpu.training.data import npz_batch_iter
 
             # Seeded per-peer so volunteers shard the shuffle order, not the
             # data: every volunteer sees the full file in a different order.
+            # crc32, not hash(): PYTHONHASHSEED randomization would make the
+            # per-peer order non-reproducible across restarts.
             data = npz_batch_iter(
                 self.cfg.data_path, self.cfg.batch_size,
-                seed=hash(self.cfg.peer_id) & 0x7FFFFFFF,
+                seed=zlib.crc32(self.cfg.peer_id.encode()) & 0x7FFFFFFF,
             )
         self.trainer = Trainer(
             bundle,
@@ -164,6 +169,7 @@ class Volunteer:
             optimizer=self.cfg.optimizer,
             lr=self.cfg.lr,
             seed=self.cfg.seed,
+            init_seed=self.cfg.init_seed,
             average_every=self.cfg.average_every,
             averager=self._averager_callback if self.averager else None,
             average_what=self.cfg.average_what,

@@ -42,6 +42,7 @@ class Trainer:
         optimizer: str = "adamw",
         lr: float = 1e-3,
         seed: int = 0,
+        init_seed: int = 0,
         average_every: int = 10,
         averager: Optional[AveragerFn] = None,
         # params: local-SGD, averaged every `average_every` steps.
@@ -61,10 +62,19 @@ class Trainer:
         self.average_every = average_every
         self.averager = averager
         self.average_what = average_what
+        # ``seed`` is PER-VOLUNTEER: it drives the data order and the step
+        # rng, so volunteers see different batches. ``init_seed`` is
+        # TASK-CONSTANT: every volunteer training the same task must build
+        # the same initial params — for LoRA models this is load-bearing
+        # (the frozen base is NEVER averaged, so adapters averaged across
+        # volunteers are deltas against one shared base; with per-volunteer
+        # bases the average would be semantically meaningless), and for full
+        # models it makes round 1 start contracted instead of spending early
+        # rounds averaging away init noise.
         rng = jax.random.PRNGKey(seed)
-        init_rng, data_rng, state_rng = jax.random.split(rng, 3)
+        _, data_rng, state_rng = jax.random.split(rng, 3)
         self.tx = make_optimizer(optimizer, lr=lr, total_steps=total_steps)
-        params = bundle.init(init_rng)
+        params = bundle.init(jax.random.PRNGKey(init_seed))
         self.state = TrainState.create(params, self.tx, state_rng)
         # Gradient-averaging mode splits the step so grads can cross the WAN
         # between bwd and the optimizer (reference GradientAverager
@@ -204,24 +214,10 @@ class Trainer:
                 and not self._grads_mode
                 and step_no % self.average_every == 0
             ):
-                # Only the bundle-selected payload crosses the WAN (full
-                # params by default; adapters only for LoRA models).
-                payload = self.bundle.avg_select(self.state.params)
-                t_avg = time.monotonic()
-                averaged = self.averager(payload, step_no)
-                # Round wall-clock is THE WAN-tier health number (compute vs
-                # averaging split, SURVEY.md §5 tracing): record it per round.
-                self.metrics.record_event(
-                    step_no, "avg_round",
-                    {"avg_s": time.monotonic() - t_avg, "ok": averaged is not None},
-                )
-                if averaged is not None:
-                    new_params = self.bundle.avg_merge(
-                        self.state.params,
-                        jax.tree_util.tree_map(np.asarray, averaged),
-                    )
+                merged = self._run_average_round(self.state.params, step_no, "params")
+                if merged is not None:
                     self.state = TrainState(
-                        params=jax.device_put(new_params),
+                        params=jax.device_put(merged),
                         opt_state=self.state.opt_state,
                         step=self.state.step,
                         rng=self.state.rng,

@@ -55,7 +55,11 @@ def main() -> None:
                     help=".npz of aligned arrays (keys = the model's batch schema); default synthetic")
     ap.add_argument("--optimizer", default="adam")
     ap.add_argument("--lr", type=float, default=1e-3)
-    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="per-volunteer seed (data order + step rng)")
+    ap.add_argument("--init-seed", type=int, default=0,
+                    help="TASK-constant seed for the initial params; must match "
+                         "across the swarm (for LoRA it pins the shared frozen base)")
     ap.add_argument("--steps", type=int, default=1000)
     ap.add_argument("--target-loss", type=float, default=None)
     ap.add_argument("--metrics", default=None)
@@ -92,6 +96,7 @@ def main() -> None:
         optimizer=args.optimizer,
         lr=args.lr,
         seed=args.seed,
+        init_seed=args.init_seed,
         steps=args.steps,
         target_loss=args.target_loss,
         metrics_path=args.metrics,

@@ -376,6 +376,41 @@ class TestIdentityGuards:
             if r is not None:
                 assert float(np.max(np.abs(r["w"]))) < 10.0
 
+    def test_byzantine_parked_contribution_cap(self):
+        """Before the receiver enters a round, a flooder can park at most
+        MAX_PARKED_CONTRIBS param-sized buffers under fabricated peer ids
+        (ADVICE r1: the sync path was capped, the byz path was not)."""
+
+        async def main():
+            from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+
+            receiver = ByzantineAverager(*await _solo_stack("recv"))
+            receiver.MAX_PARKED_CONTRIBS = 4
+            sender = Transport()
+            await sender.start()
+            try:
+                buf = np.full(17, 1.0, np.float32).tobytes()
+                for i in range(4):
+                    await sender.call(
+                        receiver.transport.addr,
+                        "byz.contribute",
+                        {"epoch": "e1", "peer": f"flood-{i}", "weight": 1.0, "schema": None},
+                        buf,
+                    )
+                with pytest.raises(RPCError):
+                    await sender.call(
+                        receiver.transport.addr,
+                        "byz.contribute",
+                        {"epoch": "e1", "peer": "flood-4", "weight": 1.0, "schema": None},
+                        buf,
+                    )
+                assert len(receiver._rounds["e1"].contribs) == 4
+            finally:
+                await sender.close()
+                await receiver.transport.close()
+
+        run(main())
+
     def test_byzantine_first_write_wins(self):
         """A second contribution under an already-seen peer id is rejected."""
 

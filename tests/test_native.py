@@ -73,6 +73,16 @@ def test_weighted_sum(lib):
     np.testing.assert_allclose(acc, ref, rtol=1e-6)
 
 
+def test_weighted_sum_rejects_contract_violations(lib):
+    # ValueError (not a strippable assert): dtype/size mismatches would be an
+    # out-of-bounds read in the native kernel.
+    acc = np.zeros(8, np.float32)
+    with pytest.raises(ValueError):
+        native.weighted_sum_inplace(acc, np.zeros(4, np.float32), 1.0)
+    with pytest.raises(ValueError):
+        native.weighted_sum_inplace(acc, np.zeros(8, np.float64), 1.0)
+
+
 def test_robust_ops_route_through_native(lib):
     from distributedvolunteercomputing_tpu.ops import robust
 
