@@ -156,8 +156,16 @@ class AveragerBase:
         buf, specs, treedef = flatten_to_buffer(tree)
         if self._schema is None:
             self._specs, self._treedef = specs, treedef
+            # The namespace is part of the schema hash: a params tree and a
+            # grads tree of the same model flatten to IDENTICAL shapes, so
+            # shapes+dtypes+wire alone can't stop a cross-mode payload from
+            # being accepted on the receive path (e.g. a gossip push banked
+            # into the wrong inbox). With the namespace folded in, every
+            # averager's _check_schema rejects it at the door.
             self._schema = hashlib.sha1(
-                repr([(s.shape, s.dtype) for s in specs] + [self.wire]).encode()
+                repr(
+                    [(s.shape, s.dtype) for s in specs] + [self.wire, self.namespace]
+                ).encode()
             ).hexdigest()[:16]
         return buf
 
@@ -399,15 +407,19 @@ class GossipAverager(AveragerBase):
                 continue
             w, buf = self._mix(w, buf, iw, ibuf)
         self._current = (w, buf)
-        # 2. push-pull with one random live peer — same-model peers only
-        # (gossip has no rendezvous key, so the namespace filter happens here;
-        # records without a model field are accepted for compatibility)
+        # 2. push-pull with one random live peer — same-namespace peers only.
+        # Gossip has no rendezvous key, so the namespace filter happens here:
+        # a namespaced averager requires the record's avg_ns (membership
+        # extra_info, volunteer.py) to match EXACTLY — "model/average_what",
+        # so a params-mode peer never mixes with a grads-mode one. A record's
+        # model field alone is NOT enough (it can't distinguish params from
+        # grads trees, which flatten to identical schemas).
         peers = await self.membership.alive_peers(include_self=False)
         targets = [
             (pid, tuple(rec["addr"]))
             for pid, rec in peers.items()
             if "addr" in rec
-            and (not self.namespace or rec.get("model", self.namespace) == self.namespace)
+            and (not self.namespace or rec.get("avg_ns") == self.namespace)
         ]
         mixed = bool(inbox)
         if targets:

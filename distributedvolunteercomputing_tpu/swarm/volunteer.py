@@ -46,6 +46,11 @@ class VolunteerConfig:
     averaging: str = "none"  # none|sync|gossip|butterfly|byzantine
     average_every: int = 10
     average_what: str = "params"  # params (local-SGD) | grads (GradientAverager)
+    # Overlap WAN rounds with local compute (params mode; see Trainer). On by
+    # default: blocking the device for a whole WAN round is what sinks
+    # samples/sec at payload scale (BASELINE.md north-star).
+    overlap: bool = True
+    max_staleness: int = 0  # steps; 0 = unbounded (rounds self-bound via timeouts)
     wire: str = "f32"  # f32|bf16 — WAN payload codec (bf16 halves DCN bytes)
     min_group: int = 2
     max_group: int = 16
@@ -114,7 +119,15 @@ class Volunteer:
         await self.dht.start(bootstrap=bootstrap)
         self.membership = SwarmMembership(
             self.dht, self.cfg.peer_id, ttl=self.cfg.heartbeat_ttl,
-            extra_info={"model": self.cfg.model},
+            extra_info={
+                "model": self.cfg.model,
+                # Full averaging namespace (model/average_what): gossip picks
+                # partners from membership records (no rendezvous key), so the
+                # record must carry the same string the averagers namespace
+                # their rounds by — a params-mode peer must never gossip with
+                # a grads-mode peer on the same model.
+                "avg_ns": f"{self.cfg.model}/{self.cfg.average_what}",
+            },
         )
         await self.membership.join()
         if self.cfg.averaging != "none":
@@ -173,6 +186,8 @@ class Volunteer:
             average_every=self.cfg.average_every,
             averager=self._averager_callback if self.averager else None,
             average_what=self.cfg.average_what,
+            overlap=self.cfg.overlap,
+            max_staleness=self.cfg.max_staleness,
             metrics_path=self.cfg.metrics_path,
             volunteer_id=self.cfg.peer_id,
             total_steps=self.cfg.steps,

@@ -5,10 +5,24 @@ optimizer step -> every K steps, hand params to the averager and continue
 from the averaged result. The averager is injected as a callback so the
 trainer (L5) never imports the swarm (L3/L4) — config 1 (single volunteer,
 no averaging, BASELINE.json:7) is just ``averager=None``.
+
+Params mode can OVERLAP the WAN round with continued local compute
+(``overlap=True``): at an averaging point the trainer snapshots the payload
+to host, hands it to a background thread, and keeps stepping; when the round
+completes it merges Moshpit-style with a delta correction,
+
+    new = averaged + (current - snapshot),
+
+so the local steps taken during the round are preserved on top of the
+contracted average. Grads mode stays synchronous BY DESIGN: GradientAverager
+semantics feed each step's averaged gradient to the optimizer before the
+next step — applying it late would mean stale-gradient SGD, a different
+algorithm, not an optimization.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import os
 import time
 from typing import Any, Callable, Dict, Iterable, Optional
@@ -49,6 +63,11 @@ class Trainer:
         # grads: GradientAverager semantics, averaged EVERY step
         #        (average_every then only sets the host-snapshot cadence).
         average_what: str = "params",
+        # Overlap the WAN round with continued local steps (params mode
+        # only). ``max_staleness`` bounds how many steps a round's result may
+        # lag before it is discarded instead of merged (0 = no bound).
+        overlap: bool = False,
+        max_staleness: int = 0,
         metrics_path: Optional[str] = None,
         volunteer_id: str = "local",
         total_steps: Optional[int] = None,
@@ -80,6 +99,17 @@ class Trainer:
         # between bwd and the optimizer (reference GradientAverager
         # semantics); the fused donate-everything step covers the rest.
         self._grads_mode = averager is not None and average_what == "grads"
+        self.overlap = bool(overlap) and averager is not None and not self._grads_mode
+        self.max_staleness = max_staleness
+        # One worker: rounds never overlap each other, only local compute.
+        self._avg_pool = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="avg-round"
+            )
+            if self.overlap
+            else None
+        )
+        self._inflight: Optional[tuple] = None  # (launch_step, payload0, future)
         if self._grads_mode:
             self._grad_fn = make_grad_step(bundle.loss_fn)
             self._apply_fn = make_apply_step(self.tx)
@@ -131,6 +161,18 @@ class Trainer:
             rng, k = jax.random.split(rng)
             yield self.bundle.make_batch(k, self.batch_size)
 
+    def _swap_params(self, new_params: Any, step_no: int) -> None:
+        """Replace params on device, keep opt_state/step/rng, refresh the
+        cross-thread snapshot. The ONE place a merge becomes live state —
+        the overlap and blocking paths must not diverge here."""
+        self.state = TrainState(
+            params=jax.device_put(new_params),
+            opt_state=self.state.opt_state,
+            step=self.state.step,
+            rng=self.state.rng,
+        )
+        self._take_snapshot(step_no)
+
     def _run_average_round(self, tree: Any, step_no: int, what: str) -> Optional[Any]:
         """One WAN round: select payload -> averager -> record -> merge.
         Returns the merged tree, or None when no group formed / round failed."""
@@ -144,6 +186,68 @@ class Trainer:
         if averaged is None:
             return None
         return self.bundle.avg_merge(tree, jax.tree_util.tree_map(np.asarray, averaged))
+
+    # -- overlapped averaging (params mode) --------------------------------
+
+    def _launch_overlap_round(self, step_no: int) -> None:
+        """Snapshot the payload to HOST and launch the round on the pool.
+
+        The host copy is load-bearing: the jitted step donates the live
+        params' buffers, so the pool thread must never touch device arrays
+        the train thread is about to consume."""
+        payload0 = jax.tree_util.tree_map(
+            np.asarray, self.bundle.avg_select(self.state.params)
+        )
+        t0 = time.monotonic()
+        fut = self._avg_pool.submit(
+            lambda: (self.averager(payload0, step_no), time.monotonic() - t0)
+        )
+        self._inflight = (step_no, payload0, fut)
+
+    def _finish_overlap_round(self, step_no: int, wait: bool = False) -> None:
+        """Merge a completed round: new = averaged + (current - snapshot).
+
+        The delta correction keeps the steps taken while the round was in
+        flight; the contraction toward the group average still happens on
+        the snapshot term (Moshpit-style delayed parameter averaging)."""
+        if self._inflight is None:
+            return
+        launch_step, payload0, fut = self._inflight
+        if not wait and not fut.done():
+            return
+        self._inflight = None
+        try:
+            # The averager callback carries its own network timeouts; the
+            # margin here only guards against a wedged callback at exit.
+            averaged, avg_s = fut.result(timeout=600.0 if wait else 0.0)
+        except Exception as e:  # noqa: BLE001 — a failed round never kills training
+            log.warning("overlapped averaging launched at step %d failed: %s", launch_step, e)
+            self.metrics.record_event(
+                step_no, "avg_round", {"ok": False, "what": "params", "overlap": True}
+            )
+            return
+        staleness = step_no - launch_step
+        ok = averaged is not None
+        if ok and self.max_staleness and staleness > self.max_staleness:
+            log.warning(
+                "dropping averaging result: staleness %d > bound %d", staleness, self.max_staleness
+            )
+            ok = False
+        self.metrics.record_event(
+            step_no, "avg_round",
+            {"avg_s": avg_s, "ok": ok, "what": "params", "overlap": True,
+             "staleness": staleness},
+        )
+        if not ok:
+            return
+        current = jax.tree_util.tree_map(
+            np.asarray, self.bundle.avg_select(self.state.params)
+        )
+        merged_payload = jax.tree_util.tree_map(
+            lambda avg, cur, p0: np.asarray(avg, np.float32) + (cur - p0),
+            averaged, current, payload0,
+        )
+        self._swap_params(self.bundle.avg_merge(self.state.params, merged_payload), step_no)
 
     def run(
         self,
@@ -209,22 +313,29 @@ class Trainer:
             else:
                 self.metrics.count_samples(self.batch_size)
 
-            if (
-                self.averager is not None
-                and not self._grads_mode
-                and step_no % self.average_every == 0
-            ):
-                merged = self._run_average_round(self.state.params, step_no, "params")
-                if merged is not None:
-                    self.state = TrainState(
-                        params=jax.device_put(merged),
-                        opt_state=self.state.opt_state,
-                        step=self.state.step,
-                        rng=self.state.rng,
-                    )
-                # Refresh the cross-thread snapshot at the averaging cadence
-                # (post-merge, so state-sync serves the averaged weights).
-                self._take_snapshot(step_no)
+            if self.averager is not None and not self._grads_mode:
+                if self.overlap:
+                    # Merge any round that completed since the last step,
+                    # then (at the cadence, with no round in flight) launch
+                    # the next one — the device keeps stepping either way.
+                    self._finish_overlap_round(step_no)
+                    if step_no % self.average_every == 0:
+                        if self._inflight is None:
+                            self._launch_overlap_round(step_no)
+                        # Refresh the cross-thread snapshot at the cadence
+                        # even when no merge landed (failed/skipped rounds):
+                        # state-sync must serve CURRENT weights, not the
+                        # last merge — a rejoiner pulling a stale snapshot
+                        # would bootstrap thousands of steps behind.
+                        self._take_snapshot(step_no)
+                elif step_no % self.average_every == 0:
+                    merged = self._run_average_round(self.state.params, step_no, "params")
+                    if merged is not None:
+                        self._swap_params(merged, step_no)
+                    else:
+                        # Snapshot at the cadence regardless of round outcome
+                        # (see overlap branch).
+                        self._take_snapshot(step_no)
 
             if profiling and i + 1 >= profile_start + profile_steps:
                 jax.block_until_ready(m["loss"])
@@ -247,6 +358,10 @@ class Trainer:
                 break
         if profiling:  # loop ended inside the trace window
             jax.profiler.stop_trace()
+        # Drain an in-flight round so the returned params are contracted and
+        # a partner mid-round isn't abandoned by our exit.
+        if self.overlap:
+            self._finish_overlap_round(start_step + ran_steps, wait=True)
         if m is not None:
             last_loss = float(m["loss"])  # sync once at the end regardless
         wall = time.monotonic() - t_start

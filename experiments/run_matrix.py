@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""The five-config experiment matrix (BASELINE.json:7-11), as real localhost
+swarms through the actual CLI entrypoints.
+
+Each config launches a coordinator + N `run_volunteer.py` processes on
+127.0.0.1 (CPU backend — the swarm/averaging tier is host-side by design;
+SURVEY.md §1 maps the WAN tier to DCN, not the chip), records every
+volunteer's VOLUNTEER_DONE summary plus wall-clock into
+``experiments/results/config{N}.jsonl``, and writes a machine-readable
+``experiments/results/summary.json`` whose rows back BASELINE.md.
+
+Model sizes are scaled-down proxies (SURVEY.md §7 step 6 prescribes proxy
+models in the sandbox); the averaging MODES and swarm shapes are the real
+thing:
+
+  1  mnist_mlp          1 volunteer   local SGD (no averaging)
+  2  cifar10_resnet18   2 volunteers  synchronous GradientAverager
+  3  bert_mlm           4 volunteers  async gossip
+  4  gpt2_small         4 volunteers  butterfly, heterogeneous speeds
+                                      (per-volunteer batch sizes)
+  5  llama_lora         4 volunteers  byzantine (trimmed mean) + kill -9 churn
+
+Config 0 is the overlap throughput experiment (VERDICT r2 #2): a
+2-volunteer sync swarm at --average-every 10 with overlapped rounds must
+sustain >= 90% of the single-volunteer no-averaging samples/sec.
+
+Run:  python experiments/run_matrix.py            # all configs
+      python experiments/run_matrix.py --config 3 # one config
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "experiments", "results")
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    # Keep the axon TPU plugin's backend discovery away from subprocesses
+    # (a wedged relay would hang every volunteer at import time).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def start_coordinator():
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "coordinator.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stdout.readline() or ""
+        if line.startswith("COORDINATOR_READY "):
+            return proc, line.split()[1]
+    proc.kill()
+    raise RuntimeError("coordinator did not become ready")
+
+
+def start_volunteer(coord, peer_id, args):
+    return subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "run_volunteer.py"),
+            "--coordinator", coord, "--peer-id", peer_id, *args,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+    )
+
+
+def wait_done(proc, timeout):
+    out, _ = proc.communicate(timeout=timeout)
+    for line in out.splitlines():
+        if line.startswith("VOLUNTEER_DONE "):
+            return json.loads(line[len("VOLUNTEER_DONE "):]), out
+    return None, out
+
+
+def run_swarm(name, vol_specs, timeout=600, kill_after=None):
+    """Launch a swarm; vol_specs = [(peer_id, [cli args]), ...].
+
+    ``kill_after``: (seconds, peer_index) — SIGKILL that volunteer mid-run
+    (the config-5 churn). Returns list of (peer_id, summary|None, wall_s).
+    """
+    coord, addr = start_coordinator()
+    t0 = time.monotonic()
+    rows = []
+    try:
+        vols = [(pid, start_volunteer(addr, pid, args)) for pid, args in vol_specs]
+        if kill_after is not None:
+            delay, idx = kill_after
+            time.sleep(delay)
+            print(f"[{name}] kill -9 {vols[idx][0]} (churn injection)", flush=True)
+            vols[idx][1].send_signal(signal.SIGKILL)
+        for pid, proc in vols:
+            try:
+                summary, out = wait_done(proc, timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                summary, out = None, "(timeout)"
+            if summary is None and (kill_after is None or pid != vols[kill_after[1]][0]):
+                tail = "\n".join(out.splitlines()[-15:])
+                raise RuntimeError(f"[{name}] volunteer {pid} produced no summary:\n{tail}")
+            rows.append((pid, summary, time.monotonic() - t0))
+    finally:
+        coord.kill()
+        for _, proc in vols:
+            if proc.poll() is None:
+                proc.kill()
+    return rows
+
+
+def record(config_key, rows, extra=None):
+    os.makedirs(RESULTS, exist_ok=True)
+    path = os.path.join(RESULTS, f"{config_key}.jsonl")
+    with open(path, "w") as fh:
+        for pid, summary, wall in rows:
+            fh.write(json.dumps({"peer": pid, "wall_s": round(wall, 2), **(summary or {"dead": True})}) + "\n")
+        if extra:
+            fh.write(json.dumps({"derived": extra}) + "\n")
+    alive = [s for _, s, _ in rows if s]
+    agg = {
+        "volunteers": len(rows),
+        "finished": len(alive),
+        "samples_per_sec_per_volunteer": round(
+            sum(s["samples_per_sec"] for s in alive) / max(len(alive), 1), 2
+        ),
+        "final_loss_mean": round(sum(s["final_loss"] for s in alive) / max(len(alive), 1), 4),
+        "wall_s_max": round(max(w for _, _, w in rows), 1),
+        "rounds_ok_total": sum(int(s.get("rounds_ok", 0)) for s in alive),
+        "rounds_skipped_total": sum(int(s.get("rounds_skipped", 0)) for s in alive),
+    }
+    if extra:
+        agg.update(extra)
+    print(f"[{config_key}] {json.dumps(agg)}", flush=True)
+    return agg
+
+
+# --------------------------------------------------------------- configs ----
+
+TINY_RESNET = ["--model-override", "stage_sizes=[1,1]", "--model-override", "widths=[8,16]",
+               "--model-override", "stem_width=8", "--model-override", "groups=2"]
+TINY_BERT = ["--model-override", "vocab=256", "--model-override", "max_len=32",
+             "--model-override", "d_model=64", "--model-override", "n_heads=2",
+             "--model-override", "n_layers=2", "--model-override", "d_ff=128"]
+TINY_GPT2 = ["--model-override", "vocab=256", "--model-override", "max_len=32",
+             "--model-override", "d_model=64", "--model-override", "n_heads=2",
+             "--model-override", "n_layers=2", "--model-override", "d_ff=128"]
+TINY_LLAMA = ["--model-override", "vocab=256", "--model-override", "max_len=32",
+              "--model-override", "d_model=64", "--model-override", "n_heads=4",
+              "--model-override", "n_kv_heads=4", "--model-override", "n_layers=2",
+              "--model-override", "d_ff=128", "--model-override", "lora_rank=4"]
+TIMEOUTS = ["--join-timeout", "25", "--gather-timeout", "25"]
+
+
+def config1():
+    rows = run_swarm("config1", [
+        ("solo", ["--model", "mnist_mlp", "--averaging", "none", "--steps", "300",
+                  "--batch-size", "32", "--lr", "0.01", "--target-loss", "0.15"]),
+    ])
+    return record("config1_mnist_localsgd", rows)
+
+
+def config2():
+    common = ["--model", "cifar10_resnet18", *TINY_RESNET, "--averaging", "sync",
+              "--average-every", "10", "--steps", "60", "--batch-size", "16",
+              "--lr", "0.005", *TIMEOUTS]
+    rows = run_swarm("config2", [
+        (f"res{i}", common + ["--seed", str(i)]) for i in range(2)
+    ])
+    return record("config2_resnet_sync", rows)
+
+
+def config3():
+    common = ["--model", "bert_mlm", *TINY_BERT, "--averaging", "gossip",
+              "--average-every", "10", "--steps", "60", "--batch-size", "16",
+              "--lr", "0.003", *TIMEOUTS]
+    rows = run_swarm("config3", [
+        (f"bert{i}", common + ["--seed", str(i)]) for i in range(4)
+    ])
+    return record("config3_bert_gossip", rows)
+
+
+def config4():
+    # Heterogeneous volunteers: same data budget per optimizer step is not
+    # required by butterfly — each volunteer contributes its own weight. The
+    # speed spread comes from different per-volunteer batch sizes (a v4-8 vs
+    # v5e-4 swarm in miniature, BASELINE.json:10).
+    base = ["--model", "gpt2_small", *TINY_GPT2, "--averaging", "butterfly",
+            "--average-every", "10", "--lr", "0.003", *TIMEOUTS]
+    rows = run_swarm("config4", [
+        ("fast0", base + ["--steps", "60", "--batch-size", "8", "--seed", "0"]),
+        ("fast1", base + ["--steps", "60", "--batch-size", "8", "--seed", "1"]),
+        ("slow0", base + ["--steps", "60", "--batch-size", "32", "--seed", "2"]),
+        ("slow1", base + ["--steps", "60", "--batch-size", "32", "--seed", "3"]),
+    ])
+    return record("config4_gpt2_butterfly_hetero", rows)
+
+
+def config5():
+    common = ["--model", "llama_lora", *TINY_LLAMA, "--averaging", "byzantine",
+              "--method", "trimmed_mean", "--average-every", "8", "--steps", "64",
+              "--batch-size", "8", "--lr", "0.005", "--min-group", "2", *TIMEOUTS]
+    rows = run_swarm(
+        "config5",
+        [(f"lora{i}", common + ["--seed", str(i)]) for i in range(4)],
+        kill_after=(25.0, 3),  # churn: one volunteer dies un-gracefully
+    )
+    return record("config5_llama_lora_byzantine_churn", rows)
+
+
+def config0_overlap():
+    """Overlap throughput: 2-volunteer sync at --average-every 10 must hold
+    >= 90% of the no-averaging samples/sec (VERDICT r2 #2 done-criterion).
+
+    The no-averaging baseline is TWO concurrent volunteers (averaging none):
+    on a shared localhost the processes contend for the same cores, so a
+    single-process baseline would charge that contention to the averager.
+    The blocking variant (--no-overlap) runs too, so the JSONL records what
+    the overlap actually buys."""
+    base = ["--model", "mnist_mlp", "--model-override", "d_hidden=512",
+            "--steps", "120", "--batch-size", "32", "--lr", "0.005"]
+
+    def mean_sps(rows):
+        return sum(s["samples_per_sec"] for _, s, _ in rows if s) / len(rows)
+
+    none_rows = run_swarm("overlap/baseline", [
+        ("none0", base + ["--averaging", "none"]),
+        ("none1", base + ["--averaging", "none"]),
+    ])
+    sync = base + ["--averaging", "sync", "--average-every", "10", *TIMEOUTS]
+    ov_rows = run_swarm("overlap/overlapped", [
+        ("ov0", sync + ["--overlap", "--seed", "0"]),
+        ("ov1", sync + ["--overlap", "--seed", "1"]),
+    ])
+    bl_rows = run_swarm("overlap/blocking", [
+        ("bl0", sync + ["--no-overlap", "--seed", "0"]),
+        ("bl1", sync + ["--no-overlap", "--seed", "1"]),
+    ])
+    base_sps, ov_sps, bl_sps = mean_sps(none_rows), mean_sps(ov_rows), mean_sps(bl_rows)
+    agg = record(
+        "config0_overlap_throughput", none_rows + ov_rows + bl_rows,
+        extra={
+            "baseline_sps": round(base_sps, 2),
+            "overlap_sps": round(ov_sps, 2),
+            "blocking_sps": round(bl_sps, 2),
+            "overlap_throughput_ratio": round(ov_sps / base_sps, 3),
+            "blocking_throughput_ratio": round(bl_sps / base_sps, 3),
+        },
+    )
+    return agg
+
+
+CONFIGS = {
+    0: config0_overlap, 1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", type=int, default=None, help="run one config (0-5)")
+    args = ap.parse_args()
+    todo = [args.config] if args.config is not None else sorted(CONFIGS)
+    summary = {}
+    for n in todo:
+        t0 = time.monotonic()
+        summary[f"config{n}"] = CONFIGS[n]()
+        summary[f"config{n}"]["experiment_wall_s"] = round(time.monotonic() - t0, 1)
+    os.makedirs(RESULTS, exist_ok=True)
+    path = os.path.join(RESULTS, "summary.json")
+    existing = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            existing = json.load(fh)
+    existing.update(summary)
+    with open(path, "w") as fh:
+        json.dump(existing, fh, indent=1, sort_keys=True)
+    print(json.dumps(existing, indent=1, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,12 @@ def main() -> None:
                     help="params = local-SGD periodic averaging; grads = GradientAverager")
     ap.add_argument("--wire", default="f32", choices=("f32", "bf16"),
                     help="WAN payload codec; bf16 halves DCN traffic")
+    ap.add_argument("--overlap", action=argparse.BooleanOptionalAction, default=True,
+                    help="overlap WAN averaging rounds with local compute "
+                         "(params mode; --no-overlap restores blocking rounds)")
+    ap.add_argument("--max-staleness", type=int, default=0,
+                    help="drop an overlapped round's result if it lags more "
+                         "than this many steps (0 = no bound)")
     ap.add_argument("--min-group", type=int, default=2)
     ap.add_argument("--max-group", type=int, default=16)
     ap.add_argument("--method", default="trimmed_mean",
@@ -88,6 +94,8 @@ def main() -> None:
         average_every=args.average_every,
         average_what=args.average_what,
         wire=args.wire,
+        overlap=args.overlap,
+        max_staleness=args.max_staleness,
         min_group=args.min_group,
         max_group=args.max_group,
         method=args.method,

@@ -194,6 +194,48 @@ class TestGossip:
         assert float(rb2["w"].mean()) < 4.0
 
 
+    def test_namespaced_partner_selection(self):
+        """Regression (round-3 experiment matrix): volunteers namespace rounds
+        as "model/average_what" while membership records carried only the
+        model name — the gossip partner filter matched nothing and every
+        round skipped. Records now publish avg_ns and the filter requires an
+        exact match: a record with only a model field (or a grads-mode
+        avg_ns) is never selected — model alone can't distinguish a params
+        tree from a grads tree, and the two flatten to identical schemas."""
+
+        async def spawn(peer_id, ns, extra_info, boot):
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=[boot] if boot else None)
+            mem = SwarmMembership(dht, peer_id, ttl=10.0, extra_info=extra_info)
+            await mem.join()
+            return t, dht, mem, GossipAverager(
+                t, dht, mem, namespace=ns, join_timeout=6.0, gather_timeout=8.0
+            )
+
+        async def main():
+            ns = "m/params"
+            a = await spawn("va", ns, {"model": "m", "avg_ns": ns}, None)
+            boot = a[0].addr
+            b = await spawn("vb", ns, {"model": "m", "avg_ns": ns}, boot)
+            grads = await spawn("vgrads", "m/grads", {"model": "m", "avg_ns": "m/grads"}, boot)
+            vols = [a, b, grads]
+            try:
+                await b[3].average(make_tree(2.0), 1)
+                # a must find its one same-namespace partner (b) and mix.
+                ra = await a[3].average(make_tree(0.0), 2)
+                # the grads-mode peer sees only cross-namespace targets -> skip
+                rg = await grads[3].average(make_tree(9.0), 1)
+                return ra, rg
+            finally:
+                await teardown(vols)
+
+        ra, rg = run(main())
+        assert ra is not None, "gossip found no partner under the volunteer-style namespace"
+        leaves_close(ra, 1.0)
+        assert rg is None, "a grads-mode peer must not gossip with params-mode peers"
+
+
 class TestButterfly:
     @pytest.mark.parametrize("n", [2, 4, 8])
     def test_power_of_two_full_average(self, n):

@@ -119,6 +119,48 @@ class TestTrainerLocalSGD:
             for a, b in zip(leaves0, leaves2)
         )
 
+    def test_overlap_round_runs_concurrently_and_merges_delta(self):
+        """Overlapped averaging: the device keeps stepping while the WAN
+        round is in flight, and the result is merged Moshpit-style as
+        new = averaged + (current - snapshot)."""
+        import threading
+
+        def make_trainer(averager):
+            return Trainer(
+                get_model("mnist_mlp"), batch_size=8, seed=0,
+                average_every=9, averager=averager, overlap=True,
+            )
+
+        def run_with(offset):
+            release = threading.Event()
+            seen = {}
+
+            def averager(payload, step):
+                seen["launch_step"] = step
+                # True only if the train loop reached the LAST step while this
+                # round was still in flight — i.e. compute really overlapped.
+                seen["released_by_training"] = release.wait(timeout=60)
+                return jax.tree_util.tree_map(
+                    lambda x: np.asarray(x, np.float32) + offset, payload
+                )
+
+            t = make_trainer(averager)
+            t.on_step = lambda tr, s: release.set() if s >= 10 else None
+            t.run(steps=10, log_every=0)
+            assert seen["launch_step"] == 9
+            assert seen["released_by_training"], "train loop blocked on the round"
+            return jax.tree_util.tree_map(np.asarray, t.state.params)
+
+        # offset 0: averaged == snapshot -> merge must be a no-op vs local
+        # trajectory; offset 1: every leaf exactly +1 vs the offset-0 run
+        # (merge is the last action: the round drains after the final step).
+        p_identity = run_with(0.0)
+        p_shifted = run_with(1.0)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_identity), jax.tree_util.tree_leaves(p_shifted)
+        ):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a) + 1.0, rtol=1e-6)
+
     def test_averager_callback_applied(self):
         calls = []
 
