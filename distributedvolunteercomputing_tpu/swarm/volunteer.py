@@ -16,11 +16,14 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import os
 import signal
 import threading
 import time
 import uuid
 from typing import Any, Dict, Optional
+
+import jax
 
 from distributedvolunteercomputing_tpu.models import get_model
 from distributedvolunteercomputing_tpu.swarm.averager import make_averager
@@ -93,6 +96,18 @@ class Volunteer:
     def _averager_callback(self, params, step: int):
         if self.averager is None or self._stop.is_set():
             return None
+        # Fault-injection hook (SURVEY.md §5): DVC_CHAOS_CONTRIB_SCALE=<x>
+        # turns this volunteer BYZANTINE — it contributes its real tree
+        # scaled by x (well-formed frames, garbage values; the case CRCs
+        # can't catch and robust aggregation exists for). Test-only; unset
+        # in production.
+        chaos_scale = float(os.environ.get("DVC_CHAOS_CONTRIB_SCALE", "0") or 0.0)
+        if chaos_scale:
+            import numpy as np
+
+            params = jax.tree_util.tree_map(
+                lambda x: np.asarray(x, np.float32) * chaos_scale, params
+            )
         # Weight = samples behind this contribution: one batch for a
         # gradient round, average_every batches for a parameter round.
         per_round = 1 if self.cfg.average_what == "grads" else self.cfg.average_every
@@ -205,17 +220,29 @@ class Volunteer:
             self.state_sync = StateSyncService(
                 self.transport, self.dht, self.cfg.peer_id, namespace=self.cfg.model
             )
-            # The provider reads the trainer's HOST snapshot, never the live
-            # TrainState: the jitted step donates its input buffers, so
-            # touching state.params from this (asyncio) thread mid-training
-            # would hit deleted arrays.
-            self.state_sync.set_provider(lambda: self.trainer.host_snapshot())
+
+            # State sync ships the bundle's SYNC SUBTREE (avg_select):
+            # identity for full models, adapters-only for LoRA — the frozen
+            # base is reconstructed bit-identically from init_seed, so
+            # shipping it (~1000x the adapters at llama2_7b scale) would be
+            # pure waste. The provider reads the trainer's HOST snapshot,
+            # never the live TrainState: the jitted step donates its input
+            # buffers, so touching state.params from this (asyncio) thread
+            # mid-training would hit deleted arrays.
+            def provider():
+                step, params = self.trainer.host_snapshot()
+                return step, bundle.avg_select(params)
+
+            self.state_sync.set_provider(provider)
             pulled = await self.state_sync.pull(
-                self.trainer.state.params, int(self.trainer.state.step)
+                bundle.avg_select(self.trainer.state.params),
+                int(self.trainer.state.step),
             )
             if pulled is not None:
-                step, params = pulled
-                self.trainer.adopt_params(params, step=step)
+                step, subtree = pulled
+                self.trainer.adopt_params(
+                    bundle.avg_merge(self.trainer.state.params, subtree), step=step
+                )
             await self.state_sync.announce()
         log.info(
             "volunteer %s up on %s:%d (model=%s averaging=%s)",
@@ -283,6 +310,7 @@ class Volunteer:
                 await self.membership.leave()
             except Exception:
                 pass
+            await self.dht.stop()
             await self.transport.close()
 
     def install_signal_handlers(self) -> None:

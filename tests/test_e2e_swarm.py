@@ -71,7 +71,14 @@ def wait_done(proc, timeout=180):
 
 class TestSwarmE2E:
     def test_two_volunteers_sync_averaging(self, tmp_path):
-        """Config-2 shape: 2 volunteers, synchronous GradientAverager."""
+        """Config-2 shape: 2 volunteers, synchronous GradientAverager.
+
+        Runs with the volunteer DEFAULT (overlapped rounds): local steps
+        are ~0.2 s while a WAN round is seconds, so a short run completes
+        fewer rounds than the blocking cadence would — at least one full
+        round (plus the end-of-run drain) is the correct expectation here;
+        blocking round-per-cadence counting is covered by the grads-mode
+        test below and the config-0 experiment's --no-overlap arm."""
         coord, addr = start_coordinator()
         try:
             common = [
@@ -82,8 +89,8 @@ class TestSwarmE2E:
             v1 = start_volunteer(addr, "vol1", common + ["--seed", "1"])
             s0, out0 = wait_done(v0)
             s1, out1 = wait_done(v1)
-            assert s0["rounds_ok"] >= 2, out0
-            assert s1["rounds_ok"] >= 2, out1
+            assert s0["rounds_ok"] >= 1, out0
+            assert s1["rounds_ok"] >= 1, out1
             assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
         finally:
             coord.kill()
@@ -124,6 +131,59 @@ class TestSwarmE2E:
             s1, out1 = wait_done(vols[1])
             assert s0["rounds_ok"] >= 1, out0
             assert s1["rounds_ok"] >= 1, out1
+        finally:
+            coord.kill()
+            for v in vols:
+                if v.poll() is None:
+                    v.kill()
+
+    def test_byzantine_lora_swarm_survives_corrupt_volunteer(self):
+        """Config-5 shape (BASELINE.json:11): llama_lora volunteers under
+        Byzantine-tolerant averaging, one volunteer contributing garbage
+        (its real adapter tree scaled 1000x — well-formed frames, so only
+        robust aggregation can catch it). Honest survivors must keep
+        finite, sane losses; the shared frozen base (init_seed) is what
+        makes their adapter averages meaningful."""
+        tiny_llama = [
+            "--model", "llama_lora",
+            "--model-override", "vocab=128", "--model-override", "max_len=16",
+            "--model-override", "d_model=32", "--model-override", "n_heads=2",
+            "--model-override", "n_kv_heads=2", "--model-override", "n_layers=2",
+            "--model-override", "d_ff=64", "--model-override", "lora_rank=2",
+        ]
+        coord, addr = start_coordinator()
+        vols = []
+        try:
+            common = [
+                "--averaging", "byzantine", "--method", "trimmed_mean",
+                "--average-every", "6", "--steps", "24", "--batch-size", "8",
+                "--min-group", "4", "--max-group", "4", "--lr", "0.005",
+                "--join-timeout", "25", "--gather-timeout", "25", *tiny_llama,
+            ]
+
+            def start(peer_id, extra, env_extra=None):
+                env = _env()
+                env.update(env_extra or {})
+                return subprocess.Popen(
+                    [sys.executable, os.path.join(REPO, "run_volunteer.py"),
+                     "--coordinator", addr, "--peer-id", peer_id, *common, *extra],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+                )
+
+            vols = [start(f"honest{i}", ["--seed", str(i)]) for i in range(3)]
+            vols.append(
+                start("byz", ["--seed", "9"], {"DVC_CHAOS_CONTRIB_SCALE": "1000.0"})
+            )
+            summaries = []
+            for v in vols[:3]:
+                s, out = wait_done(v, timeout=240)
+                summaries.append((s, out))
+            for s, out in summaries:
+                assert s["rounds_ok"] >= 2, out
+                # ln(128) ~ 4.85 at init; adopting the 1000x-scaled garbage
+                # would blow the loss up (or NaN). Trimmed mean must hold.
+                assert s["final_loss"] == s["final_loss"], out  # not NaN
+                assert s["final_loss"] < 6.5, out
         finally:
             coord.kill()
             for v in vols:
