@@ -5,17 +5,39 @@ checkpoint/resume): a volunteer that (re)joins — fresh process, restored
 preemption, long absence — pulls the freshest params straight from a live
 peer instead of training from its cold init and poisoning the next averaging
 round with stale weights (the hivemind ``load_state_from_peers`` role, done
-the swarm's way: DHT announcement + one transport RPC).
+the swarm's way: DHT announcement + transport RPCs).
 
 Protocol:
 - every provider periodically announces ``state/<namespace>`` in the DHT
   with its current step (subkey = peer_id, TTL'd like heartbeats);
 - a puller reads the key, targets the highest announced step above its own,
-  and issues ``state.fetch``; the payload is the flattened f32 param buffer
-  (always f32 — a one-off fetch shouldn't inherit the bf16 wire's rounding);
-- the puller validates the buffer length against ITS OWN param schema before
-  adopting (a wrong-model payload can't be loaded), and walks down the
-  candidate list on failure — a dead or lagging peer costs one timeout.
+  and fetches the flattened f32 payload in CHUNKS (``state.fetch`` with
+  offset/length). The first chunk opens a session: the provider serializes
+  its tree ONCE and pins the buffer for the session, so a multi-chunk pull
+  is a consistent snapshot even while the provider keeps training. Every
+  chunk rides the transport's CRC-checked framing, so a flipped byte in any
+  chunk fails that chunk, not the whole transfer;
+- the puller validates the total length against ITS OWN schema before
+  adopting (a wrong-model payload can't be loaded) and runs a sanity guard
+  (finite, magnitude-bounded) so a garbage provider can't hand a rejoiner
+  NaN params; it walks down the candidate list on failure.
+
+What the payload is: the SYNC SUBTREE, not necessarily the full params. The
+volunteer wires the model bundle's ``avg_select``/``avg_merge`` through this
+service, so a LoRA model ships only its adapters (~1000x less than the
+frozen base, which every volunteer reconstructs bit-identically from the
+task-constant ``init_seed``).
+
+Trust model (byzantine mode): a pulled state comes from ONE provider; the
+sanity guard rejects gross poison (NaN/Inf/absurd magnitudes) but a
+malicious provider could serve subtly-wrong values. This is accepted under
+the HONEST-MAJORITY assumption the byzantine averager itself rests on: the
+rejoiner's very next averaging round contracts it toward the robust
+aggregate of the group, so a poisoned pull survives at most one averaging
+interval and the poisoner's own round contributions are trimmed by the
+estimator. (Cross-checking a second provider cannot distinguish malice from
+normal between-round drift — two honest peers at the same step legitimately
+differ by their local steps — so it would reject honest providers.)
 
 Optimizer moments are NOT transferred: a pulled state resumes with a cold
 optimizer at the correct step (the standard trade — moments are 2x params of
@@ -25,7 +47,9 @@ extra WAN bytes for marginal benefit after averaging rounds resync anyway).
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Callable, List, Optional, Tuple
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,11 +64,32 @@ from distributedvolunteercomputing_tpu.utils.pytree import (
 
 log = get_logger(__name__)
 
-# (step, params_tree) supplier — reads the live trainer state.
+# (step, sync_subtree) supplier — reads the live trainer state.
 StateProvider = Callable[[], Tuple[int, Any]]
+
+# Per-chunk payload bytes. Well under the transport's frame guard; big
+# enough that a GPT-2-small full tree (~500 MB f32) is ~8 chunks.
+DEFAULT_CHUNK_BYTES = 64 * 1024 * 1024
+
+
+class _Session:
+    __slots__ = ("buf", "step", "t0", "pending")
+
+    def __init__(self):
+        self.buf = b""
+        self.step = 0
+        self.t0 = time.monotonic()
+        self.pending = True  # reserved (counts toward the cap) but not filled
 
 
 class StateSyncService:
+    # Concurrent pinned serializations; each holds one payload-sized buffer.
+    MAX_SESSIONS = 2
+    SESSION_TTL = 180.0
+    # Sanity bound for adopted values: trained params live in O(1); 1e4
+    # already means something is deeply wrong (guards garbage providers).
+    MAX_ABS_VALUE = 1e4
+
     def __init__(
         self,
         transport: Transport,
@@ -53,6 +98,7 @@ class StateSyncService:
         namespace: str,
         announce_ttl: float = 30.0,
         fetch_timeout: float = 60.0,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     ):
         self.transport = transport
         self.dht = dht
@@ -60,7 +106,9 @@ class StateSyncService:
         self.namespace = namespace
         self.announce_ttl = announce_ttl
         self.fetch_timeout = fetch_timeout
+        self.chunk_bytes = int(chunk_bytes)
         self._provider: Optional[StateProvider] = None
+        self._sessions: Dict[str, _Session] = {}
         transport.register("state.fetch", self._rpc_fetch)
 
     @property
@@ -84,18 +132,71 @@ class StateSyncService:
             ttl=self.announce_ttl,
         )
 
+    def _sweep_sessions(self) -> None:
+        now = time.monotonic()
+        for sid in [s for s, st in self._sessions.items() if now - st.t0 > self.SESSION_TTL]:
+            del self._sessions[sid]
+
     async def _rpc_fetch(self, args: dict, payload: bytes):
+        """Chunked fetch. args: {session, offset, length}. offset 0 (or a
+        new session id) serializes and PINS the provider's current tree, so
+        later chunks come from the same snapshot; the final chunk (or an
+        unconditional expiry timer) releases it."""
         if self._provider is None:
             raise RPCError("no state to serve yet")
-        step, tree = self._provider()
+        self._sweep_sessions()
+        session = str(args.get("session", "")) or uuid.uuid4().hex
+        offset = int(args.get("offset", 0))
+        length = int(args.get("length", 0)) or self.chunk_bytes
+        st = self._sessions.get(session)
+        if st is not None and st.pending:
+            # Another connection's open is mid-serialization; this session id
+            # is not usable by anyone else.
+            raise RPCError("state session still opening")
+        if st is None:
+            if offset != 0:
+                raise RPCError("unknown state session (expired or never opened)")
+            if len(self._sessions) >= self.MAX_SESSIONS:
+                raise RPCError("state session cap reached; retry shortly")
+            # Reserve BEFORE the await: concurrent opens each hold a slot, so
+            # N simultaneous rejoiners can never pin more than MAX_SESSIONS
+            # payload-sized buffers (the cap-check-then-insert race).
+            st = self._sessions[session] = _Session()
+            try:
+                step, tree = self._provider()
 
-        def _serialize() -> bytes:
-            buf, _, _ = flatten_to_buffer(tree)
-            return buf.tobytes()
+                def _serialize() -> bytes:
+                    buf, _, _ = flatten_to_buffer(tree)
+                    return buf.tobytes()
 
-        # Param-sized flatten+copy off the event loop: serving state must not
-        # stall heartbeats/averaging RPCs for the duration of a big memcpy.
-        return {"step": int(step)}, await asyncio.to_thread(_serialize)
+                # Param-sized flatten+copy off the event loop: serving state
+                # must not stall heartbeats/averaging RPCs for a big memcpy.
+                st.buf = await asyncio.to_thread(_serialize)
+                st.step = int(step)
+                st.pending = False
+            except BaseException:
+                self._sessions.pop(session, None)
+                raise
+            # Unconditional expiry: a puller that dies after chunk 0 must not
+            # pin this buffer until the NEXT fetch RPC happens to sweep — two
+            # such aborts would block all state serving for SESSION_TTL.
+            asyncio.get_running_loop().call_later(
+                self.SESSION_TTL, self._sessions.pop, session, None
+            )
+        chunk = st.buf[offset : offset + length]
+        done = offset + len(chunk) >= len(st.buf)
+        if done:
+            self._sessions.pop(session, None)
+        return (
+            {
+                "step": st.step,
+                "session": session,
+                "total": len(st.buf),
+                "offset": offset,
+                "done": done,
+            },
+            chunk,
+        )
 
     # -- puller side -------------------------------------------------------
 
@@ -116,30 +217,70 @@ class StateSyncService:
         out.sort(reverse=True)  # freshest first
         return out
 
+    async def _fetch_all(self, addr: Addr, expect_bytes: int) -> Tuple[int, bytearray]:
+        """Pull the full buffer from one provider in chunks; returns
+        (provider_step, payload). Raises on any failure — caller moves on.
+        Chunks write straight into one preallocated buffer: collecting
+        parts and joining would hold ~2x the payload at the join."""
+        out = bytearray(expect_bytes)
+        session = ""
+        offset = 0
+        while True:
+            ret, chunk = await self.transport.call(
+                addr,
+                "state.fetch",
+                {"peer": self.peer_id, "session": session, "offset": offset,
+                 "length": self.chunk_bytes},
+                timeout=self.fetch_timeout,
+            )
+            total = int(ret["total"])
+            if total != expect_bytes:
+                raise RPCError(f"provider buffer {total}B != local schema {expect_bytes}B")
+            if int(ret["offset"]) != offset or not chunk or offset + len(chunk) > total:
+                raise RPCError("chunk sequencing error")
+            out[offset : offset + len(chunk)] = chunk
+            offset += len(chunk)
+            session = str(ret["session"])
+            if ret.get("done"):
+                if offset != total:
+                    raise RPCError("provider finished short of its own total")
+                break
+        return int(ret["step"]), out
+
+    def _sane(self, buf: np.ndarray) -> bool:
+        """Finite and magnitude-bounded, allocation-free: NaN propagates
+        through min/max and fails both comparisons; +/-Inf fails the bound.
+        (np.isfinite().all() + np.abs() would allocate ~1.25x the payload on
+        the memory-tight rejoin path.)"""
+        if buf.size == 0:
+            return True
+        lo = float(np.min(buf))
+        hi = float(np.max(buf))
+        return -self.MAX_ABS_VALUE < lo <= hi < self.MAX_ABS_VALUE
+
     async def pull(
         self, local_tree: Any, local_step: int, min_lead: int = 1
     ) -> Optional[Tuple[int, Any]]:
-        """Fetch params from the freshest peer at least ``min_lead`` steps
-        ahead; returns (step, tree) or None (nobody ahead / all fetches
-        failed — both normal, the caller just trains on)."""
+        """Fetch the sync subtree from the freshest peer at least
+        ``min_lead`` steps ahead; returns (step, tree) or None (nobody
+        ahead / all fetches failed — both normal, the caller trains on)."""
         # Schema only — no param-sized buffer materialized on the pull side.
         specs, treedef = tree_specs(local_tree)
         expect = int(sum(s.size for s in specs))
         for step, pid, addr in await self._candidates(local_step + min_lead - 1):
             try:
-                ret, payload = await self.transport.call(
-                    addr, "state.fetch", {"peer": self.peer_id},
-                    timeout=self.fetch_timeout,
-                )
+                got_step, payload = await self._fetch_all(addr, expect * 4)
                 buf = np.frombuffer(payload, np.float32)
-                if buf.size != expect:
+                if not self._sane(buf):
                     log.warning(
-                        "state pull from %s: buffer %d != local schema %d (skipping)",
-                        pid, buf.size, expect,
+                        "state pull from %s failed the sanity guard "
+                        "(non-finite or absurd values); trying next", pid,
                     )
                     continue
-                got_step = int(ret.get("step", step))
-                log.info("pulled state at step %d from %s", got_step, pid)
+                log.info(
+                    "pulled state at step %d from %s (%d bytes, %d-byte chunks)",
+                    got_step, pid, len(payload), self.chunk_bytes,
+                )
                 # No defensive copy: unflatten's astype copies each chunk out
                 # of the read-only frombuffer view.
                 return got_step, unflatten_from_buffer(buf, specs, treedef)

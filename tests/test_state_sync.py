@@ -73,6 +73,143 @@ def test_pull_rejects_wrong_schema_and_falls_back():
     run(scenario())
 
 
+def test_multichunk_pull_is_a_consistent_snapshot():
+    """A pull spanning many chunks must (a) reassemble exactly and (b) come
+    from ONE pinned serialization even if the provider's live state advances
+    mid-transfer (the session pins the buffer at the first chunk)."""
+
+    async def scenario():
+        ta, _, a = await _node(peer_id="a")
+        tc, _, c = await _node(boot=ta.addr, peer_id="c")
+        # 7*3+2 = 23 f32 = 92 bytes; 16-byte chunks -> 6 chunks.
+        a.chunk_bytes = 16
+        c.chunk_bytes = 16
+        live = {"v": 8.0}
+        a.set_provider(lambda: (80, tree(live["v"])))
+        try:
+            await a.announce()
+            # Mutate the provider's live value after the session opens by
+            # hooking the transport: flip `live` once the first chunk is out.
+            orig = a._rpc_fetch
+
+            async def mutating_fetch(args, payload):
+                ret = await orig(args, payload)
+                live["v"] = 99.0  # changes what a NEW serialization would see
+                return ret
+
+            a.transport.register("state.fetch", mutating_fetch)
+            pulled = await c.pull(tree(0.0), local_step=0)
+            assert pulled is not None
+            step, t = pulled
+            assert step == 80
+            # All leaves from the FIRST serialization (8.0), never 99.0.
+            np.testing.assert_array_equal(t["w"], np.full((7, 3), 8.0))
+            np.testing.assert_array_equal(t["b"], np.full((2,), 24.0))
+            assert not a._sessions, "completed session must be released"
+        finally:
+            for tt in (ta, tc):
+                await tt.close()
+
+    run(scenario())
+
+
+def test_sanity_guard_rejects_garbage_provider():
+    """A provider serving NaN/absurd values is skipped (byzantine rejoin
+    poisoning, ADVICE r1/r2): the puller falls back to the next candidate."""
+
+    async def scenario():
+        ta, _, a = await _node(peer_id="a")
+        tb, _, b = await _node(boot=ta.addr, peer_id="b")
+        tc, _, c = await _node(boot=ta.addr, peer_id="c")
+        try:
+            poison = tree(5.0)
+            poison["w"][0, 0] = np.nan
+            b.set_provider(lambda: (90, poison))  # freshest, but poisoned
+            a.set_provider(lambda: (50, tree(5.0)))
+            await a.announce()
+            await b.announce()
+            pulled = await c.pull(tree(0.0), local_step=0)
+            assert pulled is not None
+            step, t = pulled
+            assert step == 50, "puller must fall back past the NaN provider"
+            # And absurd-magnitude (non-NaN) poison is rejected the same way.
+            big = tree(5.0)
+            big["w"][:] = 1e6
+            b.set_provider(lambda: (95, big))
+            await b.announce()
+            pulled = await c.pull(tree(0.0), local_step=0)
+            assert pulled is not None and pulled[0] == 50
+        finally:
+            for tt in (ta, tb, tc):
+                await tt.close()
+
+    run(scenario())
+
+
+def test_volunteer_lora_pull_ships_adapters_only(tmp_path):
+    """LoRA state sync: the payload is avg_select's adapter subtree, not the
+    full tree — the frozen base comes from the task-constant init_seed."""
+    from distributedvolunteercomputing_tpu.models import get_model
+    from distributedvolunteercomputing_tpu.swarm.volunteer import Volunteer, VolunteerConfig
+
+    tiny = dict(vocab=64, max_len=16, d_model=32, n_heads=2, n_kv_heads=2,
+                n_layers=2, d_ff=64, lora_rank=2)
+
+    async def scenario():
+        import jax
+
+        cfg1 = VolunteerConfig(
+            model="llama_lora", model_overrides=tiny, averaging="byzantine",
+            steps=0, peer_id="l1", min_group=2,
+        )
+        v1 = Volunteer(cfg1)
+        await v1.start()
+        # Give v1 distinctive adapters + a step lead, then announce.
+        params = v1.trainer.state.params
+        marked = {
+            "base": params["base"],
+            "lora": jax.tree_util.tree_map(
+                lambda x: np.full_like(np.asarray(x), 0.125), params["lora"]
+            ),
+        }
+        v1.trainer.adopt_params(marked, step=40)
+        await v1.state_sync.announce()
+        # The wire payload is exactly the adapter subtree's f32 size.
+        bundle = get_model("llama_lora", **tiny)
+        adapter_floats = sum(
+            int(np.asarray(x).size)
+            for x in jax.tree_util.tree_leaves(bundle.avg_select(marked))
+        )
+        ret, chunk = await v1.transport.call(
+            v1.transport.addr, "state.fetch",
+            {"peer": "probe", "session": "", "offset": 0, "length": 1 << 30},
+        )
+        assert ret["total"] == adapter_floats * 4, "payload must be adapters only"
+
+        cfg2 = VolunteerConfig(
+            model="llama_lora", model_overrides=tiny, averaging="byzantine",
+            steps=0, peer_id="l2", min_group=2,
+            coordinator="{}:{}".format(*v1.transport.addr),
+        )
+        v2 = Volunteer(cfg2)
+        try:
+            await v2.start()
+            assert int(v2.trainer.state.step) == 40
+            for got in jax.tree_util.tree_leaves(v2.trainer.state.params["lora"]):
+                np.testing.assert_allclose(np.asarray(got), 0.125, rtol=1e-6)
+            # base identical by construction (same init_seed), never shipped
+            for got, want in zip(
+                jax.tree_util.tree_leaves(v2.trainer.state.params["base"]),
+                jax.tree_util.tree_leaves(v1.trainer.state.params["base"]),
+            ):
+                np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        finally:
+            await v2.transport.close()
+            await v1.transport.close()
+
+    run(scenario())
+
+
 def test_volunteer_pull_on_join(tmp_path):
     """In-process volunteers: #2 joins after #1 trained ahead, and must start
     from #1's announced step instead of step 0."""
