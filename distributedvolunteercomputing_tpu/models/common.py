@@ -23,7 +23,9 @@ Params = Dict[str, Any]
 # bf16 on TPU keeps the MXU at full rate; f32 on CPU keeps tests exact enough
 # to compare against numpy references.
 def compute_dtype() -> jnp.dtype:
-    if jax.default_backend() in ("tpu", "axon"):
+    from distributedvolunteercomputing_tpu.utils.jaxenv import tpu_backend
+
+    if tpu_backend():
         return jnp.bfloat16
     return jnp.float32
 

@@ -68,7 +68,9 @@ def _route_to_flash(q: jax.Array, k: jax.Array, causal: bool, mask) -> bool:
         return False
     if _impl == "flash":
         return True
-    return _impl == "auto" and jax.default_backend() == "tpu" and q.shape[-2] >= 128
+    from distributedvolunteercomputing_tpu.utils.jaxenv import tpu_backend
+
+    return _impl == "auto" and tpu_backend() and q.shape[-2] >= 128
 
 
 def attention_core(

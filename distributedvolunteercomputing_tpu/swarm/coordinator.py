@@ -45,6 +45,7 @@ class Coordinator:
         return addr
 
     async def close(self) -> None:
+        await self.dht.stop()
         await self.transport.close()
 
     # -- RPCs --------------------------------------------------------------

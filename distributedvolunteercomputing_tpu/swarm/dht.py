@@ -25,8 +25,9 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import random
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
@@ -61,18 +62,32 @@ class RoutingTable:
         d = nid ^ self.own_id
         return d.bit_length() - 1 if d else 0
 
-    def add(self, nid: int, addr: Addr) -> None:
+    def add(self, nid: int, addr: Addr) -> Optional[Tuple[int, Addr]]:
+        """Insert or touch (move to most-recently-seen).
+
+        Returns ``None`` when the contact was inserted/refreshed, or the
+        least-recently-seen (nid, addr) of the FULL bucket as an eviction
+        CANDIDATE — the new contact is NOT inserted; the caller decides via
+        ping-before-evict (DHTNode._add_contact). Blind LRS-drop would let
+        churny newcomers evict stable long-lived nodes, the exact opposite
+        of Kademlia's stability heuristic."""
         if nid == self.own_id:
-            return
+            return None
         bucket = self.buckets[self._bucket_of(nid)]
         for i, (bid, _) in enumerate(bucket):
             if bid == nid:
                 bucket.pop(i)
-                break
-        bucket.append((nid, addr))
-        if len(bucket) > K:
-            # Simplified eviction: drop least-recently-seen without ping.
-            bucket.pop(0)
+                bucket.append((nid, addr))
+                return None
+        if len(bucket) < K:
+            bucket.append((nid, addr))
+            return None
+        return bucket[0]
+
+    def replace(self, old_nid: int, nid: int, addr: Addr) -> None:
+        """Evict ``old_nid`` and insert the pending contact in its place."""
+        self.remove(old_nid)
+        self.add(nid, addr)
 
     def remove(self, nid: int) -> None:
         bucket = self.buckets[self._bucket_of(nid)]
@@ -90,13 +105,21 @@ class RoutingTable:
 class DHTNode:
     """One DHT participant bound to a Transport."""
 
-    def __init__(self, transport: Transport):
+    def __init__(self, transport: Transport, maintenance_interval: float = 15.0):
         self.transport = transport
         self.node_id: int = 0  # assigned at start() once the port is known
         self.table: Optional[RoutingTable] = None
         # key -> {subkey -> (json_value, expiry_monotonic)}
         self.storage: Dict[str, Dict[str, Tuple[str, float]]] = {}
+        # Records THIS node stored via store(): republished to the (possibly
+        # changed) k-closest set until their TTL runs out, so a record
+        # survives its original replicas churning away.
+        self._owned: Dict[Tuple[str, str], Tuple[str, float]] = {}
         self._last_sweep = time.monotonic()
+        self.maintenance_interval = maintenance_interval
+        self._maint_task: Optional[asyncio.Task] = None
+        self._tasks: Set[asyncio.Task] = set()
+        self._pinging: Set[int] = set()  # LRS nodes with a probe in flight
         transport.register("dht.ping", self._rpc_ping)
         transport.register("dht.store", self._rpc_store)
         transport.register("dht.find", self._rpc_find)
@@ -126,20 +149,64 @@ class DHTNode:
                 ret, _ = await self.transport.call(
                     tuple(peer), "dht.ping", {"sender": self._self_info()}, timeout=5.0
                 )
-                self.table.add(int(ret["id"]), tuple(ret["addr"]))
+                self._add_contact(int(ret["id"]), tuple(ret["addr"]))
             except (RPCError, OSError, asyncio.TimeoutError) as e:
                 log.warning("bootstrap peer %s unreachable: %s", peer, e)
         if bootstrap:
             # Standard Kademlia join: lookup own id to populate the table.
             await self._lookup(self.node_id)
+        if self.maintenance_interval > 0:
+            self._maint_task = asyncio.create_task(self._maintenance_loop())
+
+    async def stop(self) -> None:
+        """Cancel background maintenance (pings, refresh, republish)."""
+        for task in [self._maint_task, *self._tasks]:
+            if task is not None and not task.done():
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        self._maint_task = None
+        self._tasks.clear()
 
     def _self_info(self) -> dict:
         return {"id": str(self.node_id), "addr": list(self.transport.addr)}
 
+    def _add_contact(self, nid: int, addr: Addr) -> None:
+        """Routing-table insert with PING-BEFORE-EVICT: when the bucket is
+        full, probe its least-recently-seen node; only a dead one is
+        replaced (a live stable node beats an unknown newcomer)."""
+        if self.table is None:
+            return
+        cand = self.table.add(nid, addr)
+        if cand is None:
+            return
+        lrs_nid, lrs_addr = cand
+        if lrs_nid in self._pinging:
+            return  # probe already in flight; drop the newcomer for now
+        self._pinging.add(lrs_nid)
+
+        async def probe():
+            try:
+                try:
+                    await self.transport.call(
+                        lrs_addr, "dht.ping", {"sender": self._self_info()}, timeout=3.0
+                    )
+                    self.table.add(lrs_nid, lrs_addr)  # alive: refresh to MRU
+                except (RPCError, OSError, asyncio.TimeoutError):
+                    self.table.replace(lrs_nid, nid, addr)
+            finally:
+                self._pinging.discard(lrs_nid)
+
+        task = asyncio.create_task(probe())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
     def _note_sender(self, args: dict) -> None:
         sender = args.get("sender")
         if sender and self.table is not None:
-            self.table.add(int(sender["id"]), tuple(sender["addr"]))
+            self._add_contact(int(sender["id"]), tuple(sender["addr"]))
 
     # -- RPC handlers ------------------------------------------------------
 
@@ -210,7 +277,7 @@ class DHTNode:
                     self.table.remove(nid)
                     shortlist.pop(nid, None)
                     continue
-                self.table.add(nid, shortlist[nid])
+                self._add_contact(nid, shortlist[nid])
                 for nid_s, addr in ret.get("nodes", []):
                     n = int(nid_s)
                     if n != self.node_id and n not in queried:
@@ -223,23 +290,63 @@ class DHTNode:
         closest = sorted(shortlist.items(), key=lambda na: na[0] ^ target)[:K]
         return closest, found_values
 
+    # -- maintenance (refresh / republish) ---------------------------------
+
+    async def _maintenance_loop(self) -> None:
+        """Periodic table refresh + owned-record republish.
+
+        Refresh: look up a random id in a random non-empty bucket's range
+        (plus the node's own id), so stale buckets relearn the topology and
+        dead contacts get pruned even when the application is idle.
+        Republish: push every still-live owned record to the CURRENT
+        k-closest set — nodes that joined closer to the key since the
+        original store get a replica; without this, a rolling restart of the
+        original replica set silently loses live records."""
+        while True:
+            await asyncio.sleep(self.maintenance_interval)
+            try:
+                await self._republish_owned()
+                await self._refresh_bucket()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — maintenance must not die
+                log.debug("dht maintenance iteration failed: %s", e)
+
+    async def _republish_owned(self) -> None:
+        now = time.monotonic()
+        for (key, subkey) in list(self._owned):
+            value_json, expiry = self._owned[(key, subkey)]
+            if expiry <= now:
+                del self._owned[(key, subkey)]
+                continue
+            # Remaining ttl, not the original: republish must never extend a
+            # record's life beyond what its owner asked for.
+            await self._store_raw(key, subkey, value_json, expiry - now)
+
+    async def _refresh_bucket(self) -> None:
+        nonempty = [i for i, b in enumerate(self.table.buckets) if b]
+        if not nonempty:
+            return
+        i = random.choice(nonempty)
+        # A random id at XOR-distance with highest bit i from ourselves.
+        rand = random.getrandbits(i) | (1 << i) if i else 1
+        await self._lookup(self.node_id ^ rand)
+
     # -- public API --------------------------------------------------------
 
-    async def store(self, key: str, value: object, subkey: str = "", ttl: float = 60.0) -> int:
-        """Store (replicated to the K closest nodes incl. possibly self)."""
-        self._sweep_storage()
+    async def _store_raw(self, key: str, subkey: str, value_json: str, ttl: float) -> int:
         target = key_id(key)
         closest, _ = await self._lookup(target)
         payload_args = {
             "key": key,
             "subkey": subkey,
-            "value": json.dumps(value),
+            "value": value_json,
             "ttl": ttl,
             "sender": self._self_info(),
         }
         # Always keep a local replica too: tiny swarms (N < K) stay robust.
         rec = self.storage.setdefault(key, {})
-        rec[subkey] = (json.dumps(value), time.monotonic() + ttl)
+        rec[subkey] = (value_json, time.monotonic() + ttl)
         ok = 1
         for nid, addr in closest:
             try:
@@ -248,6 +355,15 @@ class DHTNode:
             except (RPCError, OSError, asyncio.TimeoutError):
                 self.table.remove(nid)
         return ok
+
+    async def store(self, key: str, value: object, subkey: str = "", ttl: float = 60.0) -> int:
+        """Store (replicated to the K closest nodes incl. possibly self).
+        Owned records are republished to the current closest set until their
+        TTL expires (see _maintenance_loop)."""
+        self._sweep_storage()
+        value_json = json.dumps(value)
+        self._owned[(key, subkey)] = (value_json, time.monotonic() + ttl)
+        return await self._store_raw(key, subkey, value_json, ttl)
 
     async def get(self, key: str) -> Dict[str, object]:
         """All live subkeys of ``key``, merged across replicas."""

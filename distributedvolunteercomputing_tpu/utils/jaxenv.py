@@ -52,3 +52,14 @@ def pin_platform(
 
         jax.config.update("jax_platforms", want)
     return want or None
+
+
+def tpu_backend() -> bool:
+    """True when the default backend is TPU silicon — including the sandbox's
+    "axon" PJRT plugin (a real TPU chip behind a tunnel, platform-named axon).
+    The single source of truth for is-this-a-TPU decisions (bf16 compute
+    dtype, pallas kernel routing): checking ``== "tpu"`` alone silently
+    degrades the axon chip to the non-TPU code paths."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")

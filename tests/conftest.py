@@ -22,6 +22,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: payload-scale / long-running tests (run explicitly or in full sweeps)"
+    )
+
+
 @pytest.fixture(scope="session")
 def rng():
     import jax

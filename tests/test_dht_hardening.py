@@ -1,0 +1,126 @@
+"""DHT hardening (VERDICT r2 #8): ping-before-evict, owned-record republish,
+periodic refresh — proven by a 16-node rolling-restart churn scenario.
+
+The round-1/2 table used blind LRS-drop and never republished, which is fine
+at n=4 but silently loses live records at 16+ under churn: a record's
+original k-closest replica set can be entirely restarted away while the
+owner still considers the record live.
+"""
+
+import asyncio
+
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode, RoutingTable, K
+from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+class TestPingBeforeEvict:
+    def test_full_bucket_returns_candidate_not_blind_drop(self):
+        table = RoutingTable(own_id=0)
+        # ids 2^150 + j all land in bucket 150 relative to own_id 0
+        ids = [(1 << 150) + j for j in range(K + 1)]
+        for j in range(K):
+            assert table.add(ids[j], ("127.0.0.1", 1000 + j)) is None
+        cand = table.add(ids[K], ("127.0.0.1", 2000))
+        assert cand == (ids[0], ("127.0.0.1", 1000)), "LRS must be the candidate"
+        # newcomer NOT inserted until the caller decides
+        assert ids[K] not in [nid for nid, _ in table.buckets[150]]
+        # touching an existing contact moves it to MRU and returns None
+        assert table.add(ids[1], ("127.0.0.1", 1001)) is None
+        assert table.buckets[150][-1][0] == ids[1]
+
+    def test_dead_lrs_is_replaced_live_lrs_survives(self):
+        async def scenario():
+            t_self = Transport()
+            node = DHTNode(t_self, maintenance_interval=0)  # no background noise
+            await node.start()
+            t_live = Transport()
+            live_peer = DHTNode(t_live, maintenance_interval=0)
+            await live_peer.start()
+            try:
+                bucket_i = 150
+                base = node.node_id ^ (1 << bucket_i)
+                # Fill one bucket: LRS is a DEAD addr (closed port), rest dead too.
+                for j in range(K):
+                    node.table.add(base + j, ("127.0.0.1", 9))  # nothing listens
+                newcomer = base + K
+                node._add_contact(newcomer, ("127.0.0.1", 7777))
+                await asyncio.sleep(0)  # let the probe task start
+                for _ in range(100):
+                    if not node._pinging:
+                        break
+                    await asyncio.sleep(0.1)
+                in_bucket = [nid for nid, _ in node.table.buckets[bucket_i]]
+                assert newcomer in in_bucket, "dead LRS must be evicted for the newcomer"
+                assert base not in in_bucket
+
+                # Now the LRS is a LIVE node: it must survive, newcomer2 dropped.
+                node.table.remove(in_bucket[0])
+                live_id = base + 50
+                bucket = node.table.buckets[bucket_i]
+                bucket.insert(0, (live_id, t_live.addr))  # live contact as LRS
+                newcomer2 = base + K + 1
+                node._add_contact(newcomer2, ("127.0.0.1", 7778))
+                for _ in range(100):
+                    if not node._pinging:
+                        break
+                    await asyncio.sleep(0.1)
+                in_bucket = [nid for nid, _ in node.table.buckets[bucket_i]]
+                assert live_id in in_bucket, "live LRS must survive the probe"
+                assert newcomer2 not in in_bucket
+                assert in_bucket[-1] == live_id, "probed-alive LRS moves to MRU"
+            finally:
+                await node.stop()
+                await live_peer.stop()
+                await t_self.close()
+                await t_live.close()
+
+        run(scenario())
+
+
+def test_sixteen_node_rolling_restart_keeps_live_records():
+    """Half the swarm (incl. most of a record's original replica set) is
+    restarted with FRESH identities; the owner's republish + bucket refresh
+    must make the record reachable from the new nodes."""
+
+    async def scenario():
+        nodes = []
+        boot = None
+        try:
+            for i in range(16):
+                t = Transport()
+                d = DHTNode(t, maintenance_interval=0.4)
+                await d.start(bootstrap=[boot] if boot else None)
+                if boot is None:
+                    boot = t.addr
+                nodes.append([t, d])
+            # Node 0 owns a long-lived record (e.g. a coordinator rendezvous).
+            await nodes[0][1].store("svc/rendezvous", {"v": 42}, subkey="owner", ttl=90)
+            # Rolling restart: nodes 8..15 die and are replaced by NEW nodes
+            # (new ports => new DHT ids), bootstrapped via a survivor.
+            for i in range(8, 16):
+                t, d = nodes[i]
+                await d.stop()
+                await t.close()
+                t2 = Transport()
+                d2 = DHTNode(t2, maintenance_interval=0.4)
+                await d2.start(bootstrap=[nodes[1][0].addr])
+                nodes[i] = [t2, d2]
+                await asyncio.sleep(0.1)
+            # A couple of maintenance cycles: republish to the new closest
+            # set, refresh buckets past the dead contacts.
+            await asyncio.sleep(1.5)
+            for i in (8, 11, 15):
+                rec = await nodes[i][1].get("svc/rendezvous")
+                assert rec.get("owner") == {"v": 42}, (
+                    f"record lost after rolling restart (node {i} sees {rec})"
+                )
+        finally:
+            for t, d in nodes:
+                await d.stop()
+                await t.close()
+
+    run(scenario())

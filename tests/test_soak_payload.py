@@ -1,0 +1,61 @@
+"""Payload-scale soak: one sync averaging round at GPT-2-small REAL size.
+
+Everything else in the suite exchanges MB-range trees; config 4's real round
+ships the full 124M-param tree (~498 MB f32, ~249 MB over the bf16 wire)
+against gather timeouts and the transport's 2 GiB frame guard
+(BASELINE.json:10). This exercises exactly that shape on localhost so frame
+limits, timeout budgets, and checksum throughput surface here rather than on
+hardware. Marked slow; run explicitly with `-m slow` or as part of the full
+sweep (no -m filter).
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_averaging import run, spawn_volunteers, teardown
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+
+GPT2_SMALL_FLOATS = 124_439_808  # models/gpt2.py default config param count
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("wire", ["f32", "bf16"])
+def test_sync_round_at_gpt2_small_scale(wire):
+    async def main():
+        tree_a = {"flat": np.full((GPT2_SMALL_FLOATS,), 1.0, np.float32)}
+        tree_b = {"flat": np.full((GPT2_SMALL_FLOATS,), 3.0, np.float32)}
+        # Generous timeouts: the suite runs on ONE shared CPU core, and this
+        # test can start while a previous e2e test's subprocesses are still
+        # winding down — the budget guards against stalls, not contention.
+        vols = await spawn_volunteers(
+            2, SyncAverager, wire=wire, gather_timeout=150.0, join_timeout=40.0
+        )
+        try:
+            t0 = time.monotonic()
+            ra, rb = await asyncio.gather(
+                vols[0][3].average(tree_a, round_no=1),
+                vols[1][3].average(tree_b, round_no=1),
+            )
+            dt = time.monotonic() - t0
+        finally:
+            await teardown(vols)
+        return ra, rb, dt
+
+    ra, rb, dt = run_long(main())
+    assert ra is not None and rb is not None, "round failed at payload scale"
+    # mean(1, 3) = 2 exactly in f32; bf16 wire rounds each CONTRIBUTION, and
+    # 1.0/3.0 are exactly representable in bf16, so the mean is still exact.
+    np.testing.assert_allclose(ra["flat"][:1000], 2.0, rtol=1e-6)
+    np.testing.assert_allclose(rb["flat"][-1000:], 2.0, rtol=1e-6)
+    np.testing.assert_allclose(float(ra["flat"].mean()), 2.0, rtol=1e-6)
+    # Timing budget: ~1 GB of localhost TCP + CRC + reduce. Generous bound —
+    # this catches pathological stalls (frame re-assembly, checksum thrash),
+    # not single-core scheduling jitter.
+    assert dt < 240.0, f"payload-scale round took {dt:.1f}s"
+
+
+def run_long(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=420))
