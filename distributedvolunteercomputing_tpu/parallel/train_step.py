@@ -91,6 +91,7 @@ def make_sharded_train_step(
     mesh: Mesh,
     donate: bool = True,
     seq_sharded_batch: bool = False,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the jitted sharded ``(state, batch) -> (state, metrics)`` step.
 
@@ -114,8 +115,8 @@ def make_sharded_train_step(
             from distributedvolunteercomputing_tpu.ops.attention import sequence_parallel
 
             with sequence_parallel(mesh, "sp"):
-                return train_step_body(loss_fn, tx, state, batch)
-        return train_step_body(loss_fn, tx, state, batch)
+                return train_step_body(loss_fn, tx, state, batch, accum_steps)
+        return train_step_body(loss_fn, tx, state, batch, accum_steps)
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 

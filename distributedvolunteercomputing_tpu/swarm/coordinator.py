@@ -39,6 +39,9 @@ class Coordinator:
         self.transport.register("coord.status", self._rpc_status)
 
     async def start(self) -> Tuple[str, int]:
+        from distributedvolunteercomputing_tpu.utils.asyncio_debug import maybe_enable_from_env
+
+        maybe_enable_from_env()  # DVC_ASYNC_DEBUG=1: loop stall/race detectors
         addr = await self.transport.start()
         await self.dht.start(bootstrap=None)
         log.info("coordinator listening on %s:%d", *addr)

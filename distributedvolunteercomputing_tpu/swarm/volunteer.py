@@ -57,7 +57,8 @@ class VolunteerConfig:
     wire: str = "f32"  # f32|bf16 — WAN payload codec (bf16 halves DCN bytes)
     min_group: int = 2
     max_group: int = 16
-    batch_size: int = 32
+    batch_size: int = 32  # samples per optimizer step (across accum microbatches)
+    accum_steps: int = 1  # gradient-accumulation microbatches inside the step
     data_path: Optional[str] = None  # .npz real-data file; None = synthetic
     optimizer: str = "adam"
     lr: float = 1e-3
@@ -126,6 +127,9 @@ class Volunteer:
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        from distributedvolunteercomputing_tpu.utils.asyncio_debug import maybe_enable_from_env
+
+        maybe_enable_from_env()  # DVC_ASYNC_DEBUG=1: loop stall/race detectors
         await self.transport.start()
         bootstrap = None
         if self.cfg.coordinator:
@@ -166,15 +170,17 @@ class Volunteer:
         bundle = get_model(self.cfg.model, **self.cfg.model_overrides)
         on_step = None
         if self.cfg.checkpoint_dir and self.cfg.checkpoint_every > 0:
-            from distributedvolunteercomputing_tpu.training.checkpoint import save
+            from distributedvolunteercomputing_tpu.training.checkpoint import save_async
 
             ckpt_dir, every = self.cfg.checkpoint_dir, self.cfg.checkpoint_every
 
             def on_step(trainer, step_no):
                 # Periodic snapshot: a kill -9 between saves loses at most
-                # checkpoint_every steps, not the whole run.
+                # checkpoint_every steps, not the whole run. Async: the D2H
+                # copy happens here, the file write on a background thread —
+                # the device never idles on disk I/O.
                 if step_no % every == 0:
-                    save(trainer, ckpt_dir)
+                    save_async(trainer, ckpt_dir)
 
         data = None
         if self.cfg.data_path:
@@ -198,6 +204,7 @@ class Volunteer:
             lr=self.cfg.lr,
             seed=self.cfg.seed,
             init_seed=self.cfg.init_seed,
+            accum_steps=self.cfg.accum_steps,
             average_every=self.cfg.average_every,
             averager=self._averager_callback if self.averager else None,
             average_what=self.cfg.average_what,
@@ -290,9 +297,21 @@ class Volunteer:
             stop_flag=self._stop.is_set,
         )
         if self.cfg.checkpoint_dir:
-            from distributedvolunteercomputing_tpu.training.checkpoint import save
+            from distributedvolunteercomputing_tpu.training.checkpoint import (
+                latest_step,
+                save,
+                wait_pending_saves,
+            )
 
-            save(self.trainer, self.cfg.checkpoint_dir)
+            # Final save is SYNCHRONOUS (preemption-safe), after draining any
+            # in-flight periodic write so it can't race an older write to the
+            # same path. Skip it entirely when the drained async save already
+            # covers the current step (run ended exactly on a cadence point —
+            # rewriting an identical full-TrainState snapshot is pure waste).
+            if wait_pending_saves(self.trainer) and latest_step(
+                self.cfg.checkpoint_dir
+            ) != int(self.trainer.state.step):
+                save(self.trainer, self.cfg.checkpoint_dir)
         return result
 
     async def run(self) -> Dict[str, float]:

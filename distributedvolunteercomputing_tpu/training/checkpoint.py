@@ -43,6 +43,69 @@ def save(trainer, ckpt_dir: str) -> str:
     return path
 
 
+def save_async(trainer, ckpt_dir: str) -> bool:
+    """Periodic-save path: snapshot to HOST on the caller's thread (one D2H
+    COPY — np.array, never np.asarray: on the CPU backend asarray can alias
+    the live jax buffer, which the donating train step then reuses while the
+    writer thread is mid-serialization, silently corrupting the snapshot),
+    then write the file on a background thread so the device never idles on
+    disk I/O. At most one save in flight PER TRAINER — if its previous
+    write is still running, skip this point (the next cadence retries; a
+    skipped periodic save just widens one interval). The FINAL save at exit
+    must drain via ``wait_pending_saves`` and then use ``save``.
+    Returns True if a save was started."""
+    import threading
+
+    prev = getattr(trainer, "_ckpt_writer", None)
+    if prev is not None and prev.is_alive():
+        log.info("checkpoint still writing; skipping this save point")
+        return False
+    host_tree = jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True), _state_to_pytree(trainer)
+    )
+    step = int(host_tree["step"])
+    path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
+
+    def _write():
+        import orbax.checkpoint as ocp
+
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                ckptr.save(path, host_tree, force=True)
+            log.info("checkpoint saved (async): %s", path)
+        except Exception as e:  # noqa: BLE001 — a failed periodic save must not kill training
+            log.warning("async checkpoint save failed: %s", e)
+
+    t = threading.Thread(target=_write, name="ckpt-writer", daemon=True)
+    trainer._ckpt_writer = t
+    t.start()
+    return True
+
+
+def wait_pending_saves(trainer, hard_cap: float = 600.0) -> bool:
+    """Block until THIS trainer's in-flight async save lands. Returns True
+    when nothing is in flight anymore; False if the writer is still alive
+    after ``hard_cap`` (e.g. dead NFS) — in that case the caller must NOT
+    write the same directory (concurrent orbax writes to one path corrupt
+    both), and should skip its synchronous save."""
+    import time as _time
+
+    t = getattr(trainer, "_ckpt_writer", None)
+    if t is None or not t.is_alive():
+        return True
+    deadline = _time.monotonic() + hard_cap
+    while t.is_alive():
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            log.error(
+                "async checkpoint writer still running after %.0fs; "
+                "skipping the conflicting synchronous save", hard_cap,
+            )
+            return False
+        t.join(min(remaining, 10.0))
+    return True
+
+
 def latest_step(ckpt_dir: str) -> Optional[int]:
     if not os.path.isdir(ckpt_dir):
         return None

@@ -45,12 +45,53 @@ def grad_half(
     loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
     state: TrainState,
     batch: Batch,
+    accum_steps: int = 1,
 ) -> Tuple[Any, Metrics, jax.Array]:
-    """fwd/bwd half of the step: (grads, metrics, next_rng)."""
+    """fwd/bwd half of the step: (grads, metrics, next_rng).
+
+    ``accum_steps > 1`` runs gradient accumulation INSIDE the compiled step:
+    the batch's leading dim is split into ``accum_steps`` microbatches and
+    scanned (``lax.scan`` — one microbatch's HLO in the program, activation
+    memory of ONE microbatch), grads averaged across them. The optimizer
+    semantics are identical to one big batch; only peak activation memory
+    changes — the TPU-idiomatic way to train effective batch sizes that
+    don't fit HBM."""
     rng, step_rng = jax.random.split(state.rng)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-    (_, metrics), grads = grad_fn(state.params, batch, step_rng)
-    metrics = dict(metrics)
+    if accum_steps <= 1:
+        (_, metrics), grads = grad_fn(state.params, batch, step_rng)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return grads, metrics, rng
+
+    micro = jax.tree_util.tree_map(
+        lambda x: x.reshape((accum_steps, x.shape[0] // accum_steps) + x.shape[1:]),
+        batch,
+    )
+
+    def body(carry, mb_and_rng):
+        g_acc, m_acc = carry
+        mb, r = mb_and_rng
+        (_, m), g = grad_fn(state.params, mb, r)
+        g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+        m_acc = jax.tree_util.tree_map(jnp.add, m_acc, m)
+        return (g_acc, m_acc), None
+
+    g0 = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+    # One traced microbatch probe would double compile time; metrics trees in
+    # the zoo are scalar-valued, so zeros of scalars is the right init.
+    m0 = jax.eval_shape(
+        lambda p, b, r: loss_fn(p, b, r)[1],
+        state.params,
+        jax.tree_util.tree_map(lambda x: x[0], micro),
+        step_rng,
+    )
+    m0 = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), m0)
+    rngs = jax.random.split(step_rng, accum_steps)
+    (g_sum, m_sum), _ = jax.lax.scan(body, (g0, m0), (micro, rngs))
+    inv = 1.0 / accum_steps
+    grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+    metrics = dict(jax.tree_util.tree_map(lambda m: m * inv, m_sum))
     metrics["grad_norm"] = optax.global_norm(grads)
     return grads, metrics, rng
 
@@ -72,11 +113,12 @@ def train_step_body(
     tx: optax.GradientTransformation,
     state: TrainState,
     batch: Batch,
+    accum_steps: int = 1,
 ) -> Tuple[TrainState, Metrics]:
     """The traced step math, shared by the single-device step, the sharded
     step (parallel/train_step.py), and — via its two halves — the split
     grad/apply steps of gradient-averaging mode, so no path can diverge."""
-    grads, metrics, rng = grad_half(loss_fn, state, batch)
+    grads, metrics, rng = grad_half(loss_fn, state, batch, accum_steps)
     return apply_half(tx, state, grads, rng), metrics
 
 
@@ -84,17 +126,19 @@ def make_train_step(
     loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
     tx: optax.GradientTransformation,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the jitted ``(state, batch) -> (state, metrics)`` step."""
 
     def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
-        return train_step_body(loss_fn, tx, state, batch)
+        return train_step_body(loss_fn, tx, state, batch, accum_steps)
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 def make_grad_step(
     loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, Batch], Tuple[Any, Metrics, jax.Array]]:
     """Gradient-averaging mode, half 1: fwd/bwd WITHOUT the update.
 
@@ -103,7 +147,7 @@ def make_grad_step(
     forces the grads out to host between bwd and update, so the fused step
     splits into (grad_step, apply_step). State is NOT donated here — the
     same state is consumed again by apply_step."""
-    return jax.jit(lambda state, batch: grad_half(loss_fn, state, batch))
+    return jax.jit(lambda state, batch: grad_half(loss_fn, state, batch, accum_steps))
 
 
 def make_apply_step(

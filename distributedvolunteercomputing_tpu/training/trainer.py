@@ -57,6 +57,10 @@ class Trainer:
         lr: float = 1e-3,
         seed: int = 0,
         init_seed: int = 0,
+        # Microbatch count per optimizer step (gradient accumulation inside
+        # the compiled step); batch_size must divide evenly. Semantics match
+        # one big batch — only peak activation memory changes.
+        accum_steps: int = 1,
         average_every: int = 10,
         averager: Optional[AveragerFn] = None,
         # params: local-SGD, averaged every `average_every` steps.
@@ -76,8 +80,13 @@ class Trainer:
     ):
         if average_what not in ("params", "grads"):
             raise ValueError(f"unknown average_what {average_what!r}")
+        if accum_steps < 1 or batch_size % accum_steps != 0:
+            raise ValueError(
+                f"accum_steps={accum_steps} must be >=1 and divide batch_size={batch_size}"
+            )
         self.bundle = bundle
         self.batch_size = batch_size
+        self.accum_steps = accum_steps
         self.average_every = average_every
         self.averager = averager
         self.average_what = average_what
@@ -111,11 +120,13 @@ class Trainer:
         )
         self._inflight: Optional[tuple] = None  # (launch_step, payload0, future)
         if self._grads_mode:
-            self._grad_fn = make_grad_step(bundle.loss_fn)
+            self._grad_fn = make_grad_step(bundle.loss_fn, accum_steps=accum_steps)
             self._apply_fn = make_apply_step(self.tx)
             self._step_fn = None
         else:
-            self._step_fn = make_train_step(bundle.loss_fn, self.tx)
+            self._step_fn = make_train_step(
+                bundle.loss_fn, self.tx, accum_steps=accum_steps
+            )
         self._data_rng = data_rng
         self._data = data
         self.metrics = MetricsWriter(metrics_path, volunteer_id)

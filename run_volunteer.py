@@ -56,7 +56,12 @@ def main() -> None:
     ap.add_argument("--max-group", type=int, default=16)
     ap.add_argument("--method", default="trimmed_mean",
                     help="byzantine estimator: trimmed_mean|median|krum|geometric_median")
-    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=32,
+                    help="samples per optimizer step (split across --accum-steps)")
+    ap.add_argument("--accum-steps", type=int, default=1,
+                    help="gradient-accumulation microbatches inside the compiled "
+                         "step; lets slow/small volunteers train the same "
+                         "effective batch in less HBM")
     ap.add_argument("--data", default=None,
                     help=".npz of aligned arrays (keys = the model's batch schema); default synthetic")
     ap.add_argument("--optimizer", default="adam")
@@ -100,6 +105,7 @@ def main() -> None:
         max_group=args.max_group,
         method=args.method,
         batch_size=args.batch_size,
+        accum_steps=args.accum_steps,
         data_path=args.data,
         optimizer=args.optimizer,
         lr=args.lr,

@@ -115,3 +115,61 @@ def test_delay_jitter_still_averages():
             await t1.close()
 
     run(scenario())
+
+
+class TestAsyncioInvariants:
+    """Loop stall/race detection (SURVEY.md §5): the swarm tier's invariant
+    is a RESPONSIVE event loop — a handler blocking the loop freezes
+    heartbeats and masquerades as churn."""
+
+    def test_monitor_catches_a_blocking_handler(self):
+        from distributedvolunteercomputing_tpu.utils.asyncio_debug import LoopHealthMonitor
+
+        async def scenario():
+            mon = LoopHealthMonitor(interval=0.02, stall_threshold=0.15).start()
+            await asyncio.sleep(0.1)  # settle
+            import time as _time
+
+            _time.sleep(0.4)  # a misbehaving "handler" blocking the loop
+            await asyncio.sleep(0.1)  # let the sentinel wake and measure
+            await mon.stop()
+            return mon.stalls
+
+        stalls = run(scenario())
+        assert stalls, "monitor must record the 0.4s loop blockage"
+        assert max(lag for _, lag in stalls) > 0.3
+
+    def test_averaging_round_keeps_the_loop_responsive(self):
+        """A real sync round (matchmaking + gather + reduce) must never hold
+        the loop longer than the stall threshold — param-sized work belongs
+        off-loop (to_thread / native)."""
+        from distributedvolunteercomputing_tpu.utils.asyncio_debug import LoopHealthMonitor
+
+        async def scenario():
+            mon = LoopHealthMonitor(interval=0.02, stall_threshold=0.25).start()
+            t0 = ChaosTransport(seed=1)
+            dht0 = DHTNode(t0)
+            await dht0.start()
+            mem0 = SwarmMembership(dht0, "s0", ttl=10.0)
+            await mem0.join()
+            a0 = SyncAverager(t0, dht0, mem0, join_timeout=8.0, gather_timeout=8.0)
+            t1 = ChaosTransport(seed=2)
+            dht1 = DHTNode(t1)
+            await dht1.start(bootstrap=[t0.addr])
+            mem1 = SwarmMembership(dht1, "s1", ttl=10.0)
+            await mem1.join()
+            a1 = SyncAverager(t1, dht1, mem1, join_timeout=8.0, gather_timeout=8.0)
+            try:
+                tree = {"w": np.zeros((1 << 20,), np.float32)}  # 4 MB payload
+                r = await asyncio.gather(
+                    a0.average(tree, 0), a1.average(dict(tree), 0)
+                )
+                assert r[0] is not None and r[1] is not None
+            finally:
+                await t0.close()
+                await t1.close()
+            await mon.stop()
+            return mon.stalls
+
+        stalls = run(scenario())
+        assert not stalls, f"averaging round blocked the loop: {stalls}"

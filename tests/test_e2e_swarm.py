@@ -224,3 +224,29 @@ class TestSwarmE2E:
         )
         s2, out2 = wait_done(v2)
         assert s2["steps"] == 25, f"resume failed (expected 20+5):\n{out2}"
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """save_async writes the same restorable snapshot as save, off-thread."""
+    from distributedvolunteercomputing_tpu.models import get_model
+    from distributedvolunteercomputing_tpu.training import checkpoint
+    from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+    ckpt = str(tmp_path / "ck")
+    t1 = Trainer(get_model("mnist_mlp", d_hidden=16), batch_size=8, seed=3)
+    t1.run(steps=7, log_every=0)
+    assert checkpoint.save_async(t1, ckpt)
+    assert checkpoint.wait_pending_saves(t1)
+    assert checkpoint.latest_step(ckpt) == 7
+
+    t2 = Trainer(get_model("mnist_mlp", d_hidden=16), batch_size=8, seed=99)
+    assert checkpoint.maybe_restore(t2, ckpt)
+    assert int(t2.state.step) == 7
+    import jax
+    import numpy as np
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(t1.state.params),
+        jax.tree_util.tree_leaves(t2.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

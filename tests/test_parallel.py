@@ -101,6 +101,34 @@ def test_sharded_step_matches_single_device(eight_devices, dp, tp):
     assert float(metrics2["loss"]) == float(metrics2["loss"])
 
 
+def test_sharded_step_with_accum_matches_single_device(eight_devices):
+    # Gradient accumulation inside the SHARDED step: dp-sharded [accum*B]
+    # batch scanned as microbatches; numerics must still match the
+    # single-device big-batch step.
+    bundle = get_model("gpt2_small", **TINY_GPT2)
+    tx = make_optimizer("adam", lr=1e-3)
+    params = bundle.init(jax.random.PRNGKey(0))
+    batch = bundle.make_batch(jax.random.PRNGKey(1), 16)
+
+    ref_state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+    ref_step = make_train_step(bundle.loss_fn, tx, donate=False)
+    ref_state, ref_metrics = ref_step(ref_state, batch)
+
+    mesh = make_mesh(dp=2, tp=4)
+    state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+    state, _ = shard_train_state(state, mesh, tx)
+    step = make_sharded_train_step(bundle.loss_fn, tx, mesh, donate=False, accum_steps=2)
+    state, metrics = step(state, put_batch(batch, mesh))
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-4
+    )
+    got = jax.device_get(state.params["blocks"]["qkv"]["w"])
+    np.testing.assert_allclose(
+        got, np.asarray(ref_state.params["blocks"]["qkv"]["w"]), rtol=1e-3, atol=1e-5
+    )
+
+
 def test_sharded_step_llama_lora(eight_devices):
     bundle = get_model(
         "llama_lora", vocab=256, max_len=32, d_model=64, n_heads=4, n_kv_heads=4,
