@@ -224,9 +224,18 @@ def _passthrough_json(stdout: str) -> int | None:
 
 
 def _devices_with_retry(retries: int, base_delay: float):
-    """jax.devices() with bounded retries: the axon TPU plugin's backend init
-    is flaky at setup time (round-1 rc=1 was exactly this), and jax caches the
-    failure, so each retry clears the failed-backend cache first."""
+    """jax.devices() with bounded retries AND an init-hang watchdog.
+
+    Two distinct failure modes on this chip (BENCH_r01/r02 + round-3
+    observation of multi-hour backend-init hangs): init RAISES
+    ("Unable to initialize backend", retried below with the failed-backend
+    cache cleared), and init HANGS inside the plugin. The hang is detected
+    here by running jax.devices() on a worker thread with its own deadline
+    (DVC_BENCH_INIT_TIMEOUT, default 90s) so the attempt fails FAST with an
+    attributed diagnostic instead of silently eating its whole deadline —
+    the parent can then spend the saved budget on more fresh-child retries."""
+    import concurrent.futures
+
     import jax
 
     from distributedvolunteercomputing_tpu.utils.jaxenv import pin_platform
@@ -235,10 +244,36 @@ def _devices_with_retry(retries: int, base_delay: float):
     # swallows it; see utils/jaxenv.py).
     pin_platform()
 
+    init_timeout = float(os.environ.get("DVC_BENCH_INIT_TIMEOUT", "90"))
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+
+    def devices_with_deadline():
+        fut = pool.submit(jax.devices)
+        try:
+            return fut.result(timeout=init_timeout)
+        except concurrent.futures.TimeoutError:
+            # The hung thread can't be killed; the child process is disposable
+            # (the parent spawns a fresh one), so report and die hard.
+            _emit(
+                {
+                    "metric": f"samples/sec/volunteer-chip "
+                    f"({os.environ.get('DVC_BENCH_MODEL', 'gpt2_small')})",
+                    "value": 0.0,
+                    "unit": "samples/sec/chip",
+                    "vs_baseline": 0.0,
+                    "error": f"backend init hung past {init_timeout:.0f}s "
+                    "(axon plugin wedged)",
+                    "stage": "backend_init_hang",
+                }
+            )
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(3)
+
     last: BaseException | None = None
     for attempt in range(retries):
         try:
-            return jax.devices()
+            return devices_with_deadline()
         except RuntimeError as err:  # "Unable to initialize backend ..."
             last = err
             import importlib

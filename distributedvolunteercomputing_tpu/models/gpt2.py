@@ -77,15 +77,42 @@ def _block(p: common.Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
     return x + h
 
 
-def hidden(params: common.Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
-    """Final-layer hidden states [B, T, d] (before the vocab projection)."""
+def embed(params: common.Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """Token + position embeddings [B, T, d] in compute dtype — the trunk's
+    input. Public so parallel/pipeline.py can wrap just the block trunk."""
     dtype = common.compute_dtype()
     t = tokens.shape[1]
-    x = (params["wte"][tokens] + params["wpe"][:t][None]).astype(dtype)
-    x = common.scan_blocks(
+    return (params["wte"][tokens] + params["wpe"][:t][None]).astype(dtype)
+
+
+def block_fn(p: common.Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """One block's pure function (public for the pipeline trunk)."""
+    return _block(p, x, cfg)
+
+
+def lm_loss_from_hidden(
+    params: common.Params, x: jax.Array, batch: Dict[str, jax.Array], cfg: GPT2Config
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Final LN + streamed tied-vocab xent, from post-trunk hidden states."""
+    x = common.layernorm(params["ln_f"], x)
+    loss = common.lm_xent_chunked(
+        x, params["wte"], batch["targets"], chunk=cfg.xent_chunk, head_layout="vd"
+    )
+    return loss, {"loss": loss}
+
+
+def _trunk(params: common.Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """embed -> scanned blocks (pre-ln_f). Shared by loss_fn and hidden so
+    the training and inference trunks can never drift apart."""
+    x = embed(params, tokens, cfg)
+    return common.scan_blocks(
         lambda p, h: _block(p, h, cfg), params["blocks"], x, remat=cfg.remat
     )
-    return common.layernorm(params["ln_f"], x)
+
+
+def hidden(params: common.Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """Final-layer hidden states [B, T, d] (after ln_f, pre vocab projection)."""
+    return common.layernorm(params["ln_f"], _trunk(params, tokens, cfg))
 
 
 def forward(params: common.Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
@@ -101,8 +128,4 @@ def forward(params: common.Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Ar
 def loss_fn(
     params: common.Params, batch: Dict[str, jax.Array], rng: jax.Array, cfg: GPT2Config
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    x = hidden(params, batch["tokens"], cfg)
-    loss = common.lm_xent_chunked(
-        x, params["wte"], batch["targets"], chunk=cfg.xent_chunk, head_layout="vd"
-    )
-    return loss, {"loss": loss}
+    return lm_loss_from_hidden(params, _trunk(params, batch["tokens"], cfg), batch, cfg)

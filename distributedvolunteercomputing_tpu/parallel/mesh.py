@@ -1,12 +1,15 @@
 """Device mesh construction for one volunteer slice.
 
-Axis convention (outer → inner): ``("dp", "sp", "tp")``.
+Axis convention (outer → inner): ``("dp", "sp", "pp", "tp")``.
 
 ``tp`` is innermost so tensor-parallel collectives (the per-layer
 allreduces) land on ICI-adjacent chips; ``dp`` is outermost because its one
 gradient reduction per step tolerates the longest hops. ``sp`` (sequence
-parallelism for long context) sits between: its ppermute ring wants
-neighbours closer than dp but is far less chatty than tp.
+parallelism's ppermute ring) and ``pp`` (pipeline stages' ppermute chain)
+sit between: both want contiguous neighbours but are far less chatty than
+tp. Axes of size 1 cost nothing — every mesh carries all four names so
+sharding rules and ``shard_map`` axis references never need to special-case
+which strategies are active.
 """
 
 from __future__ import annotations
@@ -17,24 +20,26 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "tp")
+AXES = ("dp", "sp", "pp", "tp")
 
 
 def make_mesh(
     dp: int = 1,
     sp: int = 1,
     tp: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a ``(dp, sp, tp)`` mesh from the first dp*sp*tp local devices."""
+    """Build a ``(dp, sp, pp, tp)`` mesh from the first dp*sp*pp*tp devices."""
     if devices is None:
         devices = jax.devices()
-    need = dp * sp * tp
+    need = dp * sp * pp * tp
     if len(devices) < need:
         raise ValueError(
-            f"mesh dp={dp} sp={sp} tp={tp} needs {need} devices, have {len(devices)}"
+            f"mesh dp={dp} sp={sp} pp={pp} tp={tp} needs {need} devices, "
+            f"have {len(devices)}"
         )
-    arr = np.asarray(devices[:need]).reshape(dp, sp, tp)
+    arr = np.asarray(devices[:need]).reshape(dp, sp, pp, tp)
     return Mesh(arr, AXES)
 
 

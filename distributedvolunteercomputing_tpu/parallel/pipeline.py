@@ -1,0 +1,158 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+Build-side extension beyond reference parity (the reference genre is
+volunteer-DP only, SURVEY.md §2 "Parallelism strategies") — but the
+TPU-native way to fit models whose LAYERS don't fit one chip: the stacked
+block pytree (models store blocks as one [L, ...] stack, models/common.py
+``stacked_init``) is sharded over ``pp`` on its layer axis by the partition
+rules (parallel/sharding.py), so each pipeline stage physically holds only
+L/P layers' weights, and the trunk runs a microbatch pipeline inside one
+``shard_map``:
+
+- tick t: stage s applies its layers to microbatch (t - s); activations hop
+  stage s -> s+1 over ICI via ``lax.ppermute`` (the same neighbour-chain
+  pattern as ring attention, parallel/ring_attention.py);
+- M microbatches drain in M + P - 1 ticks (bubble fraction (P-1)/(M+P-1));
+- the backward pipeline needs no scheduling code: autodiff of the tick scan
+  reverses the schedule, and ppermute's transpose is the inverted permute.
+
+Everything outside the trunk (embeddings, final LN, vocab head/loss) stays
+plain GSPMD — replicated over pp, sharded over dp/tp by the usual rules.
+Composes with dp (batch dim sharded over dp outside AND inside the
+shard_map) and with tp (the per-layer matmul rules still shard the feature
+dims; XLA places those collectives within each stage).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_trunk(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    blocks: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+    microbatches: Optional[int] = None,
+    remat: bool = True,
+) -> jax.Array:
+    """Run ``x`` [B, T, D] through pp-sharded stacked ``blocks``.
+
+    ``blocks`` leaves are [L, ...] sharded over ``axis`` on dim 0 (each
+    device holds its stage's L/P layers). ``x``'s batch dim is split into
+    ``microbatches`` (default P) equal microbatches; B % M == 0 required.
+    Returns [B, T, D], replicated over pp (sharding of other axes is
+    whatever GSPMD picks outside).
+    """
+    pp = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    if pp == 1:
+        # No pipeline: plain scan (common.scan_blocks without the import
+        # cycle — the checkpoint policy matches).
+        fn = jax.checkpoint(block_fn) if remat else block_fn
+
+        def step(h, p):
+            return fn(p, h), None
+
+        return jax.lax.scan(step, x, blocks)[0]
+
+    b = x.shape[0]
+    m = microbatches or pp
+    if b % m != 0:
+        raise ValueError(f"batch {b} must divide into {m} microbatches")
+    n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    if n_layers % pp != 0:
+        # Fail HERE with the actual precondition, not deep inside shard_map
+        # tracing; note the partition rules also decline to shard this case.
+        raise ValueError(
+            f"pipeline needs n_layers ({n_layers}) divisible by pp ({pp})"
+        )
+    mbs = x.reshape(m, b // m, *x.shape[1:])
+
+    # Manual over pp ONLY (jax.shard_map axis_names): dp/tp stay automatic,
+    # so the batch keeps its dp sharding and the block weights keep their tp
+    # feature sharding inside each stage — XLA places those collectives as
+    # usual; this code only schedules the pp hops.
+    blocks_spec = jax.tree_util.tree_map(lambda _: P(axis), blocks)
+
+    def run(stage_blocks, mbs):
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        n_ticks = m + pp - 1
+
+        def stage_apply(h):
+            fn = jax.checkpoint(block_fn) if remat else block_fn
+
+            def step(hh, p):
+                return fn(p, hh), None
+
+            return jax.lax.scan(step, h, stage_blocks)[0]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 ingests microbatch t (clamped once the feed runs dry —
+            # those ticks compute garbage that the output mask never keeps);
+            # later stages take the activation handed over by ppermute.
+            feed = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            inp = jnp.where(idx == 0, feed, state)
+            out = stage_apply(inp)
+            # The LAST stage finished microbatch (t - P + 1) this tick.
+            mb_done = t - (pp - 1)
+            slot = jnp.clip(mb_done, 0, m - 1)
+            keep = ((idx == pp - 1) & (mb_done >= 0)).astype(out.dtype)
+            cur = jax.lax.dynamic_index_in_dim(outputs, slot, 0, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, cur * (1 - keep) + out * keep, slot, 0
+            )
+            state = jax.lax.ppermute(out, axis, perm)
+            return (state, outputs), None
+
+        state0 = jnp.zeros_like(mbs[0])
+        outputs0 = jnp.zeros_like(mbs)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, outputs0), jnp.arange(n_ticks)
+        )
+        # Only the last stage holds real outputs (zeros elsewhere): one psum
+        # over pp replicates them to every stage.
+        return jax.lax.psum(outputs, axis)
+
+    out = jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(blocks_spec, P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )(blocks, mbs)
+    return out.reshape(b, *x.shape[1:])
+
+
+def make_pp_loss_fn_gpt2(cfg, mesh: Mesh, microbatches: Optional[int] = None):
+    """GPT-2 loss with the block trunk pipelined over ``pp``.
+
+    Drop-in replacement for the bundle's loss_fn: embeddings and the
+    streamed vocab loss stay plain GSPMD; only the trunk runs the
+    microbatch pipeline. Use with ``shard_train_state`` on a pp>1 mesh
+    (the partition rules place each stage's layers automatically).
+    """
+    from distributedvolunteercomputing_tpu.models import gpt2
+
+    def loss_fn(params, batch, rng):
+        x = gpt2.embed(params, batch["tokens"], cfg)
+        x = pipeline_trunk(
+            lambda p, h: gpt2.block_fn(p, h, cfg),
+            params["blocks"],
+            x,
+            mesh,
+            microbatches=microbatches,
+            remat=cfg.remat,
+        )
+        return gpt2.lm_loss_from_hidden(params, x, batch, cfg)
+
+    return loss_fn

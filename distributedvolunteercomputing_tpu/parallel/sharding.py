@@ -74,10 +74,31 @@ def _fit_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
 
 
 def partition_spec_for_path(path_str: str, shape: Tuple[int, ...], mesh: Mesh) -> P:
-    for pattern, spec in _RULES:
+    spec = P()
+    for pattern, rule_spec in _RULES:
         if re.match(pattern, "/" + path_str):
-            return _fit_spec(spec, shape, mesh)
-    return P()
+            spec = _fit_spec(rule_spec, shape, mesh)  # full rank after fit
+            break
+    # Pipeline parallelism: every per-layer leaf under a STACKED "blocks"
+    # subtree carries the layer axis first; with a pp axis active that axis
+    # is sharded over pp, so each stage holds only its own layers' params
+    # (parallel/pipeline.py consumes them under shard_map). Composes with
+    # the tp rules (e.g. [L, d_in, d_out] -> ("pp", None, "tp")). Unstacked
+    # legacy paths ("blocks/3/qkv/w") are left alone.
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    pp = axis_sizes.get("pp", 1)
+    if (
+        pp > 1
+        and "blocks/" in path_str
+        and re.search(r"blocks/\d+(/|$)", path_str) is None
+        and shape
+        and shape[0] % pp == 0
+    ):
+        padded = list(spec) if len(spec) == len(shape) else [None] * len(shape)
+        if padded[0] is None:
+            padded[0] = "pp"
+            spec = P(*padded)
+    return spec
 
 
 def make_param_shardings(mesh: Mesh, params: Any) -> Any:

@@ -41,7 +41,8 @@ class Coordinator:
     async def start(self) -> Tuple[str, int]:
         from distributedvolunteercomputing_tpu.utils.asyncio_debug import maybe_enable_from_env
 
-        maybe_enable_from_env()  # DVC_ASYNC_DEBUG=1: loop stall/race detectors
+        # DVC_ASYNC_DEBUG=1: loop stall/race detectors (stopped in close())
+        self._loop_monitor = maybe_enable_from_env()
         addr = await self.transport.start()
         await self.dht.start(bootstrap=None)
         log.info("coordinator listening on %s:%d", *addr)
@@ -49,6 +50,8 @@ class Coordinator:
 
     async def close(self) -> None:
         await self.dht.stop()
+        if getattr(self, "_loop_monitor", None) is not None:
+            await self._loop_monitor.stop()
         await self.transport.close()
 
     # -- RPCs --------------------------------------------------------------

@@ -129,7 +129,8 @@ class Volunteer:
         self._loop = asyncio.get_running_loop()
         from distributedvolunteercomputing_tpu.utils.asyncio_debug import maybe_enable_from_env
 
-        maybe_enable_from_env()  # DVC_ASYNC_DEBUG=1: loop stall/race detectors
+        # DVC_ASYNC_DEBUG=1: loop stall/race detectors (stopped at teardown)
+        self._loop_monitor = maybe_enable_from_env()
         await self.transport.start()
         bootstrap = None
         if self.cfg.coordinator:
@@ -305,12 +306,19 @@ class Volunteer:
 
             # Final save is SYNCHRONOUS (preemption-safe), after draining any
             # in-flight periodic write so it can't race an older write to the
-            # same path. Skip it entirely when the drained async save already
-            # covers the current step (run ended exactly on a cadence point —
-            # rewriting an identical full-TrainState snapshot is pure waste).
-            if wait_pending_saves(self.trainer) and latest_step(
-                self.cfg.checkpoint_dir
-            ) != int(self.trainer.state.step):
+            # same path. Skip it only when the drained async save covers the
+            # current state EXACTLY — same step AND same mutation count; the
+            # end-of-run overlap drain can merge averaged params at an
+            # unchanged step number, and that merge must not be lost.
+            current_id = (
+                int(self.trainer.state.step),
+                getattr(self.trainer, "mutation_counter", 0),
+            )
+            already_saved = (
+                getattr(self.trainer, "_ckpt_snapshot_id", None) == current_id
+                and latest_step(self.cfg.checkpoint_dir) == current_id[0]
+            )
+            if wait_pending_saves(self.trainer) and not already_saved:
                 save(self.trainer, self.cfg.checkpoint_dir)
         return result
 
@@ -330,6 +338,8 @@ class Volunteer:
             except Exception:
                 pass
             await self.dht.stop()
+            if getattr(self, "_loop_monitor", None) is not None:
+                await self._loop_monitor.stop()
             await self.transport.close()
 
     def install_signal_handlers(self) -> None:

@@ -63,6 +63,10 @@ def save_async(trainer, ckpt_dir: str) -> bool:
     host_tree = jax.tree_util.tree_map(
         lambda x: np.array(x, copy=True), _state_to_pytree(trainer)
     )
+    # Record WHICH state this snapshot is (step + out-of-band mutation
+    # count), so the final-save path can tell "already saved" apart from
+    # "same step number but params mutated since" (end-of-run merge).
+    trainer._ckpt_snapshot_id = (int(host_tree["step"]), getattr(trainer, "mutation_counter", 0))
     step = int(host_tree["step"])
     path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
 

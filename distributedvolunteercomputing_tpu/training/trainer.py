@@ -137,6 +137,12 @@ class Trainer:
         # self.state.params cross-thread would hit deleted arrays). Updated
         # at safe points only; tuple assignment keeps readers consistent.
         self._snapshot: Any = None
+        # Bumped on every out-of-band params mutation (averaging merge,
+        # peer-pull adoption). Lets the checkpoint layer tell whether state
+        # at the SAME step number still matches its last snapshot — the step
+        # counter alone can't (the end-of-run overlap drain merges without
+        # advancing it).
+        self.mutation_counter = 0
         self._take_snapshot(0)
 
     def adopt_params(self, params: Any, step: Optional[int] = None) -> None:
@@ -152,6 +158,7 @@ class Trainer:
             step=self.state.step if step is None else jnp.asarray(step, jnp.int32),
             rng=self.state.rng,
         )
+        self.mutation_counter += 1
         self._take_snapshot(int(self.state.step))
 
     def _take_snapshot(self, step_no: int) -> None:
@@ -182,6 +189,7 @@ class Trainer:
             step=self.state.step,
             rng=self.state.rng,
         )
+        self.mutation_counter += 1
         self._take_snapshot(step_no)
 
     def _run_average_round(self, tree: Any, step_no: int, what: str) -> Optional[Any]:

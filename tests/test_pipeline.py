@@ -1,0 +1,113 @@
+"""Pipeline parallelism: the pp microbatch schedule must be a pure
+performance annotation — same numbers as the plain scanned trunk.
+
+Runs on the 8-device virtual CPU mesh (SURVEY.md §4). Build-side extension
+beyond reference parity (reference is volunteer-DP only), but load-bearing
+once it exists: a wrong schedule silently trains a different model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.models import get_model
+from distributedvolunteercomputing_tpu.models.gpt2 import GPT2Config
+from distributedvolunteercomputing_tpu.parallel import make_mesh
+from distributedvolunteercomputing_tpu.parallel.pipeline import (
+    make_pp_loss_fn_gpt2,
+    pipeline_trunk,
+)
+from distributedvolunteercomputing_tpu.parallel.sharding import partition_spec_for_path
+from distributedvolunteercomputing_tpu.parallel.train_step import (
+    make_sharded_train_step,
+    put_batch,
+    shard_train_state,
+)
+from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+
+TINY = dict(vocab=128, max_len=16, d_model=32, n_heads=2, n_layers=4, d_ff=64, remat=False)
+
+
+def test_pp_partition_rules(eight_devices):
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(dp=2, pp=2, tp=2)
+    # stacked block weights: layer axis over pp, feature dim over tp
+    assert partition_spec_for_path("blocks/qkv/w", (4, 32, 96), mesh) == P("pp", None, "tp")
+    assert partition_spec_for_path("blocks/ln1/g", (4, 32), mesh) == P("pp", None)
+    # non-block leaves untouched
+    assert partition_spec_for_path("wte", (128, 32), mesh) == P()
+    # layers not divisible by pp -> no pp sharding
+    assert partition_spec_for_path("blocks/ln1/g", (3, 32), mesh) == P()
+
+
+@pytest.mark.parametrize("pp,microbatches", [(2, 2), (4, 4), (4, 8)])
+def test_pipeline_trunk_matches_scan(eight_devices, pp, microbatches):
+    cfg = GPT2Config(**TINY)
+    bundle = get_model("gpt2_small", **TINY)
+    params = bundle.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, cfg.max_len, cfg.d_model))
+
+    from distributedvolunteercomputing_tpu.models import common, gpt2
+
+    ref = common.scan_blocks(
+        lambda p, h: gpt2.block_fn(p, h, cfg), params["blocks"], x, remat=False
+    )
+    mesh = make_mesh(pp=pp)
+
+    # Partial-manual shard_map (axis_names={'pp'}) requires a jit context —
+    # exactly how the real train step consumes it.
+    @jax.jit
+    def trunk(blocks, x):
+        return pipeline_trunk(
+            lambda p, h: gpt2.block_fn(p, h, cfg),
+            blocks,
+            x,
+            mesh,
+            microbatches=microbatches,
+            remat=False,
+        )
+
+    with mesh:
+        got = trunk(params["blocks"], x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=1e-5)
+
+
+def test_pp_train_step_matches_single_device(eight_devices):
+    """Full train step with the pipelined loss on a dp2 x pp2 x tp2 mesh ==
+    the single-device step, leaf for leaf."""
+    cfg = GPT2Config(**TINY)
+    bundle = get_model("gpt2_small", **TINY)
+    tx = make_optimizer("adam", lr=1e-3)
+    params = bundle.init(jax.random.PRNGKey(0))
+    batch = bundle.make_batch(jax.random.PRNGKey(1), 8)
+
+    ref_state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+    ref_step = make_train_step(bundle.loss_fn, tx, donate=False)
+    ref_state, ref_metrics = ref_step(ref_state, batch)
+
+    mesh = make_mesh(dp=2, pp=2, tp=2)
+    pp_loss = make_pp_loss_fn_gpt2(cfg, mesh, microbatches=4)
+    state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+    state, shardings = shard_train_state(state, mesh, tx)
+    # each stage holds only its own layers
+    from jax.sharding import PartitionSpec as P
+
+    assert shardings["blocks"]["qkv"]["w"].spec == P("pp", None, "tp")
+    step = make_sharded_train_step(pp_loss, tx, mesh, donate=False)
+    with mesh:
+        state, metrics = step(state, put_batch(batch, mesh))
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-4
+    )
+    got = jax.device_get(state.params["blocks"]["qkv"]["w"])
+    np.testing.assert_allclose(
+        got, np.asarray(ref_state.params["blocks"]["qkv"]["w"]), rtol=1e-3, atol=1e-5
+    )
+    # second step runs (no donation/recompile surprises)
+    with mesh:
+        state, m2 = step(state, put_batch(batch, mesh))
+    assert np.isfinite(float(m2["loss"]))
