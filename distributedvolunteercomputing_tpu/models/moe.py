@@ -1,0 +1,151 @@
+"""Mixture-of-Experts FFN (Switch-style top-1 routing) + GPT-2-MoE.
+
+Build-side extension beyond reference parity (SURVEY.md §2 lists the
+reference as dense volunteer-DP only), completing the parallelism set with
+EXPERT parallelism: expert weights are stacked on a leading E axis and
+sharded over the mesh's ``ep`` axis (parallel/sharding.py rules), so the
+dispatch/combine einsums below compile to GSPMD all-to-alls over ICI — the
+canonical GShard/Switch TPU formulation, where routing is expressed as
+dense one-hot einsums the MXU eats, never as data-dependent gathers.
+
+Routing (top-1, Switch Transformer):
+- router logits [S, E] -> softmax gates; each token goes to its argmax
+  expert, output scaled by that gate (the gate carries the gradient);
+- static capacity C = ceil(capacity_factor * S / E) per expert; tokens
+  beyond an expert's capacity are DROPPED for the FFN (their residual
+  stream passes through unchanged) — the standard fixed-shape trade that
+  keeps the whole layer jit-compatible;
+- load-balancing aux loss (Switch eq. 4): E * sum_e(frac_tokens_e *
+  mean_gate_e), minimized at uniform routing; returned in metrics and
+  added to the objective with ``aux_coef``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distributedvolunteercomputing_tpu.models import common
+from distributedvolunteercomputing_tpu.models.gpt2 import GPT2Config
+from distributedvolunteercomputing_tpu.ops.attention import multi_head_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2MoEConfig(GPT2Config):
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_coef: float = 0.01
+    # MoE replaces the dense FFN in EVERY block (Switch layout); d_ff is the
+    # per-expert hidden width.
+
+
+def moe_init(rng: jax.Array, cfg: GPT2MoEConfig) -> common.Params:
+    kr, ki, ko = jax.random.split(rng, 3)
+    e, d, f = cfg.n_experts, cfg.d_model, cfg.d_ff
+    res_scale = 1.0 / ((2 * cfg.n_layers) ** 0.5 * d**0.5)
+    return {
+        "router": jax.random.normal(kr, (d, e), jnp.float32) * 0.02,
+        # experts stacked on the leading E axis -> sharded over ep
+        "moe_in": jax.random.normal(ki, (e, d, f), jnp.float32) * 0.02,
+        "moe_out": jax.random.normal(ko, (e, f, d), jnp.float32) * res_scale,
+    }
+
+
+def moe_ffn(p: common.Params, x: jax.Array, cfg: GPT2MoEConfig) -> Tuple[jax.Array, jax.Array]:
+    """x: [B, T, d] -> (y [B, T, d], aux_loss scalar)."""
+    b, t, d = x.shape
+    s = b * t
+    e = cfg.n_experts
+    # ceil, not truncation: capacity_factor=1.25 must mean >= 25% headroom
+    # over the uniform share, never less.
+    cap = max(math.ceil(cfg.capacity_factor * s / e), 1)
+    xs = x.reshape(s, d)
+
+    # Router in f32 (softmax statistics), gates carry the gradient.
+    logits = jnp.einsum("sd,de->se", xs.astype(jnp.float32), p["router"])
+    gates = jax.nn.softmax(logits, axis=-1)  # [S, E]
+    expert = jnp.argmax(gates, axis=-1)  # [S]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [S, E]
+    gate = jnp.sum(gates * onehot, axis=-1)  # [S] chosen gate
+
+    # Position of each token within its expert; >= cap overflows (dropped).
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [S, E], -1 where unrouted
+    kept = (pos >= 0) & (pos < cap)
+    pos_oh = jax.nn.one_hot(
+        jnp.clip(pos, 0, cap - 1).astype(jnp.int32), cap, dtype=x.dtype
+    )  # [S, E, C]
+    dispatch = pos_oh * kept.astype(x.dtype)[..., None]  # [S, E, C]
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]
+
+    # dispatch/combine einsums: with moe_in/out sharded over ep, GSPMD emits
+    # the all-to-alls here.
+    ein = jnp.einsum("sec,sd->ecd", dispatch, xs)  # [E, C, d]
+    dtype = x.dtype
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", ein, p["moe_in"].astype(dtype)))
+    eout = jnp.einsum("ecf,efd->ecd", h, p["moe_out"].astype(dtype))  # [E, C, d]
+    y = jnp.einsum("sec,ecd->sd", combine, eout)
+
+    # Switch load-balance loss: E * sum_e(frac_routed_e * mean_gate_e).
+    frac = jnp.mean(onehot, axis=0)  # [E]
+    mean_gate = jnp.mean(gates, axis=0)  # [E]
+    aux = e * jnp.sum(frac * mean_gate)
+    return y.reshape(b, t, d), aux.astype(jnp.float32)
+
+
+def _layer_init(rng: jax.Array, cfg: GPT2MoEConfig) -> common.Params:
+    k = jax.random.split(rng, 3)
+    res_scale = 1.0 / ((2 * cfg.n_layers) ** 0.5 * cfg.d_model**0.5)
+    return {
+        "ln1": common.layernorm_init(cfg.d_model),
+        "qkv": common.dense_init(k[0], cfg.d_model, 3 * cfg.d_model, scale=0.02),
+        "attn_out": common.dense_init(k[1], cfg.d_model, cfg.d_model, scale=res_scale),
+        "ln2": common.layernorm_init(cfg.d_model),
+        "moe": moe_init(k[2], cfg),
+    }
+
+
+def init(rng: jax.Array, cfg: GPT2MoEConfig) -> common.Params:
+    keys = jax.random.split(rng, 3)
+    return {
+        "wte": common.embed_init(keys[0], cfg.vocab, cfg.d_model),
+        "wpe": common.embed_init(keys[1], cfg.max_len, cfg.d_model, scale=0.01),
+        "blocks": common.stacked_init(
+            lambda k: _layer_init(k, cfg), keys[2], cfg.n_layers
+        ),
+        "ln_f": common.layernorm_init(cfg.d_model),
+    }
+
+
+def _block(p: common.Params, x_aux, cfg: GPT2MoEConfig):
+    x, aux = x_aux
+    h = common.layernorm(p["ln1"], x)
+    qkv = common.dense(p["qkv"], h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = multi_head_attention(q, k, v, cfg.n_heads, causal=True)
+    x = x + common.dense(p["attn_out"], attn)
+    h = common.layernorm(p["ln2"], x)
+    y, layer_aux = moe_ffn(p["moe"], h, cfg)
+    return x + y, aux + layer_aux
+
+
+def loss_fn(
+    params: common.Params, batch: Dict[str, jax.Array], rng: jax.Array, cfg: GPT2MoEConfig
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    from distributedvolunteercomputing_tpu.models import gpt2
+
+    x = gpt2.embed(params, batch["tokens"], cfg)
+    aux0 = jnp.zeros((), jnp.float32)
+    (x, aux) = common.scan_blocks(
+        lambda p, xa: _block(p, xa, cfg), params["blocks"], (x, aux0), remat=cfg.remat
+    )
+    x = common.layernorm(params["ln_f"], x)
+    lm = common.lm_xent_chunked(
+        x, params["wte"], batch["targets"], chunk=cfg.xent_chunk, head_layout="vd"
+    )
+    aux = aux / cfg.n_layers
+    loss = lm + cfg.aux_coef * aux
+    return loss, {"loss": loss, "lm_loss": lm, "aux_loss": aux}

@@ -105,6 +105,22 @@ def _gpt2(**overrides: Any) -> ModelBundle:
     )
 
 
+def _gpt2_moe(**overrides: Any) -> ModelBundle:
+    from distributedvolunteercomputing_tpu.models import moe
+    from distributedvolunteercomputing_tpu.training import data
+
+    cfg = dataclasses.replace(moe.GPT2MoEConfig(), **overrides)
+    return ModelBundle(
+        name="gpt2_moe",
+        config=cfg,
+        init=lambda rng: moe.init(rng, cfg),
+        loss_fn=lambda p, b, rng: moe.loss_fn(p, b, rng, cfg),
+        make_batch=lambda rng, bs: data.synthetic_lm_batch(
+            rng, bs, seq_len=cfg.max_len, vocab=cfg.vocab
+        ),
+    )
+
+
 def _llama_lora(**overrides: Any) -> ModelBundle:
     from distributedvolunteercomputing_tpu.models import llama
     from distributedvolunteercomputing_tpu.training import data
@@ -129,6 +145,7 @@ _REGISTRY: Dict[str, Callable[..., ModelBundle]] = {
     "cifar10_resnet18": _resnet18,
     "bert_mlm": _bert,
     "gpt2_small": _gpt2,
+    "gpt2_moe": _gpt2_moe,
     "llama_lora": _llama_lora,
 }
 

@@ -1,15 +1,16 @@
 """Device mesh construction for one volunteer slice.
 
-Axis convention (outer → inner): ``("dp", "sp", "pp", "tp")``.
+Axis convention (outer → inner): ``("dp", "sp", "pp", "ep", "tp")``.
 
 ``tp`` is innermost so tensor-parallel collectives (the per-layer
 allreduces) land on ICI-adjacent chips; ``dp`` is outermost because its one
 gradient reduction per step tolerates the longest hops. ``sp`` (sequence
-parallelism's ppermute ring) and ``pp`` (pipeline stages' ppermute chain)
-sit between: both want contiguous neighbours but are far less chatty than
-tp. Axes of size 1 cost nothing — every mesh carries all four names so
-sharding rules and ``shard_map`` axis references never need to special-case
-which strategies are active.
+parallelism's ppermute ring), ``pp`` (pipeline stages' ppermute chain) and
+``ep`` (expert parallelism's dispatch/combine all-to-alls) sit between:
+they want contiguous neighbours but are far less chatty than tp. Axes of
+size 1 cost nothing — every mesh carries all five names so sharding rules
+and ``shard_map`` axis references never need to special-case which
+strategies are active.
 """
 
 from __future__ import annotations
@@ -20,7 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "pp", "tp")
+AXES = ("dp", "sp", "pp", "ep", "tp")
 
 
 def make_mesh(
@@ -28,18 +29,20 @@ def make_mesh(
     sp: int = 1,
     tp: int = 1,
     pp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a ``(dp, sp, pp, tp)`` mesh from the first dp*sp*pp*tp devices."""
+    """Build a ``(dp, sp, pp, ep, tp)`` mesh from the first
+    dp*sp*pp*ep*tp devices."""
     if devices is None:
         devices = jax.devices()
-    need = dp * sp * pp * tp
+    need = dp * sp * pp * ep * tp
     if len(devices) < need:
         raise ValueError(
-            f"mesh dp={dp} sp={sp} pp={pp} tp={tp} needs {need} devices, "
-            f"have {len(devices)}"
+            f"mesh dp={dp} sp={sp} pp={pp} ep={ep} tp={tp} needs {need} "
+            f"devices, have {len(devices)}"
         )
-    arr = np.asarray(devices[:need]).reshape(dp, sp, pp, tp)
+    arr = np.asarray(devices[:need]).reshape(dp, sp, pp, ep, tp)
     return Mesh(arr, AXES)
 
 

@@ -49,16 +49,11 @@ def pipeline_trunk(
     Returns [B, T, D], replicated over pp (sharding of other axes is
     whatever GSPMD picks outside).
     """
+    from distributedvolunteercomputing_tpu.models.common import scan_blocks
+
     pp = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
     if pp == 1:
-        # No pipeline: plain scan (common.scan_blocks without the import
-        # cycle — the checkpoint policy matches).
-        fn = jax.checkpoint(block_fn) if remat else block_fn
-
-        def step(h, p):
-            return fn(p, h), None
-
-        return jax.lax.scan(step, x, blocks)[0]
+        return scan_blocks(block_fn, blocks, x, remat=remat)
 
     b = x.shape[0]
     m = microbatches or pp
@@ -85,12 +80,7 @@ def pipeline_trunk(
         n_ticks = m + pp - 1
 
         def stage_apply(h):
-            fn = jax.checkpoint(block_fn) if remat else block_fn
-
-            def step(hh, p):
-                return fn(p, hh), None
-
-            return jax.lax.scan(step, h, stage_blocks)[0]
+            return scan_blocks(block_fn, stage_blocks, h, remat=remat)
 
         def tick(carry, t):
             state, outputs = carry

@@ -42,6 +42,10 @@ _RULES: List[Tuple[str, P]] = [
     (r".*/(wq|wk|wv|w_gate|w_up)$", P(None, "tp")),
     (r".*/(wo|w_down)$", P("tp", None)),
     (r".*/lm_head$", P(None, "tp")),
+    # MoE expert stacks [E, d, f] / [E, f, d]: experts over ep, per-expert
+    # hidden dim over tp (column- then row-parallel, as for the dense FFN).
+    (r".*/moe_in$", P("ep", None, "tp")),
+    (r".*/moe_out$", P("ep", "tp", None)),
 ]
 
 

@@ -310,6 +310,9 @@ class Volunteer:
             # current state EXACTLY — same step AND same mutation count; the
             # end-of-run overlap drain can merge averaged params at an
             # unchanged step number, and that merge must not be lost.
+            drained = wait_pending_saves(self.trainer)
+            # Evaluate AFTER the drain: latest_step only reflects the
+            # in-flight write once it has landed.
             current_id = (
                 int(self.trainer.state.step),
                 getattr(self.trainer, "mutation_counter", 0),
@@ -318,7 +321,7 @@ class Volunteer:
                 getattr(self.trainer, "_ckpt_snapshot_id", None) == current_id
                 and latest_step(self.cfg.checkpoint_dir) == current_id[0]
             )
-            if wait_pending_saves(self.trainer) and not already_saved:
+            if drained and not already_saved:
                 save(self.trainer, self.cfg.checkpoint_dir)
         return result
 

@@ -30,10 +30,12 @@ TINY_GPT2 = dict(vocab=128, max_len=32, d_model=64, n_heads=4, n_layers=2, d_ff=
 
 def test_make_mesh_shapes(eight_devices):
     mesh = make_mesh(dp=2, sp=1, tp=4)
-    assert mesh.axis_names == ("dp", "sp", "pp", "tp")
-    assert mesh.devices.shape == (2, 1, 1, 4)
+    assert mesh.axis_names == ("dp", "sp", "pp", "ep", "tp")
+    assert mesh.devices.shape == (2, 1, 1, 1, 4)
     mesh_pp = make_mesh(dp=2, pp=2, tp=2)
-    assert mesh_pp.devices.shape == (2, 1, 2, 2)
+    assert mesh_pp.devices.shape == (2, 1, 2, 1, 2)
+    mesh_ep = make_mesh(dp=2, ep=4)
+    assert mesh_ep.devices.shape == (2, 1, 1, 4, 1)
     with pytest.raises(ValueError):
         make_mesh(dp=4, sp=2, tp=4)  # 32 > 8
 
