@@ -25,7 +25,7 @@ log = get_logger(__name__)
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "dvc_native.cpp")
 _SO = os.path.join(_DIR, "libdvc_native.so")
-_ABI = 1
+_ABI = 2
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -77,6 +77,9 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.dvc_weighted_sum.argtypes = [f32p, f32p, ctypes.c_float, u64]
     lib.dvc_coord_median.argtypes = [f32p, u64, u64, f32p]
     lib.dvc_trimmed_mean.argtypes = [f32p, u64, u64, u64, f32p]
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    lib.dvc_f32_to_q8.argtypes = [f32p, u64, u64, f32p, i8p]
+    lib.dvc_q8_to_f32.argtypes = [i8p, f32p, u64, u64, f32p]
     return lib
 
 
@@ -213,6 +216,71 @@ def weighted_sum_inplace(acc: np.ndarray, x: np.ndarray, w: float) -> None:
         lib.dvc_weighted_sum(_ptr(acc, ctypes.c_float), _ptr(x, ctypes.c_float), w, acc.size)
         return
     acc += np.float32(w) * x
+
+
+Q8_CHUNK = 1024  # floats per quantization chunk (one f32 scale each)
+
+
+def q8_encode(arr: np.ndarray, chunk: int = Q8_CHUNK) -> bytes:
+    """f32 -> q8 wire bytes: [u64 n][f32 scale/chunk][int8 data]. ~4x fewer
+    bytes than f32; symmetric per-chunk scales; exact on round-tripped
+    values (pairwise protocols rely on idempotency)."""
+    arr = np.ascontiguousarray(arr, np.float32).ravel()
+    n = arr.size
+    n_chunks = -(-n // chunk) if n else 0
+    scales = np.empty(n_chunks, np.float32)
+    out = np.empty(n, np.int8)
+    lib = get_lib()
+    if lib is not None and n:
+        lib.dvc_f32_to_q8(
+            _ptr(arr, ctypes.c_float), n, chunk, _ptr(scales, ctypes.c_float),
+            _ptr(out, ctypes.c_int8),
+        )
+    elif n:
+        # Mirrors the native path: non-finite -> 0 before scaling (UB-free,
+        # scale stays finite), quantize via x * (1/scale) in f32 with
+        # round-half-away-from-zero. Exact agreement with the C++ isn't
+        # guaranteed at rounding boundaries (FMA contraction differs by
+        # compiler), but both stay within one quantization step.
+        arr = np.where(np.isfinite(arr), arr, np.float32(0))
+        pad = n_chunks * chunk - n
+        padded = np.pad(arr, (0, pad)).reshape(n_chunks, chunk)
+        amax = np.max(np.abs(padded), axis=1)
+        scales[:] = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+        q = padded * (np.float32(1.0) / scales)[:, None]
+        q = np.clip(q, -127.0, 127.0)
+        q = np.where(q >= 0, np.floor(q + 0.5), np.ceil(q - 0.5)).astype(np.int8)
+        out[:] = q.reshape(-1)[:n]
+    return (
+        np.uint64(n).tobytes() + scales.tobytes() + out.tobytes()
+    )
+
+
+def q8_decode(payload: bytes, chunk: int = Q8_CHUNK) -> np.ndarray:
+    """Inverse of q8_encode; raises ValueError on malformed payloads."""
+    if len(payload) < 8:
+        raise ValueError("q8 payload too short for header")
+    n = int(np.frombuffer(payload[:8], np.uint64)[0])
+    n_chunks = -(-n // chunk) if n else 0
+    expect = 8 + 4 * n_chunks + n
+    if len(payload) != expect:
+        raise ValueError(f"q8 payload {len(payload)}B != expected {expect}B for n={n}")
+    scales = np.frombuffer(payload[8 : 8 + 4 * n_chunks], np.float32)
+    data = np.frombuffer(payload[8 + 4 * n_chunks :], np.int8)
+    out = np.empty(n, np.float32)
+    lib = get_lib()
+    if lib is not None and n:
+        data = np.ascontiguousarray(data)
+        scales = np.ascontiguousarray(scales)
+        lib.dvc_q8_to_f32(
+            _ptr(data, ctypes.c_int8), _ptr(scales, ctypes.c_float), n, chunk,
+            _ptr(out, ctypes.c_float),
+        )
+    elif n:
+        pad = n_chunks * chunk - n
+        padded = np.pad(data.astype(np.float32), (0, pad)).reshape(n_chunks, chunk)
+        out[:] = (padded * scales[:, None]).reshape(-1)[:n]
+    return out
 
 
 def coordinate_median(stack: np.ndarray) -> np.ndarray:

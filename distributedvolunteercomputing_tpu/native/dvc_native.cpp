@@ -14,6 +14,7 @@
 // TPU step runs, so wall-clock here is overlap budget for the WAN round.
 
 #include <algorithm>
+#include <cmath>
 #include <atomic>
 #include <cstdint>
 #include <cstring>
@@ -133,7 +134,7 @@ inline uint16_t f32_to_bf16_1(float f) {
 
 extern "C" {
 
-int dvc_abi_version() { return 1; }
+int dvc_abi_version() { return 2; }
 
 uint32_t dvc_crc32(const uint8_t* data, uint64_t len, uint32_t seed) {
   const uint64_t kCut = 1 << 20;
@@ -206,6 +207,57 @@ void dvc_trimmed_mean(const float* stack, uint64_t n_peers, uint64_t dim,
       double s = 0;
       for (uint64_t i = trim; i < n_peers - trim; ++i) s += col[i];
       out[j] = static_cast<float>(s / static_cast<double>(n_peers - 2 * trim));
+    }
+  });
+}
+
+// Chunked symmetric int8 quantization for the WAN wire ("q8" codec):
+// per-chunk scale = absmax/127, values round to int8. ~4x fewer DCN bytes
+// than f32 (2x vs bf16) at <=0.4% per-element relative error within a
+// chunk; round-tripping already-quantized values is exact (the scale
+// reconstructs bit-identically), which pairwise protocols rely on.
+// Non-finite inputs are mapped to 0 BEFORE scaling: a diverged peer's
+// NaN/Inf must not poison the chunk scale or hit the UB of casting a
+// non-finite float to int8 (robust aggregation / the state-sync sanity
+// guard are the layers that deal with divergent peers; the codec's job is
+// merely to never corrupt silently or invoke UB).
+void dvc_f32_to_q8(const float* in, uint64_t n, uint64_t chunk, float* scales,
+                   int8_t* out) {
+  if (chunk == 0) return;
+  uint64_t n_chunks = (n + chunk - 1) / chunk;
+  parallel_for(n_chunks, 8, [&](uint64_t b, uint64_t e) {
+    for (uint64_t c = b; c < e; ++c) {
+      uint64_t lo = c * chunk, hi = std::min(n, lo + chunk);
+      float amax = 0.0f;
+      for (uint64_t i = lo; i < hi; ++i) {
+        float v = in[i];
+        if (!std::isfinite(v)) continue;
+        float a = v < 0 ? -v : v;
+        if (a > amax) amax = a;
+      }
+      float scale = amax > 0 ? amax / 127.0f : 1.0f;
+      scales[c] = scale;
+      float inv = 1.0f / scale;
+      for (uint64_t i = lo; i < hi; ++i) {
+        float v = std::isfinite(in[i]) ? in[i] : 0.0f;
+        float q = v * inv;
+        q = q < -127.0f ? -127.0f : (q > 127.0f ? 127.0f : q);
+        out[i] = static_cast<int8_t>(q >= 0 ? q + 0.5f : q - 0.5f);
+      }
+    }
+  });
+}
+
+void dvc_q8_to_f32(const int8_t* in, const float* scales, uint64_t n,
+                   uint64_t chunk, float* out) {
+  if (chunk == 0) return;
+  uint64_t n_chunks = (n + chunk - 1) / chunk;
+  parallel_for(n_chunks, 8, [&](uint64_t b, uint64_t e) {
+    for (uint64_t c = b; c < e; ++c) {
+      uint64_t lo = c * chunk, hi = std::min(n, lo + chunk);
+      float scale = scales[c];
+      for (uint64_t i = lo; i < hi; ++i)
+        out[i] = static_cast<float>(in[i]) * scale;
     }
   });
 }

@@ -77,7 +77,7 @@ class AveragerBase:
         namespace: str = "",
         wire: str = "f32",
     ):
-        if wire not in ("f32", "bf16"):
+        if wire not in ("f32", "bf16", "q8"):
             raise ValueError(f"unknown wire dtype {wire!r}")
         self.transport = transport
         self.dht = dht
@@ -181,20 +181,27 @@ class AveragerBase:
     def _to_wire(self, buf: np.ndarray) -> bytes:
         if self.wire == "bf16":
             return native.f32_to_bf16(buf).tobytes()
+        if self.wire == "q8":
+            return native.q8_encode(buf)
         return buf.tobytes()
 
     def _wire_roundtrip(self, buf: np.ndarray) -> np.ndarray:
         """The local buffer as PEERS see it after the wire codec. Pairwise
         protocols (butterfly) mix this instead of the raw f32 buffer so both
-        sides of a pair operate on identical inputs; idempotent (a bf16
-        round-trip of bf16-representable values is exact)."""
+        sides of a pair operate on identical inputs; idempotent for every
+        codec (a round-trip of already-codec'd values is exact: bf16 by
+        representability, q8 because the per-chunk scale reconstructs)."""
         if self.wire == "bf16":
             return native.bf16_to_f32(native.f32_to_bf16(buf))
+        if self.wire == "q8":
+            return native.q8_decode(native.q8_encode(buf))
         return buf
 
     def _buf_from_payload(self, payload: bytes) -> np.ndarray:
         if self.wire == "bf16":
             return native.bf16_to_f32(np.frombuffer(payload, np.uint16))
+        if self.wire == "q8":
+            return native.q8_decode(payload)
         return np.frombuffer(payload, np.float32).copy()
 
     # -- public API --------------------------------------------------------
@@ -277,7 +284,7 @@ class SyncAverager(AveragerBase):
             if group.my_index == 0:
                 return await self._lead_round(group, buf, weight)
             return await self._member_round(group, buf, weight)
-        except (RPCError, OSError, asyncio.TimeoutError) as e:
+        except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
             log.info("sync round %d failed (%s); continuing local", round_no, e)
             self.rounds_skipped += 1
             return None
@@ -438,7 +445,7 @@ class GossipAverager(AveragerBase):
                 w, buf = self._mix(w, buf, float(ret["weight"]), rbuf)
                 self._current = (w, buf)
                 mixed = True
-            except (RPCError, OSError, asyncio.TimeoutError) as e:
+            except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info("gossip with %s failed (%s)", pid, e)
         if not mixed:
             self.rounds_skipped += 1
@@ -555,7 +562,7 @@ class ButterflyAverager(AveragerBase):
                     raise RPCError(f"partner buffer size {pbuf.size} != local {buf.size}")
                 w, buf = self._mix(w, buf, pw, pbuf)
                 mixed_any = True
-            except (RPCError, OSError, asyncio.TimeoutError) as e:
+            except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info(
                     "butterfly round %d stage %d with %s failed (%s); skipping stage",
                     round_no, s, partner_id, e,
@@ -648,7 +655,7 @@ class ByzantineAverager(AveragerBase):
                 await self.transport.call(
                     addr, "byz.contribute", args, self._to_wire(buf), timeout=self.gather_timeout
                 )
-            except (RPCError, OSError, asyncio.TimeoutError) as e:
+            except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info("byz push to %s failed: %s", addr, e)
 
         await asyncio.gather(

@@ -54,7 +54,7 @@ class VolunteerConfig:
     # samples/sec at payload scale (BASELINE.md north-star).
     overlap: bool = True
     max_staleness: int = 0  # steps; 0 = unbounded (rounds self-bound via timeouts)
-    wire: str = "f32"  # f32|bf16 — WAN payload codec (bf16 halves DCN bytes)
+    wire: str = "f32"  # f32|bf16|q8 — WAN payload codec (bf16 halves, q8 quarters DCN bytes)
     min_group: int = 2
     max_group: int = 16
     batch_size: int = 32  # samples per optimizer step (across accum microbatches)

@@ -44,8 +44,9 @@ def main() -> None:
     ap.add_argument("--average-every", type=int, default=10)
     ap.add_argument("--average-what", default="params", choices=("params", "grads"),
                     help="params = local-SGD periodic averaging; grads = GradientAverager")
-    ap.add_argument("--wire", default="f32", choices=("f32", "bf16"),
-                    help="WAN payload codec; bf16 halves DCN traffic")
+    ap.add_argument("--wire", default="f32", choices=("f32", "bf16", "q8"),
+                    help="WAN payload codec; bf16 halves DCN traffic, q8 "
+                         "quarters it (chunked int8, <=0.4%% element error)")
     ap.add_argument("--overlap", action=argparse.BooleanOptionalAction, default=True,
                     help="overlap WAN averaging rounds with local compute "
                          "(params mode; --no-overlap restores blocking rounds)")

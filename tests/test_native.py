@@ -150,3 +150,89 @@ def test_mixed_wire_schema_rejection():
             assert np.isfinite(vals).all()
             # must equal one side's own contribution, not a corrupt mix
             assert np.allclose(vals, 1.0) or np.allclose(vals, 3.0)
+
+
+class TestQ8Codec:
+    def test_roundtrip_error_bound(self, lib):
+        rng = np.random.default_rng(5)
+        # mixed scales across chunks, plus exact zeros
+        x = np.concatenate([
+            rng.standard_normal(4096).astype(np.float32) * 100.0,
+            rng.standard_normal(4096).astype(np.float32) * 1e-3,
+            np.zeros(1500, np.float32),
+            rng.standard_normal(37).astype(np.float32),  # ragged tail chunk
+        ])
+        y = native.q8_decode(native.q8_encode(x))
+        assert y.shape == x.shape
+        # per-chunk error bound: half a quantization step = absmax/254
+        for c in range(0, x.size, native.Q8_CHUNK):
+            xc, yc = x[c:c + native.Q8_CHUNK], y[c:c + native.Q8_CHUNK]
+            bound = np.abs(xc).max(initial=0.0) / 254.0 + 1e-12
+            assert np.abs(xc - yc).max(initial=0.0) <= bound * 1.01
+
+    def test_idempotent(self, lib):
+        # pairwise protocols mix the wire-roundtripped buffer; quantizing an
+        # already-quantized buffer must be exact.
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal(5000).astype(np.float32)
+        once = native.q8_decode(native.q8_encode(x))
+        twice = native.q8_decode(native.q8_encode(once))
+        np.testing.assert_array_equal(once, twice)
+
+    def test_native_matches_numpy_fallback(self, lib, monkeypatch):
+        # Same scales; quantized values may differ by at most ONE step at
+        # rounding boundaries (FMA contraction differs by compiler), and
+        # decoding a given payload is bit-identical on both paths.
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal(10_000).astype(np.float32)
+        with_native = native.q8_encode(x)
+        monkeypatch.setattr(native, "get_lib", lambda: None)
+        without = native.q8_encode(x)
+        hdr = 8 + 4 * (10_000 // native.Q8_CHUNK + 1)
+        np.testing.assert_array_equal(
+            np.frombuffer(with_native[:hdr], np.uint8),
+            np.frombuffer(without[:hdr], np.uint8),
+        )
+        qa = np.frombuffer(with_native[hdr:], np.int8).astype(np.int16)
+        qb = np.frombuffer(without[hdr:], np.int8).astype(np.int16)
+        assert np.abs(qa - qb).max() <= 1
+        np.testing.assert_array_equal(
+            native.q8_decode(with_native), native.q8_decode(with_native)
+        )
+
+    def test_nonfinite_inputs_map_to_zero(self, lib):
+        # A diverged peer's NaN/Inf must not poison the chunk scale, invoke
+        # UB, or decode to garbage: the codec zeroes them deterministically.
+        x = np.array([1.0, -2.0, np.nan, np.inf, -np.inf, 3.0], np.float32)
+        y = native.q8_decode(native.q8_encode(x))
+        assert np.isfinite(y).all()
+        np.testing.assert_allclose(y[[0, 1, 5]], [1.0, -2.0, 3.0], rtol=2e-2)
+        np.testing.assert_array_equal(y[[2, 3, 4]], 0.0)
+
+    def test_decode_rejects_malformed(self, lib):
+        with pytest.raises(ValueError):
+            native.q8_decode(b"\x00" * 4)
+        good = native.q8_encode(np.ones(100, np.float32))
+        with pytest.raises(ValueError):
+            native.q8_decode(good[:-1])
+
+    def test_q8_wire_end_to_end(self):
+        """Two volunteers average over localhost with the q8 wire; result
+        within quantization tolerance of the true mean."""
+        from tests.test_averaging import make_tree, spawn_volunteers, teardown
+        from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+
+        async def main():
+            vols = await spawn_volunteers(2, SyncAverager, wire="q8")
+            try:
+                return await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 1),
+                    vols[1][3].average(make_tree(3.0), 1),
+                )
+            finally:
+                await teardown(vols)
+
+        ra, rb = asyncio.run(asyncio.wait_for(main(), timeout=60))
+        assert ra is not None and rb is not None
+        np.testing.assert_allclose(ra["w"], 2.0, rtol=2e-2)
+        np.testing.assert_allclose(rb["b"]["x"], 4.0, rtol=2e-2)
