@@ -76,6 +76,7 @@ class AveragerBase:
         method_kw: Optional[dict] = None,
         namespace: str = "",
         wire: str = "f32",
+        adaptive_timeout: bool = False,
     ):
         if wire not in ("f32", "bf16", "q8"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -102,6 +103,16 @@ class AveragerBase:
         self._schema: Optional[str] = None
         self.rounds_ok = 0
         self.rounds_skipped = 0
+        # Adaptive round deadlines (Chameleon-style, PAPERS.md:6): observe
+        # successful rounds' wall time and bound the NEXT round's waits by
+        # EWMA + 4 deviations instead of the full configured timeout, so a
+        # dead peer costs seconds, not the worst-case budget. Off by default
+        # (opt-in via --adaptive-timeout); the configured value stays the
+        # ceiling and is always used until the first success.
+        self.adaptive_timeout = adaptive_timeout
+        self._rt_ewma: Optional[float] = None
+        self._rt_ewdev = 0.0
+        self._round_degraded = False
 
     @property
     def round_key(self) -> str:
@@ -124,6 +135,36 @@ class AveragerBase:
     # unvalidated peer ids). One bound for every subclass that parks — a
     # per-subclass copy is how the byz path shipped uncapped in round 1.
     MAX_PARKED_CONTRIBS = 64
+
+    def _observe_round_time(self, dt: float) -> None:
+        """Feed a COMPLETE round's wall time into the deadline estimate.
+
+        Callers must only report rounds where every expected peer arrived:
+        a degraded round (subset aggregated after the deadline fired) takes
+        ~the current deadline by construction, and observing it would
+        ratchet the estimate geometrically back to the ceiling — defeating
+        the feature in exactly the persistent-churn case it targets."""
+        if self._rt_ewma is None:
+            self._rt_ewma, self._rt_ewdev = dt, dt / 2.0
+        else:
+            self._rt_ewdev += 0.25 * (abs(dt - self._rt_ewma) - self._rt_ewdev)
+            self._rt_ewma += 0.25 * (dt - self._rt_ewma)
+
+    def _observe_round_failure(self) -> None:
+        """A FAILED round doubles the estimate toward the configured
+        ceiling (AIMD-style): without this, an estimate warmed on a fast
+        network can never recover when latency genuinely rises — the peer
+        would time out every round forever and silently train solo."""
+        if self._rt_ewma is not None:
+            self._rt_ewma = min(self._rt_ewma * 2.0, self.gather_timeout)
+            self._rt_ewdev = min(self._rt_ewdev * 2.0 + 0.1, self.gather_timeout / 2.0)
+
+    @property
+    def effective_gather_timeout(self) -> float:
+        if not self.adaptive_timeout or self._rt_ewma is None:
+            return self.gather_timeout
+        est = self._rt_ewma + 4.0 * self._rt_ewdev + 1.0
+        return float(min(self.gather_timeout, max(est, 2.0)))
 
     def _sweep_rounds(self, rounds: Dict[str, "_Round"], max_age: Optional[float] = None) -> None:
         """Evict stale round state (parked contributions hold param-sized
@@ -280,14 +321,23 @@ class SyncAverager(AveragerBase):
             self.rounds_skipped += 1
             return None
         buf = self._pack(tree)
+        t0 = time.monotonic()
+        self._round_degraded = False
         try:
             if group.my_index == 0:
-                return await self._lead_round(group, buf, weight)
-            return await self._member_round(group, buf, weight)
+                result = await self._lead_round(group, buf, weight)
+            else:
+                result = await self._member_round(group, buf, weight)
         except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
             log.info("sync round %d failed (%s); continuing local", round_no, e)
             self.rounds_skipped += 1
+            self._observe_round_failure()
             return None
+        if result is None:
+            self._observe_round_failure()
+        elif not self._round_degraded:
+            self._observe_round_time(time.monotonic() - t0)
+        return result
 
     async def _lead_round(self, group: Group, buf: np.ndarray, weight: float):
         member_ids = [pid for pid, _ in group.members]
@@ -307,9 +357,9 @@ class SyncAverager(AveragerBase):
             st.full.set()
         try:
             try:
-                await asyncio.wait_for(st.full.wait(), timeout=self.gather_timeout)
+                await asyncio.wait_for(st.full.wait(), timeout=self.effective_gather_timeout)
             except asyncio.TimeoutError:
-                pass  # aggregate whoever made it
+                self._round_degraded = True  # subset aggregate: not an observation
             # Drop contributions whose buffer doesn't match ours (model
             # mismatch that slipped past the early-accept schema check) or
             # whose token isn't the secret WE issued to that member at begin
@@ -361,7 +411,7 @@ class SyncAverager(AveragerBase):
             "token": group.token,
         }
         await self.transport.call(
-            leader_addr, "sync.contribute", args, self._to_wire(buf), timeout=self.gather_timeout
+            leader_addr, "sync.contribute", args, self._to_wire(buf), timeout=self.effective_gather_timeout
         )
         _, payload = await self.transport.call(
             leader_addr, "sync.fetch", {"epoch": group.epoch}, timeout=self.gather_timeout + 6.0
@@ -432,13 +482,15 @@ class GossipAverager(AveragerBase):
         if targets:
             pid, addr = self._rng.choice(targets)
             try:
+                t0 = time.monotonic()
                 ret, payload = await self.transport.call(
                     addr,
                     "gossip.exchange",
                     {"peer": self.peer_id, "weight": w, "schema": self._schema},
                     self._to_wire(buf),
-                    timeout=self.gather_timeout,
+                    timeout=self.effective_gather_timeout,
                 )
+                self._observe_round_time(time.monotonic() - t0)
                 rbuf = self._buf_from_payload(payload)
                 if rbuf.size != buf.size:
                     raise RPCError(f"peer buffer size {rbuf.size} != local {buf.size}")
@@ -447,6 +499,7 @@ class GossipAverager(AveragerBase):
                 mixed = True
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info("gossip with %s failed (%s)", pid, e)
+                self._observe_round_failure()
         if not mixed:
             self.rounds_skipped += 1
             return None
@@ -653,18 +706,21 @@ class ByzantineAverager(AveragerBase):
         async def push(addr):
             try:
                 await self.transport.call(
-                    addr, "byz.contribute", args, self._to_wire(buf), timeout=self.gather_timeout
+                    addr, "byz.contribute", args, self._to_wire(buf),
+                    timeout=self.effective_gather_timeout,
                 )
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info("byz push to %s failed: %s", addr, e)
 
+        t0 = time.monotonic()
+        degraded = False
         await asyncio.gather(
             *(push(addr) for pid, addr in group.members if pid != self.peer_id)
         )
         try:
-            await asyncio.wait_for(st.full.wait(), timeout=self.gather_timeout)
+            await asyncio.wait_for(st.full.wait(), timeout=self.effective_gather_timeout)
         except asyncio.TimeoutError:
-            pass
+            degraded = True  # aggregate the subset, but don't observe the wait
         received = {
             p: c
             for p, c in st.contribs.items()
@@ -673,6 +729,7 @@ class ByzantineAverager(AveragerBase):
         self._rounds.pop(group.epoch, None)
         if len(received) < self.min_group:
             self.rounds_skipped += 1
+            self._observe_round_failure()
             return None
         peers = sorted(received)
         stack = np.stack([received[p][1] for p in peers])
@@ -686,6 +743,8 @@ class ByzantineAverager(AveragerBase):
             if trim * 2 >= len(peers):
                 kw["trim"] = 0
         self.rounds_ok += 1
+        if not degraded:
+            self._observe_round_time(time.monotonic() - t0)
         return self._unpack(robust.aggregate(stack, self.method, **kw))
 
 

@@ -73,6 +73,9 @@ class VolunteerConfig:
     join_timeout: float = 10.0
     gather_timeout: float = 20.0
     method: str = "mean"  # robust aggregation estimator for byzantine mode
+    # Adaptive round deadlines (EWMA of successful rounds; see AveragerBase):
+    # a dead peer costs seconds instead of the full gather budget.
+    adaptive_timeout: bool = False
 
     def __post_init__(self):
         if not self.peer_id:
@@ -157,6 +160,7 @@ class Volunteer:
                 join_timeout=self.cfg.join_timeout,
                 gather_timeout=self.cfg.gather_timeout,
                 wire=self.cfg.wire,
+                adaptive_timeout=self.cfg.adaptive_timeout,
             )
             if self.cfg.averaging == "byzantine" and self.cfg.method != "mean":
                 kw["method"] = self.cfg.method

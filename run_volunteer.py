@@ -78,6 +78,10 @@ def main() -> None:
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--join-timeout", type=float, default=10.0)
     ap.add_argument("--gather-timeout", type=float, default=20.0)
+    ap.add_argument("--adaptive-timeout", action="store_true",
+                    help="bound round waits by an EWMA of successful round "
+                         "times (dead peers cost seconds, not the full "
+                         "gather budget); --gather-timeout stays the ceiling")
     args = ap.parse_args()
 
     overrides = {}
@@ -119,6 +123,7 @@ def main() -> None:
         checkpoint_every=args.checkpoint_every,
         join_timeout=args.join_timeout,
         gather_timeout=args.gather_timeout,
+        adaptive_timeout=args.adaptive_timeout,
     )
     if cfg.averaging != "none":
         # Build/load the native host core BEFORE the event loop exists: the

@@ -497,3 +497,89 @@ async def _solo_stack(peer_id):
     mem = SwarmMembership(dht, peer_id, ttl=10.0)
     await mem.join()
     return t, dht, mem
+
+
+class TestAdaptiveTimeout:
+    def test_estimator_math(self):
+        """Off by default; after fast rounds the deadline shrinks toward the
+        observed time; the configured value is always the ceiling."""
+
+        async def main():
+            avg = SyncAverager(*await _solo_stack("solo"), gather_timeout=30.0)
+            try:
+                assert avg.effective_gather_timeout == 30.0  # off -> ceiling
+                avg.adaptive_timeout = True
+                assert avg.effective_gather_timeout == 30.0  # no data yet
+                for _ in range(6):
+                    avg._observe_round_time(0.4)
+                eff = avg.effective_gather_timeout
+                assert 2.0 <= eff < 5.0, eff  # shrunk far below the 30s budget
+                avg._observe_round_time(25.0)  # one slow round widens it again
+                assert avg.effective_gather_timeout > eff
+                assert avg.effective_gather_timeout <= 30.0
+            finally:
+                await avg.transport.close()
+
+        run(main())
+
+    def test_silent_member_costs_adaptive_deadline_and_no_ratchet(self):
+        """The scenario the feature targets: a peer passes matchmaking
+        (alive) but never contributes. After warming on fast rounds, the
+        survivors' gather wait must fire at the ADAPTIVE deadline (seconds),
+        the subset must still aggregate, and the degraded round must NOT be
+        fed back into the estimator (which would ratchet it to the ceiling
+        within a few rounds)."""
+        import time as _time
+
+        class SilentByz(ByzantineAverager):
+            # Joins the round like a live peer, then contributes nothing —
+            # the one shape of churn that makes honest peers wait.
+            async def average(self, tree, round_no, weight=1.0):
+                await self.matchmaker.form_group(
+                    self.round_key, self.min_group, self.max_group, self.join_timeout
+                )
+                return None
+
+        async def main():
+            vols = await spawn_volunteers(
+                2, ByzantineAverager, gather_timeout=30.0, join_timeout=5.0,
+                adaptive_timeout=True, min_group=2,
+            )
+            a, b = vols[0][3], vols[1][3]
+            # the silent peer joins the SAME swarm (bootstrapped DHT)
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=[vols[0][0].addr])
+            mem = SwarmMembership(dht, "silent", ttl=10.0)
+            await mem.join()
+            silent = SilentByz(t, dht, mem, gather_timeout=30.0, join_timeout=5.0)
+            try:
+                for i in range(3):  # warm with complete 2-party rounds
+                    ra, rb = await asyncio.gather(
+                        a.average(make_tree(0.0), i), b.average(make_tree(2.0), i)
+                    )
+                    assert ra is not None and rb is not None
+                eff_before = a.effective_gather_timeout
+                assert eff_before < 10.0, eff_before
+                # silent peer needs to rendezvous with a+b: bootstrap its DHT
+                # into the swarm
+                t0 = _time.monotonic()
+                ra, rb, _ = await asyncio.gather(
+                    a.average(make_tree(0.0), 50),
+                    b.average(make_tree(2.0), 50),
+                    silent.average(make_tree(9.0), 50),
+                )
+                dt = _time.monotonic() - t0
+                # survivors aggregate the subset at the ADAPTIVE deadline
+                assert ra is not None and rb is not None
+                # the gather wait really fired (a sub-second round would mean
+                # the silent peer never made it into the group — vacuous)
+                assert dt > 1.5, dt
+                assert dt < 5.0 + eff_before + 10.0, dt  # never the 30s budget
+                # and the degraded round did not ratchet the estimate up
+                assert a.effective_gather_timeout <= eff_before * 1.5 + 0.1
+            finally:
+                await t.close()
+                await teardown(vols)
+
+        run(main())
