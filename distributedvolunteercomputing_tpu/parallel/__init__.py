@@ -5,16 +5,19 @@ This is the TPU-native replacement for the reference's NCCL intra-node layer
 hand-called NCCL ops. The swarm/ package handles the WAN (DCN) tier between
 volunteer slices; this package handles everything inside one slice:
 
-- ``mesh``       — device mesh construction (dp / tp / sp axes)
-- ``sharding``   — parameter partition rules (Megatron-style TP for the
-                   transformer zoo) and batch specs
+- ``mesh``       — device mesh construction ((dp, sp, pp, ep, tp) axes)
+- ``sharding``   — parameter partition rules (Megatron-style TP, stacked
+                   layers over pp, expert stacks over ep) and batch specs
 - ``train_step`` — the sharded train step: fwd/bwd/update in ONE compiled
                    computation, gradient reduction over dp emitted by XLA
 - ``ring_attention`` — sequence-parallel exact attention over the sp axis
                    (ppermute ring; long-context path)
+- ``pipeline``   — GPipe microbatch pipeline over the pp axis inside one
+                   shard_map (each stage holds its own layers)
 """
 
 from distributedvolunteercomputing_tpu.parallel.mesh import make_mesh
+from distributedvolunteercomputing_tpu.parallel.pipeline import pipeline_trunk
 from distributedvolunteercomputing_tpu.parallel.sharding import (
     batch_sharding,
     make_param_shardings,
@@ -38,4 +41,5 @@ __all__ = [
     "shard_train_state",
     "ring_attention",
     "ring_attention_bhtd",
+    "pipeline_trunk",
 ]
