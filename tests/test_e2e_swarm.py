@@ -116,6 +116,29 @@ class TestSwarmE2E:
         finally:
             coord.kill()
 
+    def test_two_volunteers_gossip_averaging(self):
+        """Config-3 shape at process level (2 volunteers): gossip partners
+        are selected from membership records' avg_ns — the exact plumbing a
+        round-3 bug broke (records carried only the model name, every round
+        skipped). The in-process regression lives in test_averaging; this
+        guards the entrypoint wiring."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                "--averaging", "gossip", "--average-every", "8", "--steps", "48",
+                "--join-timeout", "25", "--gather-timeout", "25",
+            ]
+            v0 = start_volunteer(addr, "gos0", common + ["--seed", "0"])
+            v1 = start_volunteer(addr, "gos1", common + ["--seed", "1"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            # gossip needs the partner's record + published params; at least
+            # one side must have mixed (both usually do)
+            assert s0["rounds_ok"] + s1["rounds_ok"] >= 2, out0 + out1
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
+        finally:
+            coord.kill()
+
     def test_churn_kill9_survivors_finish(self):
         """Kill -9 one of three volunteers mid-run; survivors keep averaging."""
         coord, addr = start_coordinator()
