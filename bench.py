@@ -218,6 +218,11 @@ def main() -> int:
 
 
 def _recorded_probe(model_name: str) -> dict | None:
+    # Only a record of the EXACT configured benchmark may stand in for it:
+    # same model, no config overrides, same batch size, default (f32) dtype.
+    if os.environ.get("DVC_BENCH_MODEL_KW") or os.environ.get("DVC_BENCH_PARAM_DTYPE"):
+        return None
+    batch_size = int(os.environ.get("DVC_BENCH_BATCH", "8"))
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "experiments",
@@ -236,6 +241,8 @@ def _recorded_probe(model_name: str) -> dict | None:
     if age_s > max_age:
         return None
     if not rec.get("value") or model_name not in rec.get("metric", ""):
+        return None
+    if rec.get("batch_size") != batch_size:
         return None
     rec.setdefault("vs_baseline", 1.0)
     rec["source"] = (
@@ -527,27 +534,18 @@ def _bench_main() -> int:
     except (OSError, ValueError):
         pass
     if "model" in prior and "value" in prior:  # legacy single-record format
-        prior = {str(prior["model"]): prior}
-    # One record PER model-config key, so a shrunken-KW run can never clobber
-    # the flagship's baseline. Ratchet only against a record at the SAME batch
-    # size AND param dtype — comparing across either reports configuration
-    # arithmetic, not a perf delta (the bf16 rung is faster by construction).
+        prior = {}  # un-keyed by config; start fresh rather than mis-ratchet
+    # One record PER full configuration (model+overrides+batch+dtype): a run
+    # at any other configuration neither reads nor clobbers this one —
+    # cross-config comparison reports configuration arithmetic, not a perf
+    # delta (the bf16 rung is faster by construction).
     dtype_key = param_dtype or "float32"
-    model_key = model_name + metric_suffix
+    model_key = f"{model_name}{metric_suffix}|bs{batch_size}|{dtype_key}"
     rec = prior.get(model_key)
-    if (
-        isinstance(rec, dict)
-        and rec.get("value")
-        and rec.get("batch_size") == batch_size
-        and rec.get("param_dtype", "float32") == dtype_key
-    ):
+    if isinstance(rec, dict) and rec.get("value"):
         vs_baseline = samples_per_sec_chip / float(rec["value"])
-    elif rec is None:
-        prior[model_key] = {
-            "value": samples_per_sec_chip,
-            "batch_size": batch_size,
-            "param_dtype": dtype_key,
-        }
+    else:
+        prior[model_key] = {"value": samples_per_sec_chip}
         try:
             with open(baseline_path, "w") as fh:
                 json.dump(prior, fh)

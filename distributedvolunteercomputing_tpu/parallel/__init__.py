@@ -21,6 +21,7 @@ from distributedvolunteercomputing_tpu.parallel.pipeline import pipeline_trunk
 from distributedvolunteercomputing_tpu.parallel.sharding import (
     batch_sharding,
     make_param_shardings,
+    make_zero1_opt_shardings,
     partition_spec_for_path,
 )
 from distributedvolunteercomputing_tpu.parallel.ring_attention import (
@@ -36,6 +37,7 @@ __all__ = [
     "make_mesh",
     "batch_sharding",
     "make_param_shardings",
+    "make_zero1_opt_shardings",
     "partition_spec_for_path",
     "make_sharded_train_step",
     "shard_train_state",

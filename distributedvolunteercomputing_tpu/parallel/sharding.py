@@ -115,6 +115,37 @@ def make_param_shardings(mesh: Mesh, params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(assign, params)
 
 
+def make_zero1_opt_shardings(mesh: Mesh, params: Any) -> Any:
+    """ZeRO-1 shardings for params-shaped optimizer moments: each leaf's spec
+    is its param spec plus ``dp`` on the first still-replicated dim that the
+    dp axis divides.
+
+    Rationale: params stay replicated over dp (grads psum in backward — the
+    genre's data-parallel contract), but Adam's mu/nu never enter a matmul,
+    so nothing forces them replicated; sharding them over dp cuts optimizer
+    memory per chip by the dp factor (AdamW: from 2x params to 2x/dp). GSPMD
+    then emits reduce-scatter(grads) + all-gather(updated params) around the
+    elementwise update — the ZeRO-1 communication pattern — from annotations
+    alone. Composes with tp/pp rules: a [L, d_in, d_out] qkv leaf on a
+    dp2/pp2/tp2 mesh ends up P("pp", "dp", "tp")."""
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes.get("dp", 1)
+
+    def assign(path, leaf):
+        spec = partition_spec_for_path(_path_str(path), leaf.shape, mesh)
+        padded = list(spec) + [None] * (len(leaf.shape) - len(spec))
+        if dp > 1:
+            for dim in range(len(leaf.shape)):
+                if padded[dim] is None and leaf.shape[dim] % dp == 0:
+                    padded[dim] = "dp"
+                    break
+        while padded and padded[-1] is None:  # P(None) and P() compare unequal
+            padded.pop()
+        return NamedSharding(mesh, P(*padded))
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
 def batch_sharding(mesh: Mesh, seq_axis: bool = False) -> Any:
     """Sharding for a batch dict: leading dim over dp, optionally dim 1 over sp.
 

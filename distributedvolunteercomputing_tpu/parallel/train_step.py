@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributedvolunteercomputing_tpu.parallel.sharding import (
     batch_sharding,
     make_param_shardings,
+    make_zero1_opt_shardings,
 )
 from distributedvolunteercomputing_tpu.training.steps import (
     Batch,
@@ -30,21 +31,21 @@ from distributedvolunteercomputing_tpu.training.steps import (
 )
 
 
-def _shard_opt_state_like_params(
-    opt_state: Any, param_shardings: Any, params_treedef: Any, replicated: Any
+def _map_params_shaped_subtrees(
+    opt_state: Any,
+    params_treedef: Any,
+    subtree_fn: Callable[[Any], Any],
+    other_fn: Callable[[Any], Any],
 ) -> Any:
-    """Place optimizer state on the mesh, preserving its VALUES.
-
-    Optax states (e.g. Adam's mu/nu) embed whole params-shaped pytrees;
-    any subtree whose treedef equals the params' gets the params' per-leaf
-    shardings, everything else (step counts, scalars) is replicated. This
-    keeps a warm/restored optimizer state intact — re-initialising via
-    tx.init would silently zero the moments on resume.
-    """
+    """Structural walk over an optax state: apply ``subtree_fn`` to every
+    subtree whose treedef equals the params' (Adam's mu/nu and friends),
+    ``other_fn`` to every other leaf (step counts, scalars). The single walker
+    shared by mesh placement and the ZeRO-1 in-step constraint, so the two
+    can't diverge on optax state shapes."""
 
     def rec(node):
         if jax.tree_util.tree_structure(node) == params_treedef:
-            return jax.tree_util.tree_map(jax.device_put, node, param_shardings)
+            return subtree_fn(node)
         if isinstance(node, tuple):  # optax states are (named)tuples
             out = [rec(c) for c in node]
             return type(node)(*out) if hasattr(node, "_fields") else tuple(out)
@@ -54,29 +55,51 @@ def _shard_opt_state_like_params(
             return {k: rec(v) for k, v in node.items()}
         if node is None:
             return None
-        return jax.device_put(node, replicated)
+        return other_fn(node)
 
     return rec(opt_state)
 
 
+def _shard_opt_state_like_params(
+    opt_state: Any, param_shardings: Any, params_treedef: Any, replicated: Any
+) -> Any:
+    """Place optimizer state on the mesh, preserving its VALUES.
+
+    Params-shaped subtrees get the given per-leaf shardings, everything else
+    is replicated. This keeps a warm/restored optimizer state intact —
+    re-initialising via tx.init would silently zero the moments on resume.
+    """
+    return _map_params_shaped_subtrees(
+        opt_state,
+        params_treedef,
+        lambda node: jax.tree_util.tree_map(jax.device_put, node, param_shardings),
+        lambda leaf: jax.device_put(leaf, replicated),
+    )
+
+
 def shard_train_state(
-    state: TrainState, mesh: Mesh, tx: Any = None
+    state: TrainState, mesh: Mesh, tx: Any = None, zero1: bool = False
 ) -> Tuple[TrainState, Any]:
     """Place a host/single-device TrainState onto the mesh.
 
     Params get their rule-derived shardings; the optimizer state keeps its
     values (warm moments survive a resume) with params-shaped subtrees
-    sharded exactly like their params. ``tx`` is unused and kept for
-    call-site compatibility. Returns (sharded_state, param_shardings).
+    sharded exactly like their params — or, with ``zero1``, additionally
+    sharded over dp (ZeRO-1; see make_zero1_opt_shardings). ``tx`` is unused
+    and kept for call-site compatibility. Returns (sharded_state,
+    param_shardings).
     """
     param_shardings = make_param_shardings(mesh, state.params)
+    opt_shardings = (
+        make_zero1_opt_shardings(mesh, state.params) if zero1 else param_shardings
+    )
     params_treedef = jax.tree_util.tree_structure(state.params)
     replicated = NamedSharding(mesh, P())
     return (
         TrainState(
             params=jax.device_put(state.params, param_shardings),
             opt_state=_shard_opt_state_like_params(
-                state.opt_state, param_shardings, params_treedef, replicated
+                state.opt_state, opt_shardings, params_treedef, replicated
             ),
             step=jax.device_put(state.step, replicated),
             rng=jax.device_put(state.rng, replicated),
@@ -92,6 +115,7 @@ def make_sharded_train_step(
     donate: bool = True,
     seq_sharded_batch: bool = False,
     accum_steps: int = 1,
+    zero1: bool = False,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the jitted sharded ``(state, batch) -> (state, metrics)`` step.
 
@@ -103,10 +127,34 @@ def make_sharded_train_step(
     With ``seq_sharded_batch`` and an ``sp`` mesh axis of size > 1, the step
     body is traced under the sequence-parallel context, so every attention in
     the model routes to ring attention (parallel/ring_attention.py) over sp.
+
+    With ``zero1`` (state sharded via ``shard_train_state(..., zero1=True)``),
+    the updated optimizer moments are constrained back to their dp-sharded
+    specs every step, so GSPMD keeps them distributed instead of quietly
+    re-replicating — per-chip optimizer memory stays at 1/dp.
     """
     bspec = batch_sharding(mesh, seq_axis=seq_sharded_batch)
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     use_ring = seq_sharded_batch and axis_sizes.get("sp", 1) > 1
+
+    def constrain_opt(state: TrainState) -> TrainState:
+        if not zero1:
+            return state
+        opt_shardings = make_zero1_opt_shardings(mesh, state.params)
+        constrained = _map_params_shaped_subtrees(
+            state.opt_state,
+            jax.tree_util.tree_structure(state.params),
+            lambda node: jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, node, opt_shardings
+            ),
+            lambda leaf: leaf,
+        )
+        return TrainState(
+            params=state.params,
+            opt_state=constrained,
+            step=state.step,
+            rng=state.rng,
+        )
 
     def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
         batch = jax.lax.with_sharding_constraint(batch, bspec)
@@ -115,8 +163,10 @@ def make_sharded_train_step(
             from distributedvolunteercomputing_tpu.ops.attention import sequence_parallel
 
             with sequence_parallel(mesh, "sp"):
-                return train_step_body(loss_fn, tx, state, batch, accum_steps)
-        return train_step_body(loss_fn, tx, state, batch, accum_steps)
+                new_state, metrics = train_step_body(loss_fn, tx, state, batch, accum_steps)
+        else:
+            new_state, metrics = train_step_body(loss_fn, tx, state, batch, accum_steps)
+        return constrain_opt(new_state), metrics
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 

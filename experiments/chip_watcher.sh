@@ -11,6 +11,9 @@ cd /root/repo || exit 1
 mkdir -p experiments/results
 LOG=experiments/results/chip_watcher.log
 OUT=experiments/results/tpu_probe_success.json
+# A record left over from a previous round must not satisfy this round's
+# loop (the workdir persists across rounds) — set it aside at startup.
+[ -f "$OUT" ] && mv "$OUT" "$OUT.prev"
 echo "$(date +%T) watcher start" >>"$LOG"
 while [ ! -f "$OUT" ]; do
     if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
