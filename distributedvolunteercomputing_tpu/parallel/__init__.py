@@ -20,6 +20,7 @@ from distributedvolunteercomputing_tpu.parallel.mesh import make_mesh
 from distributedvolunteercomputing_tpu.parallel.pipeline import pipeline_trunk
 from distributedvolunteercomputing_tpu.parallel.sharding import (
     batch_sharding,
+    make_fsdp_param_shardings,
     make_param_shardings,
     make_zero1_opt_shardings,
     partition_spec_for_path,
@@ -36,6 +37,7 @@ from distributedvolunteercomputing_tpu.parallel.train_step import (
 __all__ = [
     "make_mesh",
     "batch_sharding",
+    "make_fsdp_param_shardings",
     "make_param_shardings",
     "make_zero1_opt_shardings",
     "partition_spec_for_path",

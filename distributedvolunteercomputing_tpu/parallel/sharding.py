@@ -115,19 +115,10 @@ def make_param_shardings(mesh: Mesh, params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(assign, params)
 
 
-def make_zero1_opt_shardings(mesh: Mesh, params: Any) -> Any:
-    """ZeRO-1 shardings for params-shaped optimizer moments: each leaf's spec
-    is its param spec plus ``dp`` on the first still-replicated dim that the
-    dp axis divides.
-
-    Rationale: params stay replicated over dp (grads psum in backward — the
-    genre's data-parallel contract), but Adam's mu/nu never enter a matmul,
-    so nothing forces them replicated; sharding them over dp cuts optimizer
-    memory per chip by the dp factor (AdamW: from 2x params to 2x/dp). GSPMD
-    then emits reduce-scatter(grads) + all-gather(updated params) around the
-    elementwise update — the ZeRO-1 communication pattern — from annotations
-    alone. Composes with tp/pp rules: a [L, d_in, d_out] qkv leaf on a
-    dp2/pp2/tp2 mesh ends up P("pp", "dp", "tp")."""
+def _dp_sharded_specs(mesh: Mesh, params: Any) -> Any:
+    """Each leaf's rule spec plus ``dp`` on the first still-replicated dim the
+    dp axis divides (leaves with no such dim keep their rule spec). The shared
+    placement rule behind ZeRO-1 (optimizer moments) and FSDP (params)."""
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     dp = axis_sizes.get("dp", 1)
 
@@ -144,6 +135,35 @@ def make_zero1_opt_shardings(mesh: Mesh, params: Any) -> Any:
         return NamedSharding(mesh, P(*padded))
 
     return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def make_zero1_opt_shardings(mesh: Mesh, params: Any) -> Any:
+    """ZeRO-1 shardings for params-shaped optimizer moments.
+
+    Rationale: params stay replicated over dp (grads psum in backward — the
+    genre's data-parallel contract), but Adam's mu/nu never enter a matmul,
+    so nothing forces them replicated; sharding them over dp cuts optimizer
+    memory per chip by the dp factor (AdamW: from 2x params to 2x/dp). GSPMD
+    then emits reduce-scatter(grads) + all-gather(updated params) around the
+    elementwise update — the ZeRO-1 communication pattern — from annotations
+    alone. Composes with tp/pp rules: a [L, d_in, d_out] qkv leaf on a
+    dp2/pp2/tp2 mesh ends up P("pp", "dp", "tp")."""
+    return _dp_sharded_specs(mesh, params)
+
+
+def make_fsdp_param_shardings(mesh: Mesh, params: Any) -> Any:
+    """FSDP (ZeRO-3) shardings: the PARAMS themselves sharded over dp (same
+    first-free-dim rule), so weights + grads + optimizer state all live at
+    1/dp per chip — the regime where Llama-7B-scale models fit a slice.
+
+    GSPMD inserts the FSDP communication pattern from these annotations: an
+    all-gather materializes each weight just before its matmul (fwd and bwd),
+    and the gradient reduction becomes a reduce-scatter back to the shards.
+    The train step re-constrains updated params each step
+    (make_sharded_train_step(fsdp=True)) so the sharding persists. Trades
+    per-step all-gather bandwidth (ICI-resident on a TPU slice) for dp-fold
+    memory — the standard TPU fully-sharded recipe."""
+    return _dp_sharded_specs(mesh, params)
 
 
 def batch_sharding(mesh: Mesh, seq_axis: bool = False) -> Any:

@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributedvolunteercomputing_tpu.parallel.sharding import (
     batch_sharding,
+    make_fsdp_param_shardings,
     make_param_shardings,
     make_zero1_opt_shardings,
 )
@@ -78,20 +79,29 @@ def _shard_opt_state_like_params(
 
 
 def shard_train_state(
-    state: TrainState, mesh: Mesh, tx: Any = None, zero1: bool = False
+    state: TrainState, mesh: Mesh, tx: Any = None, zero1: bool = False,
+    fsdp: bool = False,
 ) -> Tuple[TrainState, Any]:
     """Place a host/single-device TrainState onto the mesh.
 
     Params get their rule-derived shardings; the optimizer state keeps its
     values (warm moments survive a resume) with params-shaped subtrees
     sharded exactly like their params — or, with ``zero1``, additionally
-    sharded over dp (ZeRO-1; see make_zero1_opt_shardings). ``tx`` is unused
-    and kept for call-site compatibility. Returns (sharded_state,
-    param_shardings).
+    sharded over dp (ZeRO-1; see make_zero1_opt_shardings). With ``fsdp``
+    the params THEMSELVES are dp-sharded too (ZeRO-3: weights, grads and
+    optimizer state all at 1/dp per chip; make_fsdp_param_shardings).
+    ``tx`` is unused and kept for call-site compatibility. Returns
+    (sharded_state, param_shardings).
     """
-    param_shardings = make_param_shardings(mesh, state.params)
+    param_shardings = (
+        make_fsdp_param_shardings(mesh, state.params)
+        if fsdp
+        else make_param_shardings(mesh, state.params)
+    )
     opt_shardings = (
-        make_zero1_opt_shardings(mesh, state.params) if zero1 else param_shardings
+        make_zero1_opt_shardings(mesh, state.params)
+        if (zero1 or fsdp)
+        else param_shardings
     )
     params_treedef = jax.tree_util.tree_structure(state.params)
     replicated = NamedSharding(mesh, P())
@@ -116,13 +126,16 @@ def make_sharded_train_step(
     seq_sharded_batch: bool = False,
     accum_steps: int = 1,
     zero1: bool = False,
+    fsdp: bool = False,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the jitted sharded ``(state, batch) -> (state, metrics)`` step.
 
     The batch must be device_put with ``batch_sharding(mesh, ...)`` (leading
     dim over dp); state via ``shard_train_state``. Gradient reduction across
-    dp is NOT explicit: params are replicated over dp, so XLA emits the psum
-    during backward — the TPU equivalent of the reference's NCCL allreduce.
+    dp is NOT explicit: in the default (non-fsdp) mode params are replicated
+    over dp, so XLA emits the psum during backward — the TPU equivalent of
+    the reference's NCCL allreduce. Under ``fsdp`` params are dp-SHARDED and
+    that reduction becomes a reduce-scatter back to the shards.
 
     With ``seq_sharded_batch`` and an ``sp`` mesh axis of size > 1, the step
     body is traced under the sequence-parallel context, so every attention in
@@ -131,14 +144,15 @@ def make_sharded_train_step(
     With ``zero1`` (state sharded via ``shard_train_state(..., zero1=True)``),
     the updated optimizer moments are constrained back to their dp-sharded
     specs every step, so GSPMD keeps them distributed instead of quietly
-    re-replicating — per-chip optimizer memory stays at 1/dp.
+    re-replicating — per-chip optimizer memory stays at 1/dp. With ``fsdp``
+    the updated PARAMS are constrained to their dp shards as well (ZeRO-3).
     """
     bspec = batch_sharding(mesh, seq_axis=seq_sharded_batch)
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     use_ring = seq_sharded_batch and axis_sizes.get("sp", 1) > 1
 
     def constrain_opt(state: TrainState) -> TrainState:
-        if not zero1:
+        if not (zero1 or fsdp):
             return state
         opt_shardings = make_zero1_opt_shardings(mesh, state.params)
         constrained = _map_params_shaped_subtrees(
@@ -149,8 +163,15 @@ def make_sharded_train_step(
             ),
             lambda leaf: leaf,
         )
+        params = state.params
+        if fsdp:
+            params = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint,
+                params,
+                make_fsdp_param_shardings(mesh, params),
+            )
         return TrainState(
-            params=state.params,
+            params=params,
             opt_state=constrained,
             step=state.step,
             rng=state.rng,
