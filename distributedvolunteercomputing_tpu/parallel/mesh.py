@@ -48,3 +48,26 @@ def make_mesh(
 
 def mesh_shape(mesh: Mesh) -> dict:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def parse_mesh_spec(spec: str) -> dict:
+    """Parse a ``"dp=2,tp=2"``-style CLI mesh spec into make_mesh kwargs,
+    with errors that name the expected format (a bare int() traceback from
+    deep inside volunteer startup helps nobody)."""
+    axes: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue  # tolerate a trailing comma
+        k, eq, v = part.partition("=")
+        k = k.strip()
+        if not eq or k not in AXES or not v.strip().isdigit() or int(v) < 1:
+            raise ValueError(
+                f"bad mesh spec {spec!r}: expected comma-separated axis=N "
+                f"with axes from {AXES} and N >= 1 (e.g. 'dp=2,tp=2'); "
+                f"offending part: {part!r}"
+            )
+        axes[k] = int(v)
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}: expected e.g. 'dp=2,tp=2'")
+    return axes

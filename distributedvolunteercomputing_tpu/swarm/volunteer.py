@@ -76,6 +76,14 @@ class VolunteerConfig:
     # Adaptive round deadlines (EWMA of successful rounds; see AveragerBase):
     # a dead peer costs seconds instead of the full gather budget.
     adaptive_timeout: bool = False
+    # In-slice mesh: "dp=2,tp=2"-style spec over THIS volunteer's local
+    # devices (a TPU slice); empty = single-device step. The WAN tier still
+    # sees one volunteer either way. ``fsdp`` shards params+optimizer over
+    # the mesh's dp axis (ZeRO-3); ``seq_sharded`` turns on ring attention
+    # over its sp axis.
+    mesh: str = ""
+    fsdp: bool = False
+    seq_sharded: bool = False
 
     def __post_init__(self):
         if not self.peer_id:
@@ -201,9 +209,20 @@ class Volunteer:
                 self.cfg.data_path, self.cfg.batch_size,
                 seed=zlib.crc32(self.cfg.peer_id.encode()) & 0x7FFFFFFF,
             )
+        mesh = None
+        if self.cfg.mesh:
+            from distributedvolunteercomputing_tpu.parallel.mesh import (
+                make_mesh,
+                parse_mesh_spec,
+            )
+
+            mesh = make_mesh(**parse_mesh_spec(self.cfg.mesh))
         self.trainer = Trainer(
             bundle,
             data=data,
+            mesh=mesh,
+            fsdp=self.cfg.fsdp,
+            seq_sharded=self.cfg.seq_sharded,
             batch_size=self.cfg.batch_size,
             optimizer=self.cfg.optimizer,
             lr=self.cfg.lr,

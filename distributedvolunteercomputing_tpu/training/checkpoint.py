@@ -140,6 +140,18 @@ def maybe_restore(trainer, ckpt_dir: str) -> bool:
         step=jax.device_put(restored["step"]),
         rng=jax.device_put(restored["rng"]),
     )
+    if trainer.mesh is not None:
+        # A mesh trainer's state lives SHARDED (tp/pp rules; 1/dp per chip
+        # under fsdp). Re-place the restored host trees exactly as __init__
+        # did — a plain device_put would replicate everything, which on a
+        # slice sized for fsdp is an immediate OOM.
+        from distributedvolunteercomputing_tpu.parallel.train_step import (
+            shard_train_state,
+        )
+
+        trainer.state, trainer._param_shardings = shard_train_state(
+            trainer.state, trainer.mesh, trainer.tx, fsdp=trainer.fsdp
+        )
     # Refresh the cross-thread snapshot: the state-sync provider must
     # announce/serve the RESTORED step, not the cold init from __init__.
     trainer._take_snapshot(step)

@@ -77,6 +77,14 @@ class Trainer:
         total_steps: Optional[int] = None,
         on_step: Optional[Callable[["Trainer", int], None]] = None,
         data: Optional[Iterable[Batch]] = None,  # overrides the synthetic stream
+        # In-slice device mesh: when a volunteer owns a multi-chip TPU slice,
+        # the step is sharded over it (parallel/train_step.py) — dp/sp/tp/...
+        # inside the slice, while the WAN averager still sees one volunteer.
+        # ``fsdp`` shards params+opt over the mesh's dp axis (ZeRO-3);
+        # ``seq_sharded`` routes attention to the ring kernel over sp.
+        mesh: Optional[Any] = None,
+        fsdp: bool = False,
+        seq_sharded: bool = False,
     ):
         if average_what not in ("params", "grads"):
             raise ValueError(f"unknown average_what {average_what!r}")
@@ -119,10 +127,45 @@ class Trainer:
             else None
         )
         self._inflight: Optional[tuple] = None  # (launch_step, payload0, future)
+        if mesh is None and (fsdp or seq_sharded):
+            raise ValueError("fsdp/seq_sharded require a mesh (--mesh dp=...,tp=...)")
+        if fsdp and averager is not None and average_what == "grads":
+            # The split grad/apply steps have no in-step constraint keeping
+            # params at 1/dp, so ZeRO-3 would silently re-replicate — and
+            # per-step host grad averaging defeats its purpose anyway.
+            raise ValueError("fsdp is a params-mode feature; use average_what='params'")
+        self.mesh = mesh
+        self.fsdp = fsdp
+        self._param_shardings = None
+        self._put_batch: Optional[Callable[[Batch], Batch]] = None
+        if mesh is not None:
+            from distributedvolunteercomputing_tpu.parallel.train_step import (
+                put_batch,
+                shard_train_state,
+            )
+
+            self.state, self._param_shardings = shard_train_state(
+                self.state, mesh, self.tx, fsdp=fsdp
+            )
+            self._put_batch = lambda b: put_batch(b, mesh, seq_sharded=seq_sharded)
         if self._grads_mode:
+            # The split steps are plain jits: with mesh-sharded inputs GSPMD
+            # partitions them like the fused sharded step for replicated-dp
+            # layouts (tp/pp rules propagate from the input shardings). The
+            # fsdp layout needs the fused step's in-step constraints and is
+            # rejected above.
             self._grad_fn = make_grad_step(bundle.loss_fn, accum_steps=accum_steps)
             self._apply_fn = make_apply_step(self.tx)
             self._step_fn = None
+        elif mesh is not None:
+            from distributedvolunteercomputing_tpu.parallel.train_step import (
+                make_sharded_train_step,
+            )
+
+            self._step_fn = make_sharded_train_step(
+                bundle.loss_fn, self.tx, mesh, accum_steps=accum_steps,
+                seq_sharded_batch=seq_sharded, fsdp=fsdp,
+            )
         else:
             self._step_fn = make_train_step(
                 bundle.loss_fn, self.tx, accum_steps=accum_steps
@@ -153,7 +196,9 @@ class Trainer:
         import jax.numpy as jnp
 
         self.state = TrainState(
-            params=jax.device_put(params),
+            params=jax.device_put(params, self._param_shardings)
+            if self._param_shardings is not None
+            else jax.device_put(params),
             opt_state=self.state.opt_state,
             step=self.state.step if step is None else jnp.asarray(step, jnp.int32),
             rng=self.state.rng,
@@ -184,7 +229,9 @@ class Trainer:
         cross-thread snapshot. The ONE place a merge becomes live state —
         the overlap and blocking paths must not diverge here."""
         self.state = TrainState(
-            params=jax.device_put(new_params),
+            params=jax.device_put(new_params, self._param_shardings)
+            if self._param_shardings is not None
+            else jax.device_put(new_params),
             opt_state=self.state.opt_state,
             step=self.state.step,
             rng=self.state.rng,
@@ -194,8 +241,12 @@ class Trainer:
 
     def _run_average_round(self, tree: Any, step_no: int, what: str) -> Optional[Any]:
         """One WAN round: select payload -> averager -> record -> merge.
-        Returns the merged tree, or None when no group formed / round failed."""
-        payload = self.bundle.avg_select(tree)
+        Returns the merged tree, or None when no group formed / round failed.
+
+        The payload crosses to HOST first — the AveragerFn contract is host
+        numpy (the overlap path already guarantees it; for a mesh-sharded
+        state this is also the gather from the slice's shards)."""
+        payload = jax.tree_util.tree_map(np.asarray, self.bundle.avg_select(tree))
         t_avg = time.monotonic()
         averaged = self.averager(payload, step_no)
         self.metrics.record_event(
@@ -303,6 +354,8 @@ class Trainer:
                 log.info("stop flag set; exiting train loop at step %d", int(self.state.step))
                 break
             batch = next(it)
+            if self._put_batch is not None:
+                batch = self._put_batch(batch)
             step_no = start_step + ran_steps + 1
             if profile_dir and not profiling and i == profile_start:
                 jax.profiler.start_trace(profile_dir)

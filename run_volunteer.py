@@ -67,6 +67,16 @@ def main() -> None:
                     help="gradient-accumulation microbatches inside the compiled "
                          "step; lets slow/small volunteers train the same "
                          "effective batch in less HBM")
+    ap.add_argument("--mesh", default="",
+                    help="in-slice device mesh spec, e.g. dp=2,tp=2 — shards "
+                         "the step over this volunteer's local chips (TPU "
+                         "slice); empty = single device")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-3: shard params+optimizer over the mesh's dp "
+                         "axis (weights, grads, opt state at 1/dp per chip)")
+    ap.add_argument("--seq-sharded", action="store_true",
+                    help="shard the sequence dim over the mesh's sp axis "
+                         "(ring attention; long-context path)")
     ap.add_argument("--data", default=None,
                     help=".npz of aligned arrays (keys = the model's batch schema); default synthetic")
     ap.add_argument("--optimizer", default="adam")
@@ -115,6 +125,9 @@ def main() -> None:
         method=args.method,
         batch_size=args.batch_size,
         accum_steps=args.accum_steps,
+        mesh=args.mesh,
+        fsdp=args.fsdp,
+        seq_sharded=args.seq_sharded,
         data_path=args.data,
         optimizer=args.optimizer,
         lr=args.lr,

@@ -46,7 +46,10 @@ def start_coordinator():
     raise RuntimeError("coordinator did not become ready")
 
 
-def start_volunteer(coord_addr, peer_id, extra):
+def start_volunteer(coord_addr, peer_id, extra, env_extra=None):
+    env = _env()
+    if env_extra:
+        env.update(env_extra)
     return subprocess.Popen(
         [
             sys.executable, os.path.join(REPO, "run_volunteer.py"),
@@ -57,7 +60,7 @@ def start_volunteer(coord_addr, peer_id, extra):
             *TINY_MLP,
             *extra,
         ],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
     )
 
 
@@ -137,6 +140,28 @@ class TestSwarmE2E:
             # yielded exactly 0). Both sides usually mix several times, but
             # under single-core contention a side can miss its windows —
             # asserting >=1 keeps the guard without the timing flake.
+            assert s0["rounds_ok"] + s1["rounds_ok"] >= 1, out0 + out1
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
+        finally:
+            coord.kill()
+
+    def test_two_volunteers_with_in_slice_mesh(self):
+        """Each volunteer process owns a 4-device virtual slice (forced CPU
+        devices) and runs the SHARDED step (--mesh dp=2,tp=2 --fsdp) while
+        sync-averaging over the WAN tier — the per-volunteer-slice contract:
+        in-slice parallelism is invisible to the swarm."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                "--averaging", "sync", "--average-every", "8", "--steps", "24",
+                "--join-timeout", "25", "--gather-timeout", "25",
+                "--mesh", "dp=2,tp=2", "--fsdp",
+            ]
+            env4 = {"XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+            v0 = start_volunteer(addr, "mesh0", common + ["--seed", "0"], env_extra=env4)
+            v1 = start_volunteer(addr, "mesh1", common + ["--seed", "1"], env_extra=env4)
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
             assert s0["rounds_ok"] + s1["rounds_ok"] >= 1, out0 + out1
             assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
         finally:

@@ -289,6 +289,109 @@ class TestFSDP:
         assert state.params["wte"].sharding.spec == P("dp")
 
 
+class TestTrainerOnMesh:
+    """A volunteer that owns a multi-chip slice: the Trainer drives the
+    sharded step over an in-slice mesh while the WAN tier (the averager
+    callback) still sees host numpy pytrees — the per-volunteer-slice
+    contract (SURVEY.md §1 TPU mapping)."""
+
+    def test_params_mode_with_averaging_and_fsdp(self, eight_devices):
+        from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+        bundle = get_model("gpt2_small", **TINY_GPT2)
+        mesh = make_mesh(dp=2, tp=4)
+        calls = []
+
+        def averager(payload, step_no):
+            # WAN contract: host numpy in, averaged pytree out.
+            assert all(isinstance(x, np.ndarray) for x in jax.tree_util.tree_leaves(payload))
+            calls.append(step_no)
+            return jax.tree_util.tree_map(lambda x: x * 0.5, payload)
+
+        t = Trainer(
+            bundle, batch_size=16, lr=1e-3, mesh=mesh, fsdp=True,
+            average_every=3, averager=averager, overlap=False,
+        )
+        summary = t.run(steps=7, log_every=0)
+        assert np.isfinite(summary["final_loss"])
+        assert calls == [3, 6]
+        # after the averaging swap, params are STILL mesh-sharded (fsdp)
+        w = t.state.params["blocks"]["qkv"]["w"]
+        assert w.sharding.spec == P("dp", None, "tp")
+
+    def test_grads_mode_on_mesh_matches_replicated(self, eight_devices):
+        from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+        bundle = get_model("gpt2_small", **TINY_GPT2)
+
+        def identity_avg(payload, step_no):
+            return payload  # group of one: average == own grads
+
+        kw = dict(
+            batch_size=16, lr=1e-3, seed=0, init_seed=0,
+            average_every=4, averager=identity_avg, average_what="grads",
+        )
+        ref = Trainer(bundle, **kw)
+        ref_summary = ref.run(steps=3, log_every=0)
+
+        mesh = make_mesh(dp=2, tp=4)
+        t = Trainer(bundle, mesh=mesh, **kw)
+        summary = t.run(steps=3, log_every=0)
+        np.testing.assert_allclose(
+            summary["final_loss"], ref_summary["final_loss"], rtol=2e-4
+        )
+
+    def test_checkpoint_restore_keeps_mesh_placement(self, eight_devices, tmp_path):
+        # A restarted mesh/fsdp volunteer must come back SHARDED: a plain
+        # device_put restore would replicate a model that only fits at 1/dp.
+        from distributedvolunteercomputing_tpu.training import checkpoint
+        from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+        bundle = get_model("gpt2_small", **TINY_GPT2)
+        mesh = make_mesh(dp=2, tp=4)
+        t = Trainer(bundle, batch_size=8, mesh=mesh, fsdp=True)
+        t.run(steps=2, log_every=0)
+        checkpoint.save(t, str(tmp_path))
+
+        t2 = Trainer(bundle, batch_size=8, mesh=mesh, fsdp=True)
+        assert checkpoint.maybe_restore(t2, str(tmp_path))
+        w = t2.state.params["blocks"]["qkv"]["w"]
+        assert w.sharding.spec == P("dp", None, "tp")
+        assert w.addressable_shards[0].data.size == w.size // 8
+        assert int(t2.state.step) == 2
+        s = t2.run(steps=1, log_every=0)
+        assert np.isfinite(s["final_loss"])
+
+    def test_config_validation(self, eight_devices):
+        from distributedvolunteercomputing_tpu.parallel.mesh import parse_mesh_spec
+        from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+        bundle = get_model("mnist_mlp")
+        with pytest.raises(ValueError, match="require a mesh"):
+            Trainer(bundle, fsdp=True)
+        with pytest.raises(ValueError, match="params-mode"):
+            Trainer(
+                bundle, mesh=make_mesh(dp=2), fsdp=True,
+                averager=lambda p, s: p, average_what="grads",
+            )
+        assert parse_mesh_spec("dp=2,tp=2,") == {"dp": 2, "tp": 2}
+        for bad in ("dp2", "x=2", "dp=", "dp=0", ""):
+            with pytest.raises(ValueError, match="mesh spec"):
+                parse_mesh_spec(bad)
+
+    def test_adopt_params_keeps_mesh_placement(self, eight_devices):
+        from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+        bundle = get_model("gpt2_small", **TINY_GPT2)
+        mesh = make_mesh(dp=2, tp=4)
+        t = Trainer(bundle, batch_size=8, mesh=mesh, fsdp=True)
+        host = jax.tree_util.tree_map(np.asarray, jax.device_get(t.state.params))
+        t.adopt_params(host, step=5)
+        assert t.state.params["blocks"]["qkv"]["w"].sharding.spec == P("dp", None, "tp")
+        s = t.run(steps=2, log_every=0)
+        assert np.isfinite(s["final_loss"])
+
+
 def test_shard_train_state_preserves_warm_opt_state(eight_devices):
     # A checkpoint-resumed state has non-zero Adam moments; placing it on the
     # mesh must keep their VALUES (re-initialising would silently cold-start
