@@ -289,6 +289,63 @@ class TestFSDP:
         assert state.params["wte"].sharding.spec == P("dp")
 
 
+class TestLlama7BScale:
+    """Config-5 at its NOMINAL scale (BASELINE.json:11 finetunes Llama-2-7B):
+    validated abstractly via eval_shape — shapes, param count, and the
+    per-chip memory arithmetic under FSDP — without allocating 7B params."""
+
+    def test_7b_preset_shapes_and_fsdp_fit(self, eight_devices):
+        from distributedvolunteercomputing_tpu.models import llama
+        from distributedvolunteercomputing_tpu.parallel import make_fsdp_param_shardings
+
+        cfg = llama.LlamaConfig.llama2_7b()
+        abstract = jax.eval_shape(lambda: llama.init(jax.random.PRNGKey(0), cfg))
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(abstract))
+        assert 6.5e9 < n_params < 7.2e9, n_params  # the 7B in Llama-2-7B
+
+        # FSDP over a dp=8 slice: every big leaf must actually shard.
+        mesh = make_mesh(dp=8)
+        shardings = make_fsdp_param_shardings(mesh, abstract)
+
+        def frac_sharded(leaf, sh):
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            denom = 1
+            spec = list(sh.spec) + [None] * (len(leaf.shape) - len(sh.spec))
+            for ax in spec:
+                if ax is not None:
+                    denom *= sizes[ax]
+            return denom
+
+        total = 0
+        per_chip = 0
+        for leaf, sh in zip(
+            jax.tree_util.tree_leaves(abstract), jax.tree_util.tree_leaves(shardings)
+        ):
+            sz = int(np.prod(leaf.shape))
+            total += sz
+            per_chip += sz // frac_sharded(leaf, sh)
+        # weights f32 + AdamW mu/nu (moments shard identically): per-chip
+        # bytes must fit a 16 GB chip with room for activations; replicated
+        # they cannot (~27 GB params alone at f32... 7e9*4 = 28 GB).
+        bytes_per_chip = per_chip * 4 * 3  # params + mu + nu, f32
+        assert bytes_per_chip < 16e9, f"{bytes_per_chip / 1e9:.1f} GB/chip"
+        assert total * 4 > 16e9  # replicated would not fit — fsdp is load-bearing
+
+    def test_7b_lora_payload_is_small(self):
+        import dataclasses
+
+        from distributedvolunteercomputing_tpu.models import llama
+
+        cfg = llama.LlamaConfig.llama2_7b()
+        bundle = get_model("llama_lora", **dataclasses.asdict(cfg))
+        abstract = jax.eval_shape(lambda: bundle.init(jax.random.PRNGKey(0)))
+        adapters = bundle.avg_select(abstract)
+        n_adapter = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(adapters))
+        n_total = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(abstract))
+        # the WAN round ships adapters only: orders of magnitude less
+        assert n_adapter < n_total / 500, (n_adapter, n_total)
+
+
 class TestTrainerOnMesh:
     """A volunteer that owns a multi-chip slice: the Trainer drives the
     sharded step over an in-slice mesh while the WAN tier (the averager
