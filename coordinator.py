@@ -21,10 +21,19 @@ def main() -> None:
     ap.add_argument("--metrics", default=None, help="swarm-level metrics JSONL path")
     ap.add_argument("--advertise-host", default=None,
                     help="dialable address to publish when binding 0.0.0.0")
+    ap.add_argument("--secret-file", default=None,
+                    help="file holding the shared swarm secret; enables "
+                         "HMAC frame authentication (all members must use "
+                         "the same secret)")
     args = ap.parse_args()
+    from distributedvolunteercomputing_tpu.swarm.transport import read_secret
+
+    secret = read_secret(args.secret_file)
     try:
         asyncio.run(
-            run_coordinator_forever(args.host, args.port, args.metrics, args.advertise_host)
+            run_coordinator_forever(
+                args.host, args.port, args.metrics, args.advertise_host, secret=secret
+            )
         )
     except KeyboardInterrupt:
         pass

@@ -25,6 +25,7 @@ import asyncio
 import hashlib
 import random
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -431,16 +432,39 @@ class GossipAverager(AveragerBase):
 
     mode = "gossip"
 
+    # Inbox entries are un-keyed (unlike sync's (peer, token) contributions),
+    # so without a dedup id a REPLAYED exchange frame — even an HMAC-valid
+    # one captured within the transport auth window — would inject the same
+    # stale vector repeatedly. Every exchange carries a fresh xid; seen xids
+    # are remembered (bounded by count and age) and duplicates rejected.
+    _XID_TTL_S = 600.0
+    _XID_CAP = 4096
+
     def __init__(self, *a, seed: int = 0, **kw):
         super().__init__(*a, **kw)
         self._inbox: List[Tuple[float, np.ndarray]] = []
         self._current: Optional[Tuple[float, np.ndarray]] = None
         self._rng = random.Random(seed ^ hash(self.peer_id))
+        self._seen_xids: Dict[str, float] = {}
         self.transport.register("gossip.exchange", self._rpc_exchange)
+
+    def _xid_fresh(self, xid: Any) -> bool:
+        now = time.monotonic()
+        if len(self._seen_xids) >= self._XID_CAP:
+            cutoff = now - self._XID_TTL_S
+            self._seen_xids = {k: t for k, t in self._seen_xids.items() if t >= cutoff}
+            while len(self._seen_xids) >= self._XID_CAP:  # still full: drop oldest
+                self._seen_xids.pop(min(self._seen_xids, key=self._seen_xids.get))
+        if not isinstance(xid, str) or not xid or xid in self._seen_xids:
+            return False
+        self._seen_xids[xid] = now
+        return True
 
     async def _rpc_exchange(self, args: dict, payload: bytes):
         if not self._check_schema(args):
             raise RPCError("schema mismatch")
+        if not self._xid_fresh(args.get("xid")):
+            raise RPCError("duplicate or missing exchange id (replay?)")
         if self._current is None:
             raise RPCError("peer has no params published yet")
         my_w, my_buf = self._current
@@ -486,7 +510,8 @@ class GossipAverager(AveragerBase):
                 ret, payload = await self.transport.call(
                     addr,
                     "gossip.exchange",
-                    {"peer": self.peer_id, "weight": w, "schema": self._schema},
+                    {"peer": self.peer_id, "weight": w, "schema": self._schema,
+                     "xid": uuid.uuid4().hex},
                     self._to_wire(buf),
                     timeout=self.effective_gather_timeout,
                 )

@@ -52,7 +52,7 @@ class ChaosTransport(Transport):
         self.corrupt_rate = corrupt_rate
         self._chaos = random.Random(seed)
 
-    # Overrides the base class staticmethod — called as self._write_frame at
+    # Overrides the base class method — called as self._write_frame at
     # every send site, so instance dispatch picks this up for both the
     # client and server halves of this node.
     async def _write_frame(self, writer, ftype: int, meta: dict, payload: bytes) -> None:  # type: ignore[override]
@@ -70,7 +70,7 @@ class ChaosTransport(Transport):
             writer.write(bytes(bad))
             await writer.drain()
             return
-        await Transport._write_frame(writer, ftype, meta, payload)
+        await Transport._write_frame(self, writer, ftype, meta, payload)
 
     async def call(
         self,

@@ -29,8 +29,9 @@ class Coordinator:
         port: int = 0,
         metrics_path: Optional[str] = None,
         advertise_host: Optional[str] = None,
+        secret: Optional[bytes] = None,
     ):
-        self.transport = Transport(host, port, advertise_host=advertise_host)
+        self.transport = Transport(host, port, advertise_host=advertise_host, secret=secret)
         self.dht = DHTNode(self.transport)
         self.metrics_path = metrics_path
         self.latest_metrics: Dict[str, dict] = {}
@@ -82,9 +83,13 @@ class Coordinator:
 
 
 async def run_coordinator_forever(
-    host: str, port: int, metrics_path: Optional[str] = None, advertise_host: Optional[str] = None
+    host: str,
+    port: int,
+    metrics_path: Optional[str] = None,
+    advertise_host: Optional[str] = None,
+    secret: Optional[bytes] = None,
 ) -> None:
-    coord = Coordinator(host, port, metrics_path, advertise_host=advertise_host)
+    coord = Coordinator(host, port, metrics_path, advertise_host=advertise_host, secret=secret)
     addr = await coord.start()
     print(f"COORDINATOR_READY {addr[0]}:{addr[1]}", flush=True)
     try:

@@ -14,13 +14,27 @@ Design:
   per-call connections make failure units obvious and retries trivial.
   The native C++ core (native/) accelerates checksum + quantization of the
   payload bytes; the socket path stays asyncio.
+- Optional shared-secret message authentication (``secret=``): every frame
+  carries an HMAC-SHA256 over (frame type, canonical meta, payload) plus a
+  timestamp bounded by ``auth_window``. One chokepoint covers the whole
+  swarm tier — DHT records, membership, state sync, and averaging
+  contributions all cross this transport, so identity spoofing (which the
+  Byzantine first-write-wins rule implicitly trusts) requires the secret,
+  not just an open port. Within-window replay of an identical frame is
+  harmless at the protocol layer: sync/byzantine contributions key on
+  peer+token (idempotent re-park), DHT stores are last-writer-wins
+  re-publishes, butterfly stage slots are write-once per (epoch, stage),
+  and gossip exchanges carry a dedup xid (GossipAverager rejects repeats).
 """
 
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac
 import json
 import struct
+import time
 import uuid
 import zlib
 from typing import Awaitable, Callable, Dict, Optional, Tuple
@@ -44,8 +58,29 @@ class RPCError(Exception):
     """Remote handler raised, or the wire was corrupt."""
 
 
+def read_secret(path: Optional[str]) -> Optional[bytes]:
+    """Swarm secret from a file (whitespace-stripped); None = auth off.
+    A file, not a flag value — secrets in argv leak via process listings."""
+    if not path:
+        return None
+    with open(path, "rb") as fh:
+        secret = fh.read().strip()
+    if not secret:
+        raise ValueError(f"swarm secret file {path!r} is empty")
+    return secret
+
+
 class Transport:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, advertise_host: Optional[str] = None):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        advertise_host: Optional[str] = None,
+        secret: Optional[bytes] = None,
+        auth_window: float = 300.0,
+    ):
+        self._secret = secret
+        self._auth_window = auth_window
         self._host = host
         self._port = port
         # Bind address != reachable address when binding 0.0.0.0 (or behind
@@ -82,10 +117,23 @@ class Transport:
 
     # -- wire helpers ------------------------------------------------------
 
-    @staticmethod
+    def _mac(self, ftype: int, meta: dict, payload: bytes) -> str:
+        """HMAC over (frame type, canonical meta minus auth, payload)."""
+        canon = json.dumps(
+            {k: v for k, v in meta.items() if k != "auth"},
+            sort_keys=True,
+            separators=(",", ":"),
+        ).encode()
+        return hmac.new(
+            self._secret, bytes([ftype]) + canon + payload, hashlib.sha256
+        ).hexdigest()
+
     async def _write_frame(
-        writer: asyncio.StreamWriter, ftype: int, meta: dict, payload: bytes
+        self, writer: asyncio.StreamWriter, ftype: int, meta: dict, payload: bytes
     ) -> None:
+        if self._secret is not None:
+            meta = dict(meta, ts=round(time.time(), 3))
+            meta["auth"] = self._mac(ftype, meta, payload)
         meta_b = json.dumps(meta).encode()
         crc = zlib.crc32(payload) & 0xFFFFFFFF
         writer.write(_HEADER.pack(MAGIC, VERSION, ftype, len(meta_b), len(payload), crc))
@@ -93,8 +141,7 @@ class Transport:
         writer.write(payload)
         await writer.drain()
 
-    @staticmethod
-    async def _read_frame(reader: asyncio.StreamReader) -> Tuple[int, dict, bytes]:
+    async def _read_frame(self, reader: asyncio.StreamReader) -> Tuple[int, dict, bytes]:
         header = await reader.readexactly(_HEADER.size)
         magic, version, ftype, meta_len, payload_len, crc = _HEADER.unpack(header)
         if magic != MAGIC or version != VERSION:
@@ -107,6 +154,15 @@ class Transport:
         payload = await reader.readexactly(payload_len) if payload_len else b""
         if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
             raise RPCError("payload CRC mismatch (corrupt frame)")
+        if self._secret is not None:
+            got = meta.get("auth", "")
+            if not isinstance(got, str) or not hmac.compare_digest(
+                got, self._mac(ftype, meta, payload)
+            ):
+                raise RPCError("auth failure (missing/invalid frame HMAC)")
+            ts = meta.get("ts")
+            if not isinstance(ts, (int, float)) or abs(time.time() - ts) > self._auth_window:
+                raise RPCError("auth failure (frame timestamp outside window)")
         return ftype, meta, payload
 
     # -- server ------------------------------------------------------------

@@ -30,7 +30,7 @@ from distributedvolunteercomputing_tpu.swarm.averager import make_averager
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
 from distributedvolunteercomputing_tpu.swarm.state_sync import StateSyncService
-from distributedvolunteercomputing_tpu.swarm.transport import Transport
+from distributedvolunteercomputing_tpu.swarm.transport import Transport, read_secret
 from distributedvolunteercomputing_tpu.training.trainer import Trainer
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
 
@@ -84,6 +84,12 @@ class VolunteerConfig:
     mesh: str = ""
     fsdp: bool = False
     seq_sharded: bool = False
+    # Shared-secret frame authentication (transport-level HMAC): path to a
+    # file holding the swarm secret. Every member (coordinator included)
+    # must use the same secret; peers without it can't join, spoof
+    # identities, or inject contributions. A file, not a flag value —
+    # secrets in argv leak via process listings.
+    secret_file: Optional[str] = None
 
     def __post_init__(self):
         if not self.peer_id:
@@ -93,7 +99,10 @@ class VolunteerConfig:
 class Volunteer:
     def __init__(self, cfg: VolunteerConfig):
         self.cfg = cfg
-        self.transport = Transport(cfg.host, cfg.port, advertise_host=cfg.advertise_host)
+        self.transport = Transport(
+            cfg.host, cfg.port, advertise_host=cfg.advertise_host,
+            secret=read_secret(cfg.secret_file),
+        )
         self.dht = DHTNode(self.transport)
         self.membership: Optional[SwarmMembership] = None
         self.averager = None

@@ -77,6 +77,10 @@ def main() -> None:
     ap.add_argument("--seq-sharded", action="store_true",
                     help="shard the sequence dim over the mesh's sp axis "
                          "(ring attention; long-context path)")
+    ap.add_argument("--secret-file", default=None,
+                    help="file holding the shared swarm secret; enables "
+                         "HMAC frame authentication (must match the "
+                         "coordinator's and every peer's)")
     ap.add_argument("--data", default=None,
                     help=".npz of aligned arrays (keys = the model's batch schema); default synthetic")
     ap.add_argument("--optimizer", default="adam")
@@ -128,6 +132,7 @@ def main() -> None:
         mesh=args.mesh,
         fsdp=args.fsdp,
         seq_sharded=args.seq_sharded,
+        secret_file=args.secret_file,
         data_path=args.data,
         optimizer=args.optimizer,
         lr=args.lr,

@@ -19,7 +19,7 @@ from distributedvolunteercomputing_tpu.swarm.averager import (
 )
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
-from distributedvolunteercomputing_tpu.swarm.transport import Transport
+from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
 
 
 def run(coro):
@@ -193,6 +193,45 @@ class TestGossip:
         # order — just require movement off b's own value toward a's.
         assert float(rb2["w"].mean()) < 4.0
 
+
+    def test_replayed_exchange_rejected(self):
+        """An exchange frame replayed verbatim (same xid) must be rejected:
+        the gossip inbox is un-keyed, so without the xid dedup a captured
+        frame could be re-injected for the whole transport-auth window,
+        folding the same stale vector in repeatedly."""
+
+        async def main():
+            vols = await spawn_volunteers(2, GossipAverager)
+            try:
+                a, b = vols[0][3], vols[1][3]
+                await b.average(make_tree(2.0), 1)  # publish b's params
+                buf = b._pack(make_tree(0.0))
+                args = {
+                    "peer": "a", "weight": 1.0, "schema": b._schema,
+                    "xid": "fixed-xid-1",
+                }
+                wire = b._to_wire(buf)
+                await b._rpc_exchange(dict(args), wire)  # original: accepted
+                try:
+                    await b._rpc_exchange(dict(args), wire)  # replay
+                    replay = "accepted"
+                except RPCError:
+                    replay = "rejected"
+                # missing xid (pre-dedup sender) is also rejected
+                try:
+                    await b._rpc_exchange(
+                        {"peer": "a", "weight": 1.0, "schema": b._schema}, wire
+                    )
+                    missing = "accepted"
+                except RPCError:
+                    missing = "rejected"
+                return len(b._inbox), replay, missing
+            finally:
+                await teardown(vols)
+
+        inbox_len, replay, missing = run(main())
+        assert inbox_len == 1  # exactly the original landed
+        assert replay == "rejected" and missing == "rejected"
 
     def test_namespaced_partner_selection(self):
         """Regression (round-3 experiment matrix): volunteers namespace rounds

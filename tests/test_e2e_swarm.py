@@ -31,9 +31,9 @@ def _env():
     return env
 
 
-def start_coordinator():
+def start_coordinator(extra=()):
     proc = subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "coordinator.py")],
+        [sys.executable, os.path.join(REPO, "coordinator.py"), *extra],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
     )
     deadline = time.time() + 30
@@ -127,9 +127,13 @@ class TestSwarmE2E:
         guards the entrypoint wiring."""
         coord, addr = start_coordinator()
         try:
+            # 72 steps (9 gossip opportunities): under load the two
+            # processes' lifetimes skew (one compiles while the other
+            # trains) and gossip needs overlap — a short run can leave
+            # BOTH sides with zero mixed rounds purely by timing.
             common = [
-                "--averaging", "gossip", "--average-every", "8", "--steps", "48",
-                "--join-timeout", "25", "--gather-timeout", "25",
+                "--averaging", "gossip", "--average-every", "8", "--steps", "72",
+                "--join-timeout", "30", "--gather-timeout", "30",
             ]
             v0 = start_volunteer(addr, "gos0", common + ["--seed", "0"])
             v1 = start_volunteer(addr, "gos1", common + ["--seed", "1"])
@@ -164,6 +168,40 @@ class TestSwarmE2E:
             s1, out1 = wait_done(v1)
             assert s0["rounds_ok"] + s1["rounds_ok"] >= 1, out0 + out1
             assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
+        finally:
+            coord.kill()
+
+    def test_swarm_secret_locks_out_intruder(self, tmp_path):
+        """--secret-file end-to-end: secret-holding volunteers average
+        normally; a volunteer WITHOUT the secret cannot participate (its
+        frames fail the transport HMAC everywhere)."""
+        secret = tmp_path / "swarm.key"
+        secret.write_text("e2e-test-secret\n")
+        coord, addr = start_coordinator(["--secret-file", str(secret)])
+        try:
+            common = [
+                "--averaging", "sync", "--average-every", "8", "--steps", "24",
+                "--join-timeout", "15", "--gather-timeout", "15",
+            ]
+            v0 = start_volunteer(
+                addr, "auth0", common + ["--seed", "0", "--secret-file", str(secret)]
+            )
+            v1 = start_volunteer(
+                addr, "auth1", common + ["--seed", "1", "--secret-file", str(secret)]
+            )
+            intruder = start_volunteer(addr, "intruder", common + ["--seed", "2"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            assert s0["rounds_ok"] + s1["rounds_ok"] >= 1, out0 + out1
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
+            # The intruder either dies on join or finishes having never
+            # completed a round — it must not have averaged with anyone.
+            try:
+                si, outi = wait_done(intruder, timeout=120)
+            except Exception:  # died/hung before a summary = locked out
+                intruder.kill()
+            else:
+                assert si["rounds_ok"] == 0, outi
         finally:
             coord.kill()
 
