@@ -283,6 +283,65 @@ def q8_decode(payload: bytes, chunk: int = Q8_CHUNK) -> np.ndarray:
     return out
 
 
+# Top-k sparse wire codec (Deep-Gradient-Compression style): ship only the
+# largest-magnitude entries. Self-describing header so the decoder needs no
+# out-of-band state; falls back to dense when sparsity wouldn't pay.
+_TOPK_MAGIC = b"TK1"
+_TOPK_HDR = 3 + 1 + 8  # magic, mode u8, n u64
+_TOPK_SPARSE, _TOPK_DENSE = 0, 1
+
+
+def topk_encode(arr: np.ndarray, frac: float | None = None) -> bytes:
+    """f32 -> top-k wire bytes.
+
+    ``frac`` = fraction of entries to keep (by |value|). ``None`` = auto:
+    keep every nonzero, or go dense when sparse coding (8 B/entry) would
+    exceed dense f32 — the right mode for aggregation RESULTS, whose support
+    is the union of sparse contributions. Non-finite values are zeroed (they
+    would otherwise win the magnitude sort and poison the average)."""
+    arr = np.ascontiguousarray(arr, np.float32).ravel()
+    arr = np.where(np.isfinite(arr), arr, np.float32(0))
+    n = arr.size
+    if n >= 1 << 32:
+        raise ValueError(f"topk codec supports < 2^32 elements, got {n}")
+    header = _TOPK_MAGIC + bytes([_TOPK_SPARSE]) + np.uint64(n).tobytes()
+    if frac is None:
+        idx = np.flatnonzero(arr)
+    else:
+        k = max(1, int(n * frac)) if n else 0
+        if k >= n:
+            idx = np.arange(n, dtype=np.int64)
+        else:
+            idx = np.argpartition(np.abs(arr), n - k)[n - k:]
+    if 8 * idx.size >= 4 * n:  # sparse (8 B/entry) wouldn't pay: dense mode
+        return _TOPK_MAGIC + bytes([_TOPK_DENSE]) + np.uint64(n).tobytes() + arr.tobytes()
+    idx = np.sort(idx).astype(np.uint32)
+    return header + idx.tobytes() + arr[idx].tobytes()
+
+
+def topk_decode(payload: bytes) -> np.ndarray:
+    """Inverse of topk_encode: dense f32 with zeros off-support."""
+    if len(payload) < _TOPK_HDR or payload[:3] != _TOPK_MAGIC:
+        raise ValueError("topk payload: bad header")
+    mode = payload[3]
+    n = int(np.frombuffer(payload[4:12], np.uint64)[0])
+    body = payload[_TOPK_HDR:]
+    if mode == _TOPK_DENSE:
+        if len(body) != 4 * n:
+            raise ValueError(f"topk dense body {len(body)}B != {4 * n}B for n={n}")
+        return np.frombuffer(body, np.float32).copy()
+    if mode != _TOPK_SPARSE or len(body) % 8 != 0:
+        raise ValueError("topk payload: bad mode or body size")
+    k = len(body) // 8
+    idx = np.frombuffer(body[: 4 * k], np.uint32)
+    vals = np.frombuffer(body[4 * k:], np.float32)
+    if k and (idx[-1] >= n or np.any(np.diff(idx.astype(np.int64)) <= 0)):
+        raise ValueError("topk payload: indices out of range or unsorted")
+    out = np.zeros(n, np.float32)
+    out[idx] = vals
+    return out
+
+
 def coordinate_median(stack: np.ndarray) -> np.ndarray:
     """np.median(stack, axis=0) for float32 [n_peers, D], threaded."""
     lib = get_lib()

@@ -26,7 +26,7 @@ import hashlib
 import random
 import time
 import uuid
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -77,10 +77,42 @@ class AveragerBase:
         method_kw: Optional[dict] = None,
         namespace: str = "",
         wire: str = "f32",
+        topk_frac: float = 0.01,
         adaptive_timeout: bool = False,
     ):
-        if wire not in ("f32", "bf16", "q8"):
+        if wire not in ("f32", "bf16", "q8", "topk"):
             raise ValueError(f"unknown wire dtype {wire!r}")
+        if wire == "topk":
+            # Top-k is a GRADIENT compressor for gather-style protocols:
+            # pairwise mixing (gossip/butterfly) compounds the truncation at
+            # every hop with no error feedback, and top-k of a parameter
+            # tree is meaningless (it would zero most of the model).
+            if self.mode not in ("sync", "byzantine"):
+                raise ValueError(
+                    f"wire='topk' is not supported for {self.mode} averaging "
+                    "(gather-style sync/byzantine only)"
+                )
+            if method != "mean":
+                # Coordinate-wise robust statistics over near-disjoint sparse
+                # supports collapse to ~zero (at most coordinates the values
+                # are {x, 0, 0, ...} and the median/trim keeps the zeros):
+                # training would silently stall. Only the weighted mean is
+                # sound over sparse contributions.
+                raise ValueError(
+                    f"wire='topk' requires method='mean' (got {method!r}): "
+                    "robust estimators over sparse supports aggregate to zero"
+                )
+            if not 0.0 < topk_frac <= 1.0:
+                raise ValueError(f"topk_frac must be in (0, 1], got {topk_frac}")
+        self.topk_frac = topk_frac
+        # Error-feedback residual (Deep Gradient Compression): entries a
+        # contribution drops are banked and added to the NEXT contribution,
+        # so every gradient coordinate eventually ships. The residual is
+        # committed only when the round SUCCEEDS (_commit_ef): committing at
+        # compression time would lose the shipped top-k mass forever on a
+        # failed round (the trainer falls back to its raw local grad).
+        self._ef_residual: Optional[np.ndarray] = None
+        self._ef_pending: Optional[np.ndarray] = None
         self.transport = transport
         self.dht = dht
         self.membership = membership
@@ -204,9 +236,10 @@ class AveragerBase:
             # being accepted on the receive path (e.g. a gossip push banked
             # into the wrong inbox). With the namespace folded in, every
             # averager's _check_schema rejects it at the door.
+            wire_tag = f"topk:{self.topk_frac}" if self.wire == "topk" else self.wire
             self._schema = hashlib.sha1(
                 repr(
-                    [(s.shape, s.dtype) for s in specs] + [self.wire, self.namespace]
+                    [(s.shape, s.dtype) for s in specs] + [wire_tag, self.namespace]
                 ).encode()
             ).hexdigest()[:16]
         return buf
@@ -225,7 +258,46 @@ class AveragerBase:
             return native.f32_to_bf16(buf).tobytes()
         if self.wire == "q8":
             return native.q8_encode(buf)
+        if self.wire == "topk":
+            # Auto mode: results/other sends keep their full support (or go
+            # dense); top-k TRUNCATION is only ever applied to contributions
+            # via _compress_contribution, where error feedback catches it.
+            return native.topk_encode(buf)
         return buf.tobytes()
+
+    def _compress_contribution(
+        self, buf: np.ndarray
+    ) -> Tuple[bytes, Callable[[], np.ndarray]]:
+        """(wire bytes, lazy dense-as-peers-see-it) for THIS round's
+        contribution.
+
+        For topk: add the error-feedback residual, keep the top k entries,
+        and stage the remainder as PENDING — the caller commits it via
+        ``_commit_ef(ok)`` once the round's outcome is known. For every other
+        codec this is (_to_wire, lazy decode of the same bytes); the dense
+        view is lazy because sync members never need it — only the leader
+        and the byzantine path stack their own contribution."""
+        if self.wire != "topk":
+            wire = self._to_wire(buf)
+            if self.wire == "f32":
+                return wire, lambda: buf
+            return wire, lambda: self._buf_from_payload(wire)
+        if self._ef_residual is not None and self._ef_residual.size == buf.size:
+            buf = buf + self._ef_residual
+        wire = native.topk_encode(buf, frac=self.topk_frac)
+        sent = native.topk_decode(wire)
+        self._ef_pending = buf - sent
+        return wire, lambda: sent
+
+    def _commit_ef(self, ok: bool) -> None:
+        """Resolve the staged error-feedback residual for the last
+        compressed contribution: on success the remainder is banked for the
+        next round; on failure the PREVIOUS residual stands (nothing was
+        delivered, and the trainer applies its raw local grad instead)."""
+        if self._ef_pending is not None:
+            if ok:
+                self._ef_residual = self._ef_pending
+            self._ef_pending = None
 
     def _wire_roundtrip(self, buf: np.ndarray) -> np.ndarray:
         """The local buffer as PEERS see it after the wire codec. Pairwise
@@ -237,6 +309,8 @@ class AveragerBase:
             return native.bf16_to_f32(native.f32_to_bf16(buf))
         if self.wire == "q8":
             return native.q8_decode(native.q8_encode(buf))
+        if self.wire == "topk":
+            return native.topk_decode(native.topk_encode(buf))
         return buf
 
     def _buf_from_payload(self, payload: bytes) -> np.ndarray:
@@ -244,6 +318,8 @@ class AveragerBase:
             return native.bf16_to_f32(np.frombuffer(payload, np.uint16))
         if self.wire == "q8":
             return native.q8_decode(payload)
+        if self.wire == "topk":
+            return native.topk_decode(payload)
         return np.frombuffer(payload, np.float32).copy()
 
     # -- public API --------------------------------------------------------
@@ -322,18 +398,23 @@ class SyncAverager(AveragerBase):
             self.rounds_skipped += 1
             return None
         buf = self._pack(tree)
+        # One compression per round, leader or member: the leader's own
+        # contribution enters the aggregate exactly as a peer would see it.
+        wire_bytes, sent = self._compress_contribution(buf)
         t0 = time.monotonic()
         self._round_degraded = False
         try:
             if group.my_index == 0:
-                result = await self._lead_round(group, buf, weight)
+                result = await self._lead_round(group, sent(), weight)
             else:
-                result = await self._member_round(group, buf, weight)
+                result = await self._member_round(group, weight, wire_bytes)
         except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
             log.info("sync round %d failed (%s); continuing local", round_no, e)
             self.rounds_skipped += 1
             self._observe_round_failure()
+            self._commit_ef(False)
             return None
+        self._commit_ef(result is not None)
         if result is None:
             self._observe_round_failure()
         elif not self._round_degraded:
@@ -402,7 +483,7 @@ class SyncAverager(AveragerBase):
             self._rounds.pop(group.epoch, None)
             raise
 
-    async def _member_round(self, group: Group, buf: np.ndarray, weight: float):
+    async def _member_round(self, group: Group, weight: float, wire_bytes: bytes):
         leader_addr = group.members[0][1]
         args = {
             "epoch": group.epoch,
@@ -412,7 +493,7 @@ class SyncAverager(AveragerBase):
             "token": group.token,
         }
         await self.transport.call(
-            leader_addr, "sync.contribute", args, self._to_wire(buf), timeout=self.effective_gather_timeout
+            leader_addr, "sync.contribute", args, wire_bytes, timeout=self.effective_gather_timeout
         )
         _, payload = await self.transport.call(
             leader_addr, "sync.fetch", {"epoch": group.epoch}, timeout=self.gather_timeout + 6.0
@@ -713,11 +794,12 @@ class ByzantineAverager(AveragerBase):
             self.rounds_skipped += 1
             return None
         buf = self._pack(tree)
+        wire_bytes, sent = self._compress_contribution(buf)
         st = self._rounds.get(group.epoch)
         if st is None:
             st = self._rounds[group.epoch] = _Round([])
         st.expected = set(pid for pid, _ in group.members)
-        st.contribs[self.peer_id] = (weight, buf)
+        st.contribs[self.peer_id] = (weight, sent())
         if set(st.contribs) >= st.expected:
             st.full.set()
 
@@ -731,7 +813,7 @@ class ByzantineAverager(AveragerBase):
         async def push(addr):
             try:
                 await self.transport.call(
-                    addr, "byz.contribute", args, self._to_wire(buf),
+                    addr, "byz.contribute", args, wire_bytes,
                     timeout=self.effective_gather_timeout,
                 )
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
@@ -755,7 +837,9 @@ class ByzantineAverager(AveragerBase):
         if len(received) < self.min_group:
             self.rounds_skipped += 1
             self._observe_round_failure()
+            self._commit_ef(False)
             return None
+        self._commit_ef(True)
         peers = sorted(received)
         stack = np.stack([received[p][1] for p in peers])
         kw = dict(self.method_kw)

@@ -54,7 +54,11 @@ class VolunteerConfig:
     # samples/sec at payload scale (BASELINE.md north-star).
     overlap: bool = True
     max_staleness: int = 0  # steps; 0 = unbounded (rounds self-bound via timeouts)
-    wire: str = "f32"  # f32|bf16|q8 — WAN payload codec (bf16 halves, q8 quarters DCN bytes)
+    wire: str = "f32"  # f32|bf16|q8|topk — WAN payload codec
+    # wire="topk" fraction: ship only the top |value| fraction of gradient
+    # entries per round (error feedback banks the rest). ~50x fewer DCN
+    # bytes at 0.01. Grads mode + sync/byzantine only.
+    topk_frac: float = 0.01
     min_group: int = 2
     max_group: int = 16
     batch_size: int = 32  # samples per optimizer step (across accum microbatches)
@@ -94,6 +98,19 @@ class VolunteerConfig:
     def __post_init__(self):
         if not self.peer_id:
             self.peer_id = f"vol-{uuid.uuid4().hex[:8]}"
+        if self.wire == "topk":
+            # Fail at config time, before the transport binds or membership
+            # announces anything. Top-k of a parameter tree would zero most
+            # of the model; pairwise protocols compound truncation per hop;
+            # robust estimators over sparse supports aggregate to zero.
+            if self.average_what != "grads":
+                raise ValueError("wire='topk' requires --average-what grads")
+            if self.averaging not in ("sync", "byzantine"):
+                raise ValueError(
+                    "wire='topk' requires --averaging sync or byzantine"
+                )
+            if self.averaging == "byzantine" and self.method != "mean":
+                raise ValueError("wire='topk' requires --method mean")
 
 
 class Volunteer:
@@ -177,9 +194,15 @@ class Volunteer:
                 join_timeout=self.cfg.join_timeout,
                 gather_timeout=self.cfg.gather_timeout,
                 wire=self.cfg.wire,
+                topk_frac=self.cfg.topk_frac,
                 adaptive_timeout=self.cfg.adaptive_timeout,
             )
-            if self.cfg.averaging == "byzantine" and self.cfg.method != "mean":
+            if self.cfg.averaging == "byzantine" and (
+                self.cfg.method != "mean" or self.cfg.wire == "topk"
+            ):
+                # Passing "mean" explicitly matters for topk: without it the
+                # ByzantineAverager defaults to trimmed_mean, which the topk
+                # wire (validated in __post_init__) must not run under.
                 kw["method"] = self.cfg.method
             # Namespace rounds by model AND by what is averaged: a grads-mode
             # peer must never rendezvous with a params-mode peer on the same

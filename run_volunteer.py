@@ -48,9 +48,15 @@ def main() -> None:
     ap.add_argument("--average-every", type=int, default=10)
     ap.add_argument("--average-what", default="params", choices=("params", "grads"),
                     help="params = local-SGD periodic averaging; grads = GradientAverager")
-    ap.add_argument("--wire", default="f32", choices=("f32", "bf16", "q8"),
+    ap.add_argument("--wire", default="f32", choices=("f32", "bf16", "q8", "topk"),
                     help="WAN payload codec; bf16 halves DCN traffic, q8 "
-                         "quarters it (chunked int8, <=0.4%% element error)")
+                         "quarters it (chunked int8, <=0.4%% element error), "
+                         "topk ships only the largest-magnitude gradient "
+                         "entries with error feedback (grads mode, "
+                         "sync/byzantine; ~50x fewer bytes at default frac)")
+    ap.add_argument("--topk-frac", type=float, default=0.01,
+                    help="fraction of gradient entries kept per round by "
+                         "--wire topk")
     ap.add_argument("--overlap", action=argparse.BooleanOptionalAction, default=True,
                     help="overlap WAN averaging rounds with local compute "
                          "(params mode; --no-overlap restores blocking rounds)")
@@ -122,6 +128,7 @@ def main() -> None:
         average_every=args.average_every,
         average_what=args.average_what,
         wire=args.wire,
+        topk_frac=args.topk_frac,
         overlap=args.overlap,
         max_staleness=args.max_staleness,
         min_group=args.min_group,

@@ -622,3 +622,31 @@ class TestAdaptiveTimeout:
                 await teardown(vols)
 
         run(main())
+
+
+class TestTopkScope:
+    def test_pairwise_modes_reject_topk(self):
+        """Top-k is gather-only: pairwise mixing would compound truncation
+        at every hop with no error feedback."""
+        async def main():
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=None)
+            mem = SwarmMembership(dht, "solo", ttl=10.0)
+            await mem.join()
+            try:
+                for cls in (GossipAverager, ButterflyAverager):
+                    with pytest.raises(ValueError, match="topk"):
+                        cls(t, dht, mem, wire="topk")
+            finally:
+                await t.close()
+
+        run(main())
+
+    def test_volunteer_config_rejects_topk_params_mode(self):
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        with pytest.raises(ValueError, match="grads"):
+            VolunteerConfig(wire="topk", average_what="params")
+        # grads mode is fine
+        VolunteerConfig(wire="topk", average_what="grads", averaging="sync")

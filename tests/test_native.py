@@ -236,3 +236,87 @@ class TestQ8Codec:
         assert ra is not None and rb is not None
         np.testing.assert_allclose(ra["w"], 2.0, rtol=2e-2)
         np.testing.assert_allclose(rb["b"]["x"], 4.0, rtol=2e-2)
+
+
+class TestTopkCodec:
+    def test_roundtrip_keeps_topk_zeros_rest(self):
+        arr = np.array([0.1, -5.0, 0.0, 3.0, -0.2, 1.0], np.float32)
+        dense = native.topk_decode(native.topk_encode(arr, frac=0.34))
+        # top 2 by |value|: -5.0 and 3.0 at their original positions
+        np.testing.assert_array_equal(
+            dense, np.array([0.0, -5.0, 0.0, 3.0, 0.0, 0.0], np.float32)
+        )
+
+    def test_explicit_frac_dense_fallback(self):
+        # frac where sparse coding (8 B/entry) would exceed dense f32:
+        # the encoder goes dense and the roundtrip is exact.
+        arr = np.random.default_rng(2).standard_normal(64).astype(np.float32)
+        enc = native.topk_encode(arr, frac=0.9)
+        assert len(enc) <= 12 + 4 * arr.size
+        np.testing.assert_array_equal(native.topk_decode(enc), arr)
+
+    def test_auto_mode_sparse_and_dense(self):
+        # Sparse result: few nonzeros -> sparse coding, exact
+        sparse = np.zeros(1000, np.float32)
+        sparse[[3, 500, 999]] = [1.0, -2.0, 3.0]
+        enc = native.topk_encode(sparse)
+        assert len(enc) < 4 * sparse.size  # actually smaller than dense f32
+        np.testing.assert_array_equal(native.topk_decode(enc), sparse)
+        # Dense-ish input -> dense mode, exact
+        dense = np.random.default_rng(0).standard_normal(1000).astype(np.float32)
+        enc2 = native.topk_encode(dense)
+        np.testing.assert_array_equal(native.topk_decode(enc2), dense)
+
+    def test_idempotent_roundtrip(self):
+        # wire-roundtrip of an already-truncated buffer is exact (pairwise
+        # and leader-side consistency relies on this, as for bf16/q8)
+        arr = np.random.default_rng(1).standard_normal(256).astype(np.float32)
+        once = native.topk_decode(native.topk_encode(arr, frac=0.1))
+        twice = native.topk_decode(native.topk_encode(once, frac=0.1))
+        np.testing.assert_array_equal(once, twice)
+
+    def test_nonfinite_zeroed(self):
+        arr = np.array([np.nan, np.inf, 1.0, -2.0], np.float32)
+        dense = native.topk_decode(native.topk_encode(arr, frac=0.5))
+        np.testing.assert_array_equal(dense, [0.0, 0.0, 1.0, -2.0])
+
+    def test_malformed_payloads_rejected(self):
+        good = native.topk_encode(np.ones(8, np.float32), frac=0.5)
+        with pytest.raises(ValueError):
+            native.topk_decode(b"XX" + good[2:])  # bad magic
+        with pytest.raises(ValueError):
+            native.topk_decode(good[:-3])  # truncated body
+        # out-of-range index
+        bad = bytearray(native.topk_encode(np.ones(4, np.float32), frac=0.25))
+        bad[12:16] = np.uint32(99).tobytes()
+        with pytest.raises(ValueError):
+            native.topk_decode(bytes(bad))
+
+    def test_topk_wire_end_to_end_with_error_feedback(self):
+        """Sync round over the topk wire, then a second round: entries
+        dropped by round 1's truncation ship in round 2 via the EF residual."""
+        from tests.test_averaging import make_tree, spawn_volunteers, teardown
+        from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+
+        async def main():
+            vols = await spawn_volunteers(2, SyncAverager, wire="topk", topk_frac=0.5)
+            try:
+                r1 = await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 1),
+                    vols[1][3].average(make_tree(3.0), 1),
+                )
+                resid = [v[3]._ef_residual for v in vols]
+                r2 = await asyncio.gather(
+                    vols[0][3].average(make_tree(0.0), 2),
+                    vols[1][3].average(make_tree(0.0), 2),
+                )
+                return r1, resid, r2
+            finally:
+                await teardown(vols)
+
+        (ra, rb), resid, (ra2, rb2) = asyncio.run(asyncio.wait_for(main(), timeout=60))
+        assert ra is not None and rb is not None
+        # each volunteer kept only half its entries; the residual banks the rest
+        assert all(r is not None and float(np.abs(r).sum()) > 0 for r in resid)
+        # round 2 contributes (0 + residual): the dropped mass still arrives
+        assert ra2 is not None and rb2 is not None
