@@ -70,6 +70,8 @@ class VolunteerConfig:
     init_seed: int = 0  # TASK-constant: shared initial params (see Trainer)
     steps: int = 1000
     target_loss: Optional[float] = None
+    eval_every: int = 0  # 0 = no held-out evaluation
+    eval_batches: int = 4
     metrics_path: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 200
@@ -270,6 +272,8 @@ class Volunteer:
             volunteer_id=self.cfg.peer_id,
             total_steps=self.cfg.steps,
             on_step=on_step,
+            eval_every=self.cfg.eval_every,
+            eval_batches=self.cfg.eval_batches,
         )
         if self.cfg.checkpoint_dir:
             from distributedvolunteercomputing_tpu.training.checkpoint import maybe_restore

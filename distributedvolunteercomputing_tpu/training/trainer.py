@@ -85,7 +85,17 @@ class Trainer:
         mesh: Optional[Any] = None,
         fsdp: bool = False,
         seq_sharded: bool = False,
+        # Periodic held-out evaluation: every ``eval_every`` steps, mean loss
+        # over ``eval_batches`` batches WITHOUT updating params, recorded as
+        # an "eval" metrics event. With synthetic data the eval stream is an
+        # independent rng stream (true held-out); with a custom ``data``
+        # iterable, eval consumes its next batches (loss-before-update on
+        # data the optimizer hasn't seen).
+        eval_every: int = 0,
+        eval_batches: int = 4,
     ):
+        if eval_every and eval_batches < 1:
+            raise ValueError(f"eval_batches must be >= 1, got {eval_batches}")
         if average_what not in ("params", "grads"):
             raise ValueError(f"unknown average_what {average_what!r}")
         if accum_steps < 1 or batch_size % accum_steps != 0:
@@ -172,6 +182,13 @@ class Trainer:
             )
         self._data_rng = data_rng
         self._data = data
+        self.eval_every = eval_every
+        self.eval_batches = eval_batches
+        self._eval_fn = None
+        self._it: Optional[Any] = None
+        # Held-out stream: a distinct fold of the volunteer seed, so eval
+        # batches never collide with any training batch at any seed.
+        self._eval_rng = jax.random.fold_in(data_rng, 0x5EED)
         self.metrics = MetricsWriter(metrics_path, volunteer_id)
         self.on_step = on_step
         # Host-side (step, params) snapshot for concurrent readers (the
@@ -238,6 +255,34 @@ class Trainer:
         )
         self.mutation_counter += 1
         self._take_snapshot(step_no)
+
+    def evaluate(self, n_batches: Optional[int] = None) -> float:
+        """Mean held-out loss over ``n_batches`` without updating params.
+        Safe between steps (the jitted step donates buffers DURING a step,
+        but params are live again once it returns)."""
+        if self._eval_fn is None:
+            from distributedvolunteercomputing_tpu.training.steps import make_eval_step
+
+            self._eval_fn = make_eval_step(self.bundle.loss_fn)
+        n = self.eval_batches if n_batches is None else n_batches
+        if n < 1:
+            raise ValueError(f"evaluate() needs n_batches >= 1, got {n}")
+        rng = self._eval_rng
+        total = 0.0
+        for _ in range(n):
+            if self._data is not None:
+                if self._it is None:  # standalone use before run()
+                    self._it = iter(self._data)
+                batch = next(self._it)
+            else:
+                rng, k = jax.random.split(rng)
+                batch = self.bundle.make_batch(k, self.batch_size)
+            if self._put_batch is not None:
+                batch = self._put_batch(batch)
+            rng, ek = jax.random.split(rng)
+            total += float(self._eval_fn(self.state.params, batch, ek)["loss"])
+        self._eval_rng = rng
+        return total / n
 
     def _run_average_round(self, tree: Any, step_no: int, what: str) -> Optional[Any]:
         """One WAN round: select payload -> averager -> record -> merge.
@@ -328,6 +373,7 @@ class Trainer:
     ) -> Dict[str, float]:
         """Train for ``steps`` (or until ``target_loss``); returns summary."""
         it = iter(self._data) if self._data is not None else iter(self.data_iter())
+        self._it = it  # evaluate() draws from the same iterator for custom data
         # Tracing hook (SURVEY.md §5): DVC_PROFILE_DIR=<dir> captures a
         # jax.profiler trace of steps [DVC_PROFILE_START, +DVC_PROFILE_STEPS)
         # — past warmup/compile, so the trace shows steady-state step time
@@ -384,6 +430,14 @@ class Trainer:
                 self.metrics.record(step_no, m, n_samples=self.batch_size)
             else:
                 self.metrics.count_samples(self.batch_size)
+
+            if self.eval_every and step_no % self.eval_every == 0:
+                ev = self.evaluate()
+                self.metrics.record_event(
+                    step_no, "eval",
+                    {"eval_loss": ev, "n_batches": self.eval_batches},
+                )
+                log.info("step %d eval_loss %.4f", step_no, ev)
 
             if self.averager is not None and not self._grads_mode:
                 if self.overlap:

@@ -98,6 +98,11 @@ def main() -> None:
                          "across the swarm (for LoRA it pins the shared frozen base)")
     ap.add_argument("--steps", type=int, default=1000)
     ap.add_argument("--target-loss", type=float, default=None)
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="held-out eval cadence in steps (0 = off); mean "
+                         "loss over --eval-batches recorded as an 'eval' "
+                         "metrics event")
+    ap.add_argument("--eval-batches", type=int, default=4)
     ap.add_argument("--metrics", default=None)
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--join-timeout", type=float, default=10.0)
@@ -147,6 +152,8 @@ def main() -> None:
         init_seed=args.init_seed,
         steps=args.steps,
         target_loss=args.target_loss,
+        eval_every=args.eval_every,
+        eval_batches=args.eval_batches,
         metrics_path=args.metrics,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,

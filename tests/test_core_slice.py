@@ -93,6 +93,38 @@ class TestTrainerLocalSGD:
         summary = t.run(steps=500, target_loss=10.0, log_every=0)  # trivially satisfied
         assert summary["steps"] == 1
 
+    def test_eval_hook_records_held_out_loss(self, tmp_path):
+        """eval_every: periodic held-out loss without updating params —
+        recorded as 'eval' metrics events, params untouched by eval."""
+        import json
+
+        mpath = str(tmp_path / "m.jsonl")
+        t = Trainer(
+            get_model("mnist_mlp"), batch_size=32, lr=1e-2, optimizer="adam",
+            seed=0, metrics_path=mpath, eval_every=5, eval_batches=2,
+        )
+        before_eval = t.evaluate()  # public API works standalone
+        assert np.isfinite(before_eval)
+        summary = t.run(steps=10, log_every=0)
+        events = [
+            json.loads(l) for l in open(mpath)
+            if '"eval"' in l and "eval_loss" in l
+        ]
+        assert len(events) == 2  # steps 5 and 10
+        losses = [e["eval_loss"] for e in events]
+        assert all(np.isfinite(v) for v in losses)
+        # training reduces held-out loss on the synthetic blobs task
+        assert losses[-1] < before_eval
+        # eval stream is held-out: a fresh trainer's eval batches differ from
+        # its training batches (different fold of the seed)
+        t2 = Trainer(get_model("mnist_mlp"), batch_size=4, seed=3)
+        train_batch = next(iter(t2.data_iter()))
+        import jax as _jax
+
+        rng, k = _jax.random.split(t2._eval_rng)
+        eval_batch = t2.bundle.make_batch(k, 4)
+        assert not np.array_equal(np.asarray(train_batch["x"]), np.asarray(eval_batch["x"]))
+
     def test_init_seed_pins_shared_base_across_volunteer_seeds(self):
         # Config-5 semantics (BASELINE.json:11): every volunteer finetunes ONE
         # shared base, so different per-volunteer --seed values must still

@@ -436,6 +436,20 @@ class TestTrainerOnMesh:
             with pytest.raises(ValueError, match="mesh spec"):
                 parse_mesh_spec(bad)
 
+    def test_evaluate_under_fsdp(self, eight_devices):
+        # evaluate() on a ZeRO-3-sharded trainer: jit respects the params'
+        # input shardings (the fsdp hazard is OUTPUT state drift, which eval
+        # has none of) — must run and leave the params sharded.
+        from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+        bundle = get_model("gpt2_small", **TINY_GPT2)
+        mesh = make_mesh(dp=2, tp=4)
+        t = Trainer(bundle, batch_size=8, mesh=mesh, fsdp=True, eval_every=2, eval_batches=2)
+        ev = t.evaluate()
+        assert np.isfinite(ev)
+        t.run(steps=2, log_every=0)
+        assert t.state.params["blocks"]["qkv"]["w"].sharding.spec == P("dp", None, "tp")
+
     def test_adopt_params_keeps_mesh_placement(self, eight_devices):
         from distributedvolunteercomputing_tpu.training.trainer import Trainer
 
