@@ -95,6 +95,11 @@ class Transport:
             )
         self._server: Optional[asyncio.base_events.Server] = None
         self._handlers: Dict[str, Handler] = {}
+        # WAN accounting (frame headers + meta + payload, both directions):
+        # the evidence behind wire-codec claims — experiments read these off
+        # the volunteer summary instead of estimating.
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
     @property
     def addr(self) -> Addr:
@@ -139,6 +144,7 @@ class Transport:
         writer.write(_HEADER.pack(MAGIC, VERSION, ftype, len(meta_b), len(payload), crc))
         writer.write(meta_b)
         writer.write(payload)
+        self.bytes_sent += _HEADER.size + len(meta_b) + len(payload)
         await writer.drain()
 
     async def _read_frame(self, reader: asyncio.StreamReader) -> Tuple[int, dict, bytes]:
@@ -152,6 +158,7 @@ class Transport:
             raise RPCError(f"meta {meta_len} exceeds {MAX_META}")
         meta = json.loads(await reader.readexactly(meta_len)) if meta_len else {}
         payload = await reader.readexactly(payload_len) if payload_len else b""
+        self.bytes_received += _HEADER.size + meta_len + payload_len
         if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
             raise RPCError("payload CRC mismatch (corrupt frame)")
         if self._secret is not None:

@@ -391,6 +391,10 @@ class Volunteer:
             self.summary = await asyncio.to_thread(self._train_blocking)
             if self.averager is not None:
                 self.summary.update(self.averager.stats())
+            # WAN accounting: every byte this volunteer moved over DCN
+            # (averaging payloads dominate; DHT/heartbeat traffic is noise).
+            self.summary["wan_bytes_sent"] = self.transport.bytes_sent
+            self.summary["wan_bytes_received"] = self.transport.bytes_received
             return self.summary
         finally:
             self._stop.set()

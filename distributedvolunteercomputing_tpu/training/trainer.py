@@ -269,20 +269,45 @@ class Trainer:
             raise ValueError(f"evaluate() needs n_batches >= 1, got {n}")
         rng = self._eval_rng
         total = 0.0
+        done = 0
         for _ in range(n):
             if self._data is not None:
                 if self._it is None:  # standalone use before run()
                     self._it = iter(self._data)
-                batch = next(self._it)
+                try:
+                    batch = next(self._it)
+                except StopIteration:
+                    # Finite dataset exhausted: evaluate on what we got
+                    # rather than killing a training run that was sized
+                    # without eval's extra draws in mind.
+                    break
             else:
                 rng, k = jax.random.split(rng)
                 batch = self.bundle.make_batch(k, self.batch_size)
             if self._put_batch is not None:
                 batch = self._put_batch(batch)
             rng, ek = jax.random.split(rng)
-            total += float(self._eval_fn(self.state.params, batch, ek)["loss"])
+            # Honor accum_steps: training fits memory by microbatching
+            # inside the compiled step, so eval must not allocate the
+            # whole-batch activation footprint in one forward.
+            if self.accum_steps > 1:
+                micro = jax.tree_util.tree_map(
+                    lambda x: x.reshape(
+                        (self.accum_steps, x.shape[0] // self.accum_steps) + x.shape[1:]
+                    ),
+                    batch,
+                )
+                losses = []
+                for i in range(self.accum_steps):
+                    mb = jax.tree_util.tree_map(lambda x: x[i], micro)
+                    rng, mk = jax.random.split(rng)
+                    losses.append(float(self._eval_fn(self.state.params, mb, mk)["loss"]))
+                total += sum(losses) / len(losses)
+            else:
+                total += float(self._eval_fn(self.state.params, batch, ek)["loss"])
+            done += 1
         self._eval_rng = rng
-        return total / n
+        return total / done if done else float("nan")
 
     def _run_average_round(self, tree: Any, step_no: int, what: str) -> Optional[Any]:
         """One WAN round: select payload -> averager -> record -> merge.
@@ -433,11 +458,12 @@ class Trainer:
 
             if self.eval_every and step_no % self.eval_every == 0:
                 ev = self.evaluate()
-                self.metrics.record_event(
-                    step_no, "eval",
-                    {"eval_loss": ev, "n_batches": self.eval_batches},
-                )
-                log.info("step %d eval_loss %.4f", step_no, ev)
+                if ev == ev:  # nan = finite dataset exhausted; nothing to record
+                    self.metrics.record_event(
+                        step_no, "eval",
+                        {"eval_loss": ev, "n_batches": self.eval_batches},
+                    )
+                    log.info("step %d eval_loss %.4f", step_no, ev)
 
             if self.averager is not None and not self._grads_mode:
                 if self.overlap:

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Measure WAN bytes per averaging round for every wire codec.
+
+2-volunteer grads-mode sync swarm (GradientAverager semantics: one round
+per step) for each of f32 / bf16 / q8 / topk, using the transport's own
+byte counters (volunteer summary wan_bytes_*), NOT an estimate. Writes
+experiments/results/wire_bytes.jsonl and prints a table; BASELINE.md cites
+the resulting bytes-per-round ratios.
+
+Run: python experiments/wire_bytes.py [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from run_matrix import RESULTS, record, run_swarm  # noqa: E402
+
+# Proxy model with enough params (~52k) that payload dominates frame
+# overhead; d_hidden=64 -> mnist mlp 28*28*64 + 64*10 weights.
+MODEL = ["--model", "mnist_mlp", "--model-override", "d_hidden=64"]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    rows_by_wire = {}
+    for wire in ("f32", "bf16", "q8", "topk"):
+        common = MODEL + [
+            "--averaging", "sync", "--average-what", "grads", "--wire", wire,
+            "--steps", str(args.steps), "--batch-size", "8",
+            "--join-timeout", "20", "--gather-timeout", "20",
+        ]
+        if wire == "topk":
+            common += ["--topk-frac", "0.01"]
+        rows = run_swarm(
+            f"wire_{wire}",
+            [(f"{wire}-a", common + ["--seed", "0"]),
+             (f"{wire}-b", common + ["--seed", "1"])],
+            timeout=240,
+        )
+        summaries = [s for _, s, _ in rows if s]
+        rounds = sum(s["rounds_ok"] for s in summaries) or 1
+        sent = sum(s["wan_bytes_sent"] for s in summaries)
+        rows_by_wire[wire] = {
+            "bytes_sent_total": sent,
+            "rounds_ok_total": rounds,
+            "bytes_per_round_per_volunteer": sent / rounds,
+            "final_loss_mean": sum(s["final_loss"] for s in summaries) / len(summaries),
+        }
+        record(f"wire_{wire}", rows, extra=rows_by_wire[wire])
+        print(f"[wire_{wire}] {json.dumps(rows_by_wire[wire])}", flush=True)
+
+    base = rows_by_wire["f32"]["bytes_per_round_per_volunteer"]
+    table = {
+        w: {
+            **d,
+            "vs_f32": round(d["bytes_per_round_per_volunteer"] / base, 4),
+        }
+        for w, d in rows_by_wire.items()
+    }
+    out = os.path.join(RESULTS, "wire_bytes.jsonl")
+    with open(out, "w") as fh:
+        for w, d in table.items():
+            fh.write(json.dumps({"wire": w, **d}) + "\n")
+    print(json.dumps(table, indent=2))
+
+
+if __name__ == "__main__":
+    main()
