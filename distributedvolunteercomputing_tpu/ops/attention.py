@@ -18,18 +18,23 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-# Active sequence-parallel context: (mesh, axis_name) or None. When set, the
-# attention core routes to ring attention (parallel/ring_attention.py) so the
+# Active sequence-parallel context: (mesh, axis_name, impl) or None. When
+# set, the attention core routes to the chosen SP implementation so the
 # model code is unchanged between single-device and sp-sharded runs. Set by
 # make_sharded_train_step at TRACE time (it wraps the step body), or manually.
+# impl: "ring" (K/V rotate via ppermute — works for any head count, memory
+# O(T/sp) per device) or "ulysses" (all-to-all swaps seq<->heads around a
+# full-sequence attention — fewer collective hops on ICI; needs H % sp == 0).
 _seq_ctx = None
 
 
 @contextlib.contextmanager
-def sequence_parallel(mesh, axis: str = "sp"):
+def sequence_parallel(mesh, axis: str = "sp", impl: str = "ring"):
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel impl {impl!r}")
     global _seq_ctx
     prev = _seq_ctx
-    _seq_ctx = (mesh, axis)
+    _seq_ctx = (mesh, axis, impl)
     try:
         yield
     finally:
@@ -81,10 +86,29 @@ def attention_core(
     mask: Optional[jax.Array] = None,  # [B, 1|H, Tq, Tk] additive-able bool
 ) -> jax.Array:
     if _seq_ctx is not None and mask is None and q.shape[-2] == k.shape[-2]:
+        mesh, axis, impl = _seq_ctx
+        if impl == "ulysses":
+            from distributedvolunteercomputing_tpu.parallel.ulysses import (
+                ulysses_attention_bhtd,
+            )
+
+            return ulysses_attention_bhtd(q, k, v, mesh, axis, causal)
         from distributedvolunteercomputing_tpu.parallel.ring_attention import ring_attention_bhtd
 
-        mesh, axis = _seq_ctx
         return ring_attention_bhtd(q, k, v, mesh, axis, causal)
+    return attention_core_local(q, k, v, causal, mask)
+
+
+def attention_core_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The single-device core (flash kernel or fused XLA), with no
+    sequence-parallel routing — also the inner attention the Ulysses path
+    runs per head-group after its all-to-all."""
     if _route_to_flash(q, k, causal, mask):
         from distributedvolunteercomputing_tpu.ops.pallas_attention import flash_attention
 

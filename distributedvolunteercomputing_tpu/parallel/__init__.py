@@ -29,6 +29,10 @@ from distributedvolunteercomputing_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_bhtd,
 )
+from distributedvolunteercomputing_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_bhtd,
+)
 from distributedvolunteercomputing_tpu.parallel.train_step import (
     make_sharded_train_step,
     shard_train_state,
@@ -45,5 +49,7 @@ __all__ = [
     "shard_train_state",
     "ring_attention",
     "ring_attention_bhtd",
+    "ulysses_attention",
+    "ulysses_attention_bhtd",
     "pipeline_trunk",
 ]

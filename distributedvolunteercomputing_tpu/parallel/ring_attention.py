@@ -97,6 +97,22 @@ def ring_attention(
     return (acc / l_safe).astype(q.dtype)
 
 
+def sp_shard_map(inner, mesh: Mesh, axis: str):
+    """The one shard_map wrapper every SP implementation uses: [B, H, T, D]
+    with T sharded over ``axis``, manual over ``axis`` only, every other
+    mesh axis automatic (GSPMD). Shared by ring and ulysses so the two
+    impls can't diverge on the wrapping."""
+    spec = P(None, None, axis, None)
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis},
+        check_vma=False,
+    )
+
+
 def ring_attention_bhtd(
     q: jax.Array,  # [B, H, T, D] global; T sharded over ``axis``
     k: jax.Array,
@@ -105,15 +121,8 @@ def ring_attention_bhtd(
     axis: str = "sp",
     causal: bool = False,
 ) -> jax.Array:
-    """shard_map'd ring attention on head-split arrays; manual over ``axis``
-    only, every other mesh axis stays automatic (GSPMD)."""
-    spec = P(None, None, axis, None)
-    inner = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        axis_names={axis},
-        check_vma=False,
+    """shard_map'd ring attention on head-split arrays."""
+    inner = sp_shard_map(
+        functools.partial(ring_attention, axis_name=axis, causal=causal), mesh, axis
     )
     return inner(q, k, v)

@@ -127,6 +127,7 @@ def make_sharded_train_step(
     accum_steps: int = 1,
     zero1: bool = False,
     fsdp: bool = False,
+    sp_impl: str = "ring",
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Build the jitted sharded ``(state, batch) -> (state, metrics)`` step.
 
@@ -139,7 +140,9 @@ def make_sharded_train_step(
 
     With ``seq_sharded_batch`` and an ``sp`` mesh axis of size > 1, the step
     body is traced under the sequence-parallel context, so every attention in
-    the model routes to ring attention (parallel/ring_attention.py) over sp.
+    the model routes to the chosen SP implementation over sp: ``sp_impl`` =
+    "ring" (parallel/ring_attention.py, any head count) or "ulysses"
+    (parallel/ulysses.py, all-to-all seq<->heads; needs n_heads % sp == 0).
 
     With ``zero1`` (state sharded via ``shard_train_state(..., zero1=True)``),
     the updated optimizer moments are constrained back to their dp-sharded
@@ -183,7 +186,7 @@ def make_sharded_train_step(
             # Context is consulted at trace time — this body IS the trace.
             from distributedvolunteercomputing_tpu.ops.attention import sequence_parallel
 
-            with sequence_parallel(mesh, "sp"):
+            with sequence_parallel(mesh, "sp", impl=sp_impl):
                 new_state, metrics = train_step_body(loss_fn, tx, state, batch, accum_steps)
         else:
             new_state, metrics = train_step_body(loss_fn, tx, state, batch, accum_steps)

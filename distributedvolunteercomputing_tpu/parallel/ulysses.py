@@ -1,0 +1,85 @@
+"""Ulysses-style sequence parallelism: all-to-all swaps seq <-> heads.
+
+The second sequence-parallel implementation next to ring attention
+(parallel/ring_attention.py). Where the ring rotates K/V chunks sp-1 times
+(sp-1 ppermute hops, online-softmax merges per hop), Ulysses pays exactly
+TWO all-to-alls per attention: one to trade the sequence sharding for a
+head sharding (each device then holds H/sp heads of the FULL sequence),
+one to trade back after a completely ordinary full-sequence attention —
+which on TPU means the pallas flash kernel runs unmodified per head group,
+and the collectives are the all-to-alls ICI is built for.
+
+Trade-offs vs the ring (why both exist):
+- Ulysses needs ``n_heads % sp == 0``; the ring works for any head count.
+- Ulysses holds full-sequence activations for its head group: per-device
+  attention memory is O(H/sp * T) vs the ring's O(H * T/sp) — same total,
+  but the ring also never materializes more than a [Tl, Tl] score block
+  while Ulysses leans on the flash kernel for that.
+- Ring = sp-1 neighbor hops; Ulysses = 2 global all-to-alls. On a real ICI
+  torus the all-to-alls win at moderate sp; the ring wins at very large sp.
+
+All ops are differentiable JAX primitives (all_to_all has a transpose
+rule), so backward needs no custom VJP. Causal masking is exact: the inner
+attention sees the full, correctly ordered sequence.
+
+Reference note: the reference genre is volunteer data-parallel only
+(SURVEY.md §2); this module is build-side long-context work, prescribed by
+the task brief ("ring attention or all-to-all sequence/context
+parallelism").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, H, Tl, D] — the local sequence shard
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention via seq<->head all-to-alls; call INSIDE shard_map
+    over ``axis_name``."""
+    from distributedvolunteercomputing_tpu.ops.attention import attention_core_local
+
+    sp = jax.lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % sp != 0:
+        raise ValueError(
+            f"ulysses sequence parallelism needs n_heads % sp == 0 "
+            f"(H={h}, sp={sp}); use the ring impl for this config"
+        )
+
+    def seq_to_heads(x):  # [B, H, Tl, D] -> [B, H/sp, T, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def heads_to_seq(x):  # [B, H/sp, T, D] -> [B, H, Tl, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    out = attention_core_local(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal
+    )
+    return heads_to_seq(out)
+
+
+def ulysses_attention_bhtd(
+    q: jax.Array,  # [B, H, T, D] global; T sharded over ``axis``
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+) -> jax.Array:
+    """shard_map'd Ulysses attention — same wrapper as ring_attention_bhtd
+    (ring_attention.sp_shard_map)."""
+    from distributedvolunteercomputing_tpu.parallel.ring_attention import sp_shard_map
+
+    inner = sp_shard_map(
+        functools.partial(ulysses_attention, axis_name=axis, causal=causal), mesh, axis
+    )
+    return inner(q, k, v)

@@ -90,6 +90,7 @@ class VolunteerConfig:
     mesh: str = ""
     fsdp: bool = False
     seq_sharded: bool = False
+    sp_impl: str = "ring"  # ring | ulysses (all-to-all seq<->heads)
     # Shared-secret frame authentication (transport-level HMAC): path to a
     # file holding the swarm secret. Every member (coordinator included)
     # must use the same secret; peers without it can't join, spoof
@@ -257,6 +258,7 @@ class Volunteer:
             mesh=mesh,
             fsdp=self.cfg.fsdp,
             seq_sharded=self.cfg.seq_sharded,
+            sp_impl=self.cfg.sp_impl,
             batch_size=self.cfg.batch_size,
             optimizer=self.cfg.optimizer,
             lr=self.cfg.lr,

@@ -85,6 +85,7 @@ class Trainer:
         mesh: Optional[Any] = None,
         fsdp: bool = False,
         seq_sharded: bool = False,
+        sp_impl: str = "ring",  # "ring" | "ulysses" (all-to-all; H % sp == 0)
         # Periodic held-out evaluation: every ``eval_every`` steps, mean loss
         # over ``eval_batches`` batches WITHOUT updating params, recorded as
         # an "eval" metrics event. With synthetic data the eval stream is an
@@ -174,7 +175,7 @@ class Trainer:
 
             self._step_fn = make_sharded_train_step(
                 bundle.loss_fn, self.tx, mesh, accum_steps=accum_steps,
-                seq_sharded_batch=seq_sharded, fsdp=fsdp,
+                seq_sharded_batch=seq_sharded, fsdp=fsdp, sp_impl=sp_impl,
             )
         else:
             self._step_fn = make_train_step(

@@ -82,7 +82,11 @@ def main() -> None:
                          "axis (weights, grads, opt state at 1/dp per chip)")
     ap.add_argument("--seq-sharded", action="store_true",
                     help="shard the sequence dim over the mesh's sp axis "
-                         "(ring attention; long-context path)")
+                         "(long-context path)")
+    ap.add_argument("--sp-impl", default="ring", choices=("ring", "ulysses"),
+                    help="sequence-parallel implementation: ring (ppermute "
+                         "K/V rotation, any head count) or ulysses "
+                         "(all-to-all seq<->heads; needs n_heads %% sp == 0)")
     ap.add_argument("--secret-file", default=None,
                     help="file holding the shared swarm secret; enables "
                          "HMAC frame authentication (must match the "
@@ -144,6 +148,7 @@ def main() -> None:
         mesh=args.mesh,
         fsdp=args.fsdp,
         seq_sharded=args.seq_sharded,
+        sp_impl=args.sp_impl,
         secret_file=args.secret_file,
         data_path=args.data,
         optimizer=args.optimizer,
