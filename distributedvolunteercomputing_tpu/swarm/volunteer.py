@@ -41,7 +41,9 @@ log = get_logger(__name__)
 class VolunteerConfig:
     model: str = "mnist_mlp"
     model_overrides: Dict[str, Any] = dataclasses.field(default_factory=dict)
-    coordinator: Optional[str] = None  # "host:port"; None = run standalone
+    # "host:port[,host:port...]" — several = several DHT bootstrap nodes
+    # (join works while ANY is alive); None = run standalone.
+    coordinator: Optional[str] = None
     host: str = "127.0.0.1"
     port: int = 0
     advertise_host: Optional[str] = None  # dialable address when binding 0.0.0.0
@@ -116,6 +118,26 @@ class VolunteerConfig:
                 raise ValueError("wire='topk' requires --method mean")
 
 
+def _parse_addrs(spec: Optional[str]) -> list:
+    """``host:port[,host:port...]`` -> [(host, port), ...]. Several
+    coordinators = several DHT bootstrap nodes: a volunteer can join (and a
+    rejoiner can re-bootstrap) as long as ANY of them is alive."""
+    if not spec:
+        return []
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"bad coordinator address {part!r} in {spec!r}: expected host:port"
+            )
+        out.append((host, int(port)))
+    return out
+
+
 class Volunteer:
     def __init__(self, cfg: VolunteerConfig):
         self.cfg = cfg
@@ -172,10 +194,7 @@ class Volunteer:
         # DVC_ASYNC_DEBUG=1: loop stall/race detectors (stopped at teardown)
         self._loop_monitor = maybe_enable_from_env()
         await self.transport.start()
-        bootstrap = None
-        if self.cfg.coordinator:
-            host, port = self.cfg.coordinator.rsplit(":", 1)
-            bootstrap = [(host, int(port))]
+        bootstrap = _parse_addrs(self.cfg.coordinator) or None
         await self.dht.start(bootstrap=bootstrap)
         self.membership = SwarmMembership(
             self.dht, self.cfg.peer_id, ttl=self.cfg.heartbeat_ttl,
@@ -319,10 +338,8 @@ class Volunteer:
         )
 
     async def _report_loop(self) -> None:
-        caddr = None
-        if self.cfg.coordinator:
-            host, port = self.cfg.coordinator.rsplit(":", 1)
-            caddr = (host, int(port))
+        caddrs = _parse_addrs(self.cfg.coordinator)
+        caddr = caddrs[0] if caddrs else None
         while not self._stop.is_set():
             await asyncio.sleep(5.0)
             if self.state_sync is not None:
@@ -335,21 +352,26 @@ class Volunteer:
             if caddr is None:
                 continue
             try:
-                await self.transport.call(
-                    caddr,
-                    "coord.report",
-                    {
-                        "peer": self.cfg.peer_id,
-                        "step": int(self.trainer.state.step) if self.trainer else 0,
-                        "samples_per_sec": self.trainer.metrics.samples_per_sec()
-                        if self.trainer
-                        else 0.0,
-                        **{k: v for k, v in self.summary.items()},
-                    },
-                    timeout=5.0,
-                )
+                # Built INSIDE the try: reading trainer.state from this
+                # thread can hit a donated (deleted) buffer mid-step on a
+                # real accelerator — that must skip one report, not kill
+                # the loop (which also carries the announce() refresh).
+                report = {
+                    "peer": self.cfg.peer_id,
+                    "step": int(self.trainer.state.step) if self.trainer else 0,
+                    "samples_per_sec": self.trainer.metrics.samples_per_sec()
+                    if self.trainer
+                    else 0.0,
+                    **{k: v for k, v in self.summary.items()},
+                }
+                await self.transport.call(caddr, "coord.report", report, timeout=5.0)
             except Exception:
-                pass  # coordinator reachability is not correctness-critical
+                # Coordinator reachability is not correctness-critical; with
+                # several bootstrap coordinators, rotate to the next one so
+                # metrics survive a coordinator death.
+                if len(caddrs) > 1:
+                    caddrs = caddrs[1:] + caddrs[:1]
+                    caddr = caddrs[0]
 
     def _train_blocking(self) -> Dict[str, float]:
         assert self.trainer is not None

@@ -36,7 +36,10 @@ def main() -> None:
     ap.add_argument("--model", default="mnist_mlp")
     ap.add_argument("--model-override", action="append", default=[],
                     help="key=value config override (repeatable), e.g. d_model=128")
-    ap.add_argument("--coordinator", default=None, help="host:port of the coordinator")
+    ap.add_argument("--coordinator", default=None,
+                    help="coordinator address(es), host:port[,host:port...] — "
+                         "several = several DHT bootstrap nodes; joining works "
+                         "while ANY is alive")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--advertise-host", default=None,

@@ -171,6 +171,33 @@ class TestSwarmE2E:
         finally:
             coord.kill()
 
+    def test_multi_coordinator_bootstrap_survives_dead_first(self):
+        """--coordinator addr1,addr2: volunteers join through the SECOND
+        coordinator when the first is already dead — coordinator death must
+        not strand rejoining volunteers."""
+        from distributedvolunteercomputing_tpu.swarm.volunteer import _parse_addrs
+
+        assert _parse_addrs("h1:1,h2:2") == [("h1", 1), ("h2", 2)]
+        assert _parse_addrs(None) == []
+        with pytest.raises(ValueError, match="host:port"):
+            _parse_addrs("nocolon")
+
+        coord, addr = start_coordinator()
+        try:
+            # dead-first: a port nothing listens on, then the live one
+            both = f"127.0.0.1:1,{addr}"
+            common = [
+                "--averaging", "sync", "--average-every", "8", "--steps", "24",
+                "--join-timeout", "25", "--gather-timeout", "25",
+            ]
+            v0 = start_volunteer(both, "mc0", common + ["--seed", "0"])
+            v1 = start_volunteer(both, "mc1", common + ["--seed", "1"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            assert s0["rounds_ok"] + s1["rounds_ok"] >= 1, out0 + out1
+        finally:
+            coord.kill()
+
     def test_swarm_secret_locks_out_intruder(self, tmp_path):
         """--secret-file end-to-end: secret-holding volunteers average
         normally; a volunteer WITHOUT the secret cannot participate (its
