@@ -32,6 +32,46 @@ def _state_to_pytree(trainer) -> dict:
     }
 
 
+# Snapshots kept per directory after a save (DVC_CKPT_KEEP overrides).
+# Periodic saves otherwise grow without bound: at gpt2_small scale each
+# snapshot is ~1.5 GB (params + AdamW moments), and a long volunteer run
+# with --checkpoint-every 200 would fill the disk.
+def _keep_last() -> int:
+    raw = os.environ.get("DVC_CKPT_KEEP", "3")
+    try:
+        return int(raw)
+    except ValueError:
+        log.warning("DVC_CKPT_KEEP=%r is not an integer; keeping 3", raw)
+        return 3
+
+
+KEEP_LAST = _keep_last()
+
+
+def _gc(ckpt_dir: str, just_saved: int, keep: int = 0) -> None:
+    """Delete all but the newest ``keep`` snapshots (by step number), never
+    touching steps >= the snapshot just written — stale HIGHER-step entries
+    (a reused directory, a second volunteer lagging behind) must not make GC
+    eat the save that just happened."""
+    keep = keep or KEEP_LAST
+    if not os.path.isdir(ckpt_dir) or keep <= 0:
+        return
+    import shutil
+
+    steps = sorted(
+        int(m.group(1)) for name in os.listdir(ckpt_dir) if (m := _STEP_RE.match(name))
+    )
+    for step in steps[:-keep]:
+        if step >= just_saved:
+            continue
+        path = os.path.join(ckpt_dir, f"step_{step}")
+        try:
+            shutil.rmtree(path)
+            log.info("checkpoint GC: removed %s", path)
+        except OSError as e:
+            log.warning("checkpoint GC failed for %s: %s", path, e)
+
+
 def save(trainer, ckpt_dir: str) -> str:
     import orbax.checkpoint as ocp
 
@@ -40,6 +80,7 @@ def save(trainer, ckpt_dir: str) -> str:
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, _state_to_pytree(trainer), force=True)
     log.info("checkpoint saved: %s", path)
+    _gc(ckpt_dir, just_saved=step)
     return path
 
 
@@ -77,6 +118,7 @@ def save_async(trainer, ckpt_dir: str) -> bool:
             with ocp.PyTreeCheckpointer() as ckptr:
                 ckptr.save(path, host_tree, force=True)
             log.info("checkpoint saved (async): %s", path)
+            _gc(ckpt_dir, just_saved=step)
         except Exception as e:  # noqa: BLE001 — a failed periodic save must not kill training
             log.warning("async checkpoint save failed: %s", e)
 

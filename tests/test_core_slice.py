@@ -93,6 +93,33 @@ class TestTrainerLocalSGD:
         summary = t.run(steps=500, target_loss=10.0, log_every=0)  # trivially satisfied
         assert summary["steps"] == 1
 
+    def test_checkpoint_gc_keeps_last_n(self, tmp_path, monkeypatch):
+        """Periodic saves must not grow the directory without bound: after
+        each save, all but the newest KEEP_LAST snapshots are removed, and
+        restore still loads the newest."""
+        import os
+
+        from distributedvolunteercomputing_tpu.training import checkpoint
+
+        monkeypatch.setattr(checkpoint, "KEEP_LAST", 3)
+        t = Trainer(get_model("mnist_mlp", d_hidden=8), batch_size=8, lr=1e-2)
+        batch_iter = iter(t.data_iter())
+        for _ in range(5):
+            t.state, _ = t._step_fn(t.state, next(batch_iter))
+            checkpoint.save(t, str(tmp_path))
+        dirs = sorted(os.listdir(tmp_path))
+        assert dirs == ["step_3", "step_4", "step_5"], dirs
+        t2 = Trainer(get_model("mnist_mlp", d_hidden=8), batch_size=8, lr=1e-2)
+        assert checkpoint.maybe_restore(t2, str(tmp_path))
+        assert int(t2.state.step) == 5
+        # Stale HIGHER-step entries (reused dir / lagging second writer)
+        # must never make GC eat the snapshot just written.
+        os.makedirs(tmp_path / "step_1000")
+        t.state, _ = t._step_fn(t.state, next(batch_iter))
+        checkpoint.save(t, str(tmp_path))  # step 6
+        assert "step_6" in os.listdir(tmp_path)
+        assert "step_1000" in os.listdir(tmp_path)
+
     def test_eval_hook_records_held_out_loss(self, tmp_path):
         """eval_every: periodic held-out loss without updating params —
         recorded as 'eval' metrics events, params untouched by eval."""
