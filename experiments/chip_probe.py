@@ -133,6 +133,19 @@ def _gpt2_step(ctx):
         "source": "experiments/chip_probe.py (staged warm-up ladder)",
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    # est_mfu via the same 6ND convention as bench.py (lower bound: remat
+    # recompute not counted).
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        from bench import _peak_flops
+
+        peak = _peak_flops(jax.devices()[0].device_kind)
+        if peak:
+            payload["est_mfu"] = round(
+                6.0 * n_params * payload["tokens_per_sec_chip"] / peak, 4
+            )
+    except Exception:
+        pass
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results", "tpu_probe_success.json")
     with open(out, "w") as fh:
         json.dump(payload, fh)

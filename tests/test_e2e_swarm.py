@@ -50,10 +50,11 @@ def start_volunteer(coord_addr, peer_id, extra, env_extra=None):
     env = _env()
     if env_extra:
         env.update(env_extra)
+    coord = ["--coordinator", coord_addr] if coord_addr else []
     return subprocess.Popen(
         [
             sys.executable, os.path.join(REPO, "run_volunteer.py"),
-            "--coordinator", coord_addr,
+            *coord,
             "--peer-id", peer_id,
             "--batch-size", "16",
             "--lr", "0.01",
@@ -170,6 +171,38 @@ class TestSwarmE2E:
             assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
         finally:
             coord.kill()
+
+    def test_peer_bootstrap_no_coordinator(self):
+        """Fully decentralized: every volunteer runs a DHT node, so a second
+        volunteer can bootstrap off the FIRST volunteer's address — no
+        coordinator process anywhere. The coordinator is a convenience
+        (stable rendezvous + metrics sink), not a dependency."""
+        import socket
+
+        common = [
+            "--averaging", "sync", "--average-every", "6", "--steps", "60",
+            "--join-timeout", "25", "--gather-timeout", "25",
+        ]
+        va = start_volunteer(
+            None, "boot-a", common + ["--seed", "0", "--port", "47821"]
+        )
+        # Volunteers print no READY line; poll the port until A's transport
+        # is listening (the DHT bootstrap ping is single-attempt, so racing
+        # it would fail spuriously on a slow start).
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", 47821), timeout=1.0).close()
+                break
+            except OSError:
+                time.sleep(0.5)
+        else:
+            va.kill()
+            raise AssertionError("volunteer A never started listening")
+        vb = start_volunteer("127.0.0.1:47821", "boot-b", common + ["--seed", "1"])
+        sa, outa = wait_done(va)
+        sb, outb = wait_done(vb)
+        assert sa["rounds_ok"] + sb["rounds_ok"] >= 1, outa + outb
 
     def test_multi_coordinator_bootstrap_survives_dead_first(self):
         """--coordinator addr1,addr2: volunteers join through the SECOND
