@@ -25,7 +25,7 @@ log = get_logger(__name__)
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "dvc_native.cpp")
 _SO = os.path.join(_DIR, "libdvc_native.so")
-_ABI = 2
+_ABI = 3
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -80,6 +80,8 @@ def _load() -> Optional[ctypes.CDLL]:
     i8p = ctypes.POINTER(ctypes.c_int8)
     lib.dvc_f32_to_q8.argtypes = [f32p, u64, u64, f32p, i8p]
     lib.dvc_q8_to_f32.argtypes = [i8p, f32p, u64, u64, f32p]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.dvc_topk_indices.argtypes = [f32p, u64, u64, u32p]
     return lib
 
 
@@ -305,17 +307,37 @@ def topk_encode(arr: np.ndarray, frac: float | None = None) -> bytes:
     if n >= 1 << 32:
         raise ValueError(f"topk codec supports < 2^32 elements, got {n}")
     header = _TOPK_MAGIC + bytes([_TOPK_SPARSE]) + np.uint64(n).tobytes()
+
+    def dense() -> bytes:  # built on demand: it copies the whole buffer
+        return _TOPK_MAGIC + bytes([_TOPK_DENSE]) + np.uint64(n).tobytes() + arr.tobytes()
+
     if frac is None:
-        idx = np.flatnonzero(arr)
+        idx = np.flatnonzero(arr).astype(np.uint32)
+        if 8 * idx.size >= 4 * n:  # sparse (8 B/entry) wouldn't pay
+            return dense()
     else:
         k = max(1, int(n * frac)) if n else 0
-        if k >= n:
-            idx = np.arange(n, dtype=np.int64)
+        if 8 * k >= 4 * n or k >= n:
+            # Dense mode is knowable from k alone — decide BEFORE paying
+            # for any selection work.
+            return dense()
+        # numpy's SIMD introselect beats the C++ nth_element ~2x on this
+        # hardware (measured at 31M f32: 0.30s vs 0.64s), so numpy is the
+        # default; the native path (parity-tested) is an opt-in for
+        # platforms where numpy's partition underperforms. Env checked
+        # first: get_lib() would otherwise kick off the background g++
+        # build for a value the condition then ignores.
+        if (
+            os.environ.get("DVC_TOPK_NATIVE") == "1"
+            and n >= (1 << 15)
+            and (lib := get_lib()) is not None
+        ):
+            idx = np.empty(k, np.uint32)
+            lib.dvc_topk_indices(_ptr(arr, ctypes.c_float), n, k, _ptr(idx, ctypes.c_uint32))
         else:
-            idx = np.argpartition(np.abs(arr), n - k)[n - k:]
-    if 8 * idx.size >= 4 * n:  # sparse (8 B/entry) wouldn't pay: dense mode
-        return _TOPK_MAGIC + bytes([_TOPK_DENSE]) + np.uint64(n).tobytes() + arr.tobytes()
-    idx = np.sort(idx).astype(np.uint32)
+            idx = np.sort(
+                np.argpartition(np.abs(arr), n - k)[n - k:]
+            ).astype(np.uint32)
     return header + idx.tobytes() + arr[idx].tobytes()
 
 

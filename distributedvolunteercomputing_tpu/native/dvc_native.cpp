@@ -134,7 +134,7 @@ inline uint16_t f32_to_bf16_1(float f) {
 
 extern "C" {
 
-int dvc_abi_version() { return 2; }
+int dvc_abi_version() { return 3; }
 
 uint32_t dvc_crc32(const uint8_t* data, uint64_t len, uint32_t seed) {
   const uint64_t kCut = 1 << 20;
@@ -260,6 +260,47 @@ void dvc_q8_to_f32(const int8_t* in, const float* scales, uint64_t n,
         out[i] = static_cast<float>(in[i]) * scale;
     }
   });
+}
+
+// Indices of the k largest-|value| entries, ascending index order (the
+// top-k sparse wire codec's selection phase). Caller guarantees finite
+// input (the Python side zeroes NaN/Inf first) and 0 < k <= n. Threshold
+// via nth_element on a magnitude copy, then one in-order scan emitting
+// strictly-above-threshold entries plus as many threshold-equal ones as k
+// still needs — output is sorted by construction, as the wire format
+// requires.
+void dvc_topk_indices(const float* in, uint64_t n, uint64_t k,
+                      uint32_t* idx_out) {
+  if (k == 0 || k > n) return;
+  // One scratch magnitude array, consumed destructively by nth_element;
+  // the counting/emit scans read |in[i]| directly (fabs is cheaper than a
+  // second n-float allocation + copy).
+  std::vector<float> mag(n);
+  parallel_for(n, 1u << 16, [&](uint64_t b, uint64_t e) {
+    for (uint64_t i = b; i < e; ++i) mag[i] = in[i] < 0 ? -in[i] : in[i];
+  });
+  std::nth_element(mag.begin(), mag.begin() + (n - k), mag.end());
+  float thr = mag[n - k];
+  std::atomic<uint64_t> greater_at{0};
+  parallel_for(n, 1u << 16, [&](uint64_t b, uint64_t e) {
+    uint64_t g = 0;
+    for (uint64_t i = b; i < e; ++i) {
+      float a = in[i] < 0 ? -in[i] : in[i];
+      if (a > thr) ++g;
+    }
+    greater_at.fetch_add(g, std::memory_order_relaxed);
+  });
+  uint64_t need_eq = k - greater_at.load();
+  uint64_t w = 0;
+  for (uint64_t i = 0; i < n && w < k; ++i) {
+    float a = in[i] < 0 ? -in[i] : in[i];
+    if (a > thr) {
+      idx_out[w++] = static_cast<uint32_t>(i);
+    } else if (a == thr && need_eq > 0) {
+      idx_out[w++] = static_cast<uint32_t>(i);
+      --need_eq;
+    }
+  }
 }
 
 }  // extern "C"

@@ -320,3 +320,36 @@ class TestTopkCodec:
         assert all(r is not None and float(np.abs(r).sum()) > 0 for r in resid)
         # round 2 contributes (0 + residual): the dropped mass still arrives
         assert ra2 is not None and rb2 is not None
+
+    def test_native_topk_selection_parity(self, lib, monkeypatch):
+        """The C++ dvc_topk_indices (opt-in via DVC_TOPK_NATIVE=1 — numpy's
+        introselect measured ~2x faster on this hardware) selects the same
+        top-k MAGNITUDES as the numpy path (index sets may differ on ties),
+        its output is ascending as the wire format requires, and the codec
+        roundtrip built on it is valid."""
+        rng = np.random.default_rng(7)
+        arr = rng.standard_normal(1 << 16).astype(np.float32)
+        k = arr.size // 100
+        import ctypes
+
+        idx_native = np.empty(k, np.uint32)
+        lib.dvc_topk_indices(
+            native._ptr(arr, ctypes.c_float), arr.size, k,
+            native._ptr(idx_native, ctypes.c_uint32),
+        )
+        assert np.all(np.diff(idx_native.astype(np.int64)) > 0)
+        idx_np = np.argpartition(np.abs(arr), arr.size - k)[arr.size - k:]
+        np.testing.assert_allclose(
+            np.sort(np.abs(arr[idx_native])), np.sort(np.abs(arr[idx_np]))
+        )
+        # full codec path with the native selection opted in
+        monkeypatch.setenv("DVC_TOPK_NATIVE", "1")
+        dense = native.topk_decode(native.topk_encode(arr, frac=0.01))
+        assert np.count_nonzero(dense) <= max(1, int(arr.size * 0.01))
+        np.testing.assert_array_equal(dense[idx_native], arr[idx_native])
+        # and it agrees with the default numpy path on the same input
+        monkeypatch.delenv("DVC_TOPK_NATIVE")
+        dense_np = native.topk_decode(native.topk_encode(arr, frac=0.01))
+        np.testing.assert_allclose(
+            np.sort(np.abs(dense[dense != 0])), np.sort(np.abs(dense_np[dense_np != 0]))
+        )
