@@ -18,6 +18,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <thread>
 #include <vector>
 
@@ -272,14 +273,15 @@ void dvc_q8_to_f32(const int8_t* in, const float* scales, uint64_t n,
 void dvc_topk_indices(const float* in, uint64_t n, uint64_t k,
                       uint32_t* idx_out) {
   if (k == 0 || k > n) return;
-  // One scratch magnitude array, consumed destructively by nth_element;
-  // the counting/emit scans read |in[i]| directly (fabs is cheaper than a
-  // second n-float allocation + copy).
-  std::vector<float> mag(n);
+  // One UNINITIALIZED scratch magnitude array (vector would zero-fill n
+  // floats serially before the parallel fill overwrites them), consumed
+  // destructively by nth_element; the counting/emit scans read |in[i]|
+  // directly (fabs is cheaper than a second n-float allocation + copy).
+  std::unique_ptr<float[]> mag(new float[n]);
   parallel_for(n, 1u << 16, [&](uint64_t b, uint64_t e) {
     for (uint64_t i = b; i < e; ++i) mag[i] = in[i] < 0 ? -in[i] : in[i];
   });
-  std::nth_element(mag.begin(), mag.begin() + (n - k), mag.end());
+  std::nth_element(mag.get(), mag.get() + (n - k), mag.get() + n);
   float thr = mag[n - k];
   std::atomic<uint64_t> greater_at{0};
   parallel_for(n, 1u << 16, [&](uint64_t b, uint64_t e) {

@@ -40,6 +40,46 @@ def test_corrupt_frame_rejected_by_crc():
     run(scenario())
 
 
+def test_corrupt_frame_rejected_under_auth():
+    """With the HMAC secret on, tampering is rejected at the right layer in
+    both shapes: a chaos-corrupted payload dies at the CRC (which runs
+    first), and a frame whose CRC is VALID but whose MAC is wrong — the
+    shape only an attacker who can recompute CRCs produces — dies at the
+    HMAC check. Neither crashes the server, and a clean authed call still
+    works afterwards."""
+
+    class _BadMacTransport(Transport):
+        # Right secret, valid CRC — but every MAC it emits is garbage.
+        def _mac(self, ftype, meta, payload):
+            return "0" * 64
+
+    async def scenario():
+        server = Transport(secret=b"k")
+
+        async def echo(args, payload):
+            return {"n": len(payload)}, payload
+
+        server.register("echo", echo)
+        await server.start()
+        chaos = ChaosTransport(corrupt_rate=1.0, seed=7, secret=b"k")
+        await chaos.start()
+        forger = _BadMacTransport(secret=b"k")
+        try:
+            with pytest.raises(RPCError, match="CRC|corrupt"):
+                await chaos.call(server.addr, "echo", {}, b"x" * 1024, timeout=10)
+            with pytest.raises((RPCError, OSError), match="auth"):
+                await forger.call(server.addr, "echo", {}, b"x" * 64, timeout=10)
+            # and a clean (uncorrupted) call on a fresh authed client works
+            ok = Transport(secret=b"k")
+            ret, payload = await ok.call(server.addr, "echo", {}, b"hi", timeout=10)
+            assert payload == b"hi"
+        finally:
+            await chaos.close()
+            await server.close()
+
+    run(scenario())
+
+
 def test_lossy_peer_degrades_then_recovers():
     """With a fully lossy link the round returns None within its timeouts
     (no hang); healing the link makes the next round succeed."""

@@ -172,6 +172,56 @@ class TestSwarmE2E:
         finally:
             coord.kill()
 
+    def test_kitchen_sink_auth_topk_churn(self, tmp_path):
+        """The features compose: HMAC-authenticated swarm, grads-mode sync
+        averaging over the top-k sparse wire with error feedback, kill -9
+        churn mid-run — survivors keep averaging and finish."""
+        secret = tmp_path / "swarm.key"
+        secret.write_text("kitchen-sink\n")
+        coord, addr = start_coordinator(["--secret-file", str(secret)])
+        vols = []
+        try:
+            victim_metrics = str(tmp_path / "ks2.jsonl")
+            common = [
+                "--averaging", "sync", "--average-what", "grads",
+                "--wire", "topk", "--topk-frac", "0.25",
+                "--steps", "30", "--min-group", "2",
+                "--join-timeout", "20", "--gather-timeout", "10",
+                "--secret-file", str(secret),
+            ]
+            vols = [
+                start_volunteer(
+                    addr, f"ks{i}",
+                    common + ["--seed", str(i)]
+                    + (["--metrics", victim_metrics] if i == 2 else []),
+                )
+                for i in range(3)
+            ]
+            # Kill only once the victim has demonstrably TRAINED (metrics
+            # records exist): a wall-clock sleep can land the kill during
+            # JAX compile, quietly degrading this to a 2-node test.
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                try:
+                    if sum(1 for _ in open(victim_metrics)) >= 3:
+                        break
+                except OSError:
+                    pass
+                time.sleep(1.0)
+            else:
+                raise AssertionError("victim volunteer never started training")
+            vols[2].send_signal(signal.SIGKILL)
+            s0, out0 = wait_done(vols[0])
+            s1, out1 = wait_done(vols[1])
+            assert s0["rounds_ok"] >= 1, out0
+            assert s1["rounds_ok"] >= 1, out1
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5
+        finally:
+            coord.kill()
+            for v in vols:
+                if v.poll() is None:
+                    v.kill()
+
     def test_peer_bootstrap_no_coordinator(self):
         """Fully decentralized: every volunteer runs a DHT node, so a second
         volunteer can bootstrap off the FIRST volunteer's address — no
