@@ -1,4 +1,4 @@
-"""Mixture-of-Experts FFN (Switch-style top-1 routing) + GPT-2-MoE.
+"""Mixture-of-Experts FFN (Switch top-1 / GShard top-2 routing) + GPT-2-MoE.
 
 Build-side extension beyond reference parity (SURVEY.md §2 lists the
 reference as dense volunteer-DP only), completing the parallelism set with
@@ -8,9 +8,10 @@ dispatch/combine einsums below compile to GSPMD all-to-alls over ICI — the
 canonical GShard/Switch TPU formulation, where routing is expressed as
 dense one-hot einsums the MXU eats, never as data-dependent gathers.
 
-Routing (top-1, Switch Transformer):
-- router logits [S, E] -> softmax gates; each token goes to its argmax
-  expert, output scaled by that gate (the gate carries the gradient);
+Routing (``router_top_k``; 1 = Switch Transformer, 2 = GShard top-2):
+- router logits [S, E] -> softmax gates; each token goes to its top-k
+  experts, output scaled by the gate(s) (renormalized over the chosen
+  experts for k > 1; the raw argmax gate for k = 1, as in Switch);
 - static capacity C = ceil(capacity_factor * S / E) per expert; tokens
   beyond an expert's capacity are DROPPED for the FFN (their residual
   stream passes through unchanged) — the standard fixed-shape trade that
@@ -39,8 +40,18 @@ class GPT2MoEConfig(GPT2Config):
     n_experts: int = 8
     capacity_factor: float = 1.25
     aux_coef: float = 0.01
+    # Experts each token is routed to: 1 = Switch, 2 = GShard-style top-2
+    # (gates renormalized over the chosen experts; the second choice queues
+    # for capacity AFTER all first choices).
+    router_top_k: int = 1
     # MoE replaces the dense FFN in EVERY block (Switch layout); d_ff is the
     # per-expert hidden width.
+
+    def __post_init__(self):
+        if not 1 <= self.router_top_k <= self.n_experts:
+            raise ValueError(
+                f"router_top_k={self.router_top_k} must be in [1, n_experts={self.n_experts}]"
+            )
 
 
 def moe_init(rng: jax.Array, cfg: GPT2MoEConfig) -> common.Params:
@@ -61,25 +72,45 @@ def moe_ffn(p: common.Params, x: jax.Array, cfg: GPT2MoEConfig) -> Tuple[jax.Arr
     s = b * t
     e = cfg.n_experts
     # ceil, not truncation: capacity_factor=1.25 must mean >= 25% headroom
-    # over the uniform share, never less.
-    cap = max(math.ceil(cfg.capacity_factor * s / e), 1)
+    # over the uniform share, never less. Capacity scales with router_top_k
+    # (GShard): top-2 makes 2S total assignments, so per-expert slots must
+    # double for the same factor or ~a third of assignments drop even under
+    # perfectly uniform routing.
+    cap = max(math.ceil(cfg.capacity_factor * cfg.router_top_k * s / e), 1)
     xs = x.reshape(s, d)
 
     # Router in f32 (softmax statistics), gates carry the gradient.
     logits = jnp.einsum("sd,de->se", xs.astype(jnp.float32), p["router"])
     gates = jax.nn.softmax(logits, axis=-1)  # [S, E]
-    expert = jnp.argmax(gates, axis=-1)  # [S]
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [S, E]
-    gate = jnp.sum(gates * onehot, axis=-1)  # [S] chosen gate
+    k_router = cfg.router_top_k
+    top_gates, top_idx = jax.lax.top_k(gates, k_router)  # [S, K]
+    if k_router > 1:
+        # GShard: renormalize over the chosen experts so the combined output
+        # is a convex mixture. (Deliberately NOT applied at K=1, matching
+        # Switch — the raw gate carries the router gradient.)
+        top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
 
-    # Position of each token within its expert; >= cap overflows (dropped).
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [S, E], -1 where unrouted
-    kept = (pos >= 0) & (pos < cap)
-    pos_oh = jax.nn.one_hot(
-        jnp.clip(pos, 0, cap - 1).astype(jnp.int32), cap, dtype=x.dtype
-    )  # [S, E, C]
-    dispatch = pos_oh * kept.astype(x.dtype)[..., None]  # [S, E, C]
-    combine = dispatch * gate.astype(x.dtype)[:, None, None]
+    # Per-choice dispatch: choice i's tokens queue for expert capacity AFTER
+    # every earlier choice's assignments (count_prev), the standard GShard
+    # ordering — a token's second choice never displaces a first choice.
+    dispatch = jnp.zeros((s, e, cap), x.dtype)
+    combine = jnp.zeros((s, e, cap), x.dtype)
+    count_prev = jnp.zeros((e,), jnp.float32)
+    onehot1 = None
+    for i in range(k_router):
+        oh = jax.nn.one_hot(top_idx[:, i], e, dtype=jnp.float32)  # [S, E]
+        if i == 0:
+            onehot1 = oh
+        # Position within the expert queue; -1 where unrouted, >= cap drops.
+        pos = (jnp.cumsum(oh, axis=0) + count_prev[None, :]) * oh - 1.0
+        kept = (pos >= 0) & (pos < cap)
+        pos_oh = jax.nn.one_hot(
+            jnp.clip(pos, 0, cap - 1).astype(jnp.int32), cap, dtype=x.dtype
+        )  # [S, E, C]
+        disp = pos_oh * kept.astype(x.dtype)[..., None]
+        dispatch = dispatch + disp
+        combine = combine + disp * top_gates[:, i].astype(x.dtype)[:, None, None]
+        count_prev = count_prev + jnp.sum(oh, axis=0)
 
     # dispatch/combine einsums: with moe_in/out sharded over ep, GSPMD emits
     # the all-to-alls here.
@@ -89,8 +120,9 @@ def moe_ffn(p: common.Params, x: jax.Array, cfg: GPT2MoEConfig) -> Tuple[jax.Arr
     eout = jnp.einsum("ecf,efd->ecd", h, p["moe_out"].astype(dtype))  # [E, C, d]
     y = jnp.einsum("sec,ecd->sd", combine, eout)
 
-    # Switch load-balance loss: E * sum_e(frac_routed_e * mean_gate_e).
-    frac = jnp.mean(onehot, axis=0)  # [E]
+    # Load-balance loss (Switch eq. 4 / GShard): E * sum_e(frac of tokens
+    # whose FIRST choice is e * mean_gate_e).
+    frac = jnp.mean(onehot1, axis=0)  # [E]
     mean_gate = jnp.mean(gates, axis=0)  # [E]
     aux = e * jnp.sum(frac * mean_gate)
     return y.reshape(b, t, d), aux.astype(jnp.float32)

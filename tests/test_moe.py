@@ -44,6 +44,104 @@ def test_capacity_overflow_drops_not_crashes():
     assert zero_rows > 0.5
 
 
+class TestTop2Routing:
+    def test_top2_equals_convex_mixture_with_ample_capacity(self):
+        """GShard top-2 with capacity for everyone: each token's output is
+        the renormalized-gate convex mixture of its two experts' FFNs."""
+        cfg = GPT2MoEConfig(
+            **{**TINY, "n_experts": 4, "capacity_factor": 8.0, "router_top_k": 2}
+        )
+        p = moe_init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, cfg.d_model))
+        y, aux = moe_ffn(p, x, cfg)
+
+        xs = np.asarray(x.reshape(-1, cfg.d_model))
+        logits = xs.astype(np.float32) @ np.asarray(p["router"])
+        gates = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+        tg, ti = jax.lax.top_k(gates, 2)
+        tg = np.asarray(tg / jnp.sum(tg, -1, keepdims=True))
+        ti = np.asarray(ti)
+        ref = np.zeros_like(xs)
+        for s in range(xs.shape[0]):
+            for j in range(2):
+                e_idx = ti[s, j]
+                h = np.asarray(
+                    jax.nn.gelu(jnp.asarray(xs[s] @ np.asarray(p["moe_in"][e_idx])))
+                )
+                ref[s] += tg[s, j] * (h @ np.asarray(p["moe_out"][e_idx]))
+        np.testing.assert_allclose(
+            np.asarray(y).reshape(-1, cfg.d_model), ref, rtol=2e-4, atol=2e-5
+        )
+        assert np.isfinite(float(aux))
+
+    def test_top2_capacity_second_choice_yields(self):
+        """Second choices queue AFTER all first choices: under a brutal cap
+        the output matches an independent numpy reference that fills every
+        expert's slots with first choices before any second choice."""
+        import math
+
+        cfg = GPT2MoEConfig(
+            **{**TINY, "capacity_factor": 0.15, "router_top_k": 2}
+        )
+        p = moe_init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, cfg.d_model))
+        y, aux = moe_ffn(p, x, cfg)
+        assert np.isfinite(np.asarray(y)).all()
+
+        # Independent reference: sequential slot assignment, choice-major
+        # (ALL first choices queue before ANY second choice).
+        s, e = 32, cfg.n_experts
+        cap = max(math.ceil(cfg.capacity_factor * cfg.router_top_k * s / e), 1)
+        xs = np.asarray(x.reshape(s, -1))
+        gates = np.asarray(
+            jax.nn.softmax(
+                jnp.asarray(xs.astype(np.float32) @ np.asarray(p["router"])), axis=-1
+            )
+        )
+        ti = np.argsort(-gates, axis=-1)[:, :2]
+        tg = np.take_along_axis(gates, ti, axis=-1)
+        tg = tg / tg.sum(-1, keepdims=True)
+        used = np.zeros(e, np.int64)
+        ref = np.zeros_like(xs)
+        for j in range(2):  # choice-major order is the invariant under test
+            for tok in range(s):
+                e_idx = ti[tok, j]
+                if used[e_idx] < cap:
+                    used[e_idx] += 1
+                    h = np.asarray(
+                        jax.nn.gelu(jnp.asarray(xs[tok] @ np.asarray(p["moe_in"][e_idx])))
+                    )
+                    ref[tok] += tg[tok, j] * (h @ np.asarray(p["moe_out"][e_idx]))
+        np.testing.assert_allclose(
+            np.asarray(y).reshape(s, -1), ref, rtol=2e-4, atol=2e-5
+        )
+
+    def test_router_top_k_validation(self):
+        with pytest.raises(ValueError, match="router_top_k"):
+            GPT2MoEConfig(**{**TINY, "router_top_k": 5})  # > n_experts=4
+        with pytest.raises(ValueError, match="router_top_k"):
+            GPT2MoEConfig(**{**TINY, "router_top_k": 0})
+
+    def test_top2_trains_and_matches_ep_sharded(self, eight_devices):
+        bundle = get_model("gpt2_moe", **{**TINY, "router_top_k": 2})
+        tx = make_optimizer("adam", lr=1e-3)
+        params = bundle.init(jax.random.PRNGKey(0))
+        batch = bundle.make_batch(jax.random.PRNGKey(1), 8)
+
+        ref_state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+        ref_step = make_train_step(bundle.loss_fn, tx, donate=False)
+        ref_state, ref_m = ref_step(ref_state, batch)
+
+        mesh = make_mesh(dp=2, ep=2, tp=2)
+        state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+        state, _ = shard_train_state(state, mesh, tx)
+        step = make_sharded_train_step(bundle.loss_fn, tx, mesh, donate=False)
+        state, m = step(state, put_batch(batch, mesh))
+        np.testing.assert_allclose(
+            float(m["loss"]), float(ref_m["loss"]), rtol=2e-4
+        )
+
+
 def test_gpt2_moe_grads_reach_experts_and_router():
     bundle = get_model("gpt2_moe", **TINY)
     params = bundle.init(jax.random.PRNGKey(0))
