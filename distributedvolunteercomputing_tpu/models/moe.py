@@ -12,10 +12,11 @@ Routing (``router_top_k``; 1 = Switch Transformer, 2 = GShard top-2):
 - router logits [S, E] -> softmax gates; each token goes to its top-k
   experts, output scaled by the gate(s) (renormalized over the chosen
   experts for k > 1; the raw argmax gate for k = 1, as in Switch);
-- static capacity C = ceil(capacity_factor * S / E) per expert; tokens
-  beyond an expert's capacity are DROPPED for the FFN (their residual
-  stream passes through unchanged) — the standard fixed-shape trade that
-  keeps the whole layer jit-compatible;
+- static capacity C = ceil(capacity_factor * router_top_k * S / E) per
+  expert (capacity scales with k — 2S assignments need 2x the slots);
+  tokens beyond an expert's capacity are DROPPED for the FFN (their
+  residual stream passes through unchanged) — the standard fixed-shape
+  trade that keeps the whole layer jit-compatible;
 - load-balancing aux loss (Switch eq. 4): E * sum_e(frac_tokens_e *
   mean_gate_e), minimized at uniform routing; returned in metrics and
   added to the objective with ``aux_coef``.

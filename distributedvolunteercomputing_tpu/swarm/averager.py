@@ -529,6 +529,17 @@ class GossipAverager(AveragerBase):
         self._seen_xids: Dict[str, float] = {}
         self.transport.register("gossip.exchange", self._rpc_exchange)
 
+    def publish(self, tree: Any, weight: float = 1.0) -> None:
+        """Make this peer's params available to exchanges BEFORE its own
+        first averaging point. Without this a peer busy compiling serves
+        every incoming exchange 'no params published yet' — under startup
+        skew two peers can each burn ALL their rounds against the other's
+        unpublished window and finish having never mixed (observed as an
+        e2e flake before this existed). The volunteer publishes its post-
+        state-sync snapshot right after joining (params mode only)."""
+        buf = self._pack(tree)
+        self._current = (weight, self._wire_roundtrip(buf))
+
     def _xid_fresh(self, xid: Any) -> bool:
         now = time.monotonic()
         if len(self._seen_xids) >= self._XID_CAP:
@@ -552,7 +563,15 @@ class GossipAverager(AveragerBase):
         inbuf = self._buf_from_payload(payload)
         if inbuf.size != my_buf.size:
             raise RPCError(f"buffer size {inbuf.size} != local {my_buf.size}")
-        self._inbox.append((float(args["weight"]), inbuf))
+        if len(self._inbox) < self.MAX_PARKED_CONTRIBS:
+            self._inbox.append((float(args["weight"]), inbuf))
+        else:
+            # Inbox full (peer long between averaging points — e.g. still
+            # compiling after publish()): serve OUR half of the exchange but
+            # drop theirs, bounding banked param-sized buffers. Push-pull
+            # degrades to pull-only instead of growing without bound.
+            log.debug("gossip inbox full (%d); dropping incoming contribution",
+                      len(self._inbox))
         return {"weight": my_w}, self._to_wire(my_buf)
 
     def _mix(self, w1, b1, w2, b2) -> Tuple[float, np.ndarray]:

@@ -332,6 +332,14 @@ class Volunteer:
                     bundle.avg_merge(self.trainer.state.params, subtree), step=step
                 )
             await self.state_sync.announce()
+            if self.cfg.averaging == "gossip" and self.cfg.average_what == "params":
+                # Publish the post-state-sync params so exchanges from
+                # faster peers succeed BEFORE our first averaging point —
+                # without this, startup skew (one peer compiling while the
+                # other trains) can burn both peers' entire runs against
+                # each other's unpublished window (GossipAverager.publish).
+                _, snap = self.trainer.host_snapshot()
+                self.averager.publish(bundle.avg_select(snap))
         log.info(
             "volunteer %s up on %s:%d (model=%s averaging=%s)",
             self.cfg.peer_id, *self.transport.addr, self.cfg.model, self.cfg.averaging,

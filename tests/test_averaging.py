@@ -194,6 +194,27 @@ class TestGossip:
         assert float(rb2["w"].mean()) < 4.0
 
 
+    def test_publish_serves_exchanges_before_first_round(self):
+        """A peer that has PUBLISHED (but never averaged) must serve
+        exchanges: under startup skew a compiling peer otherwise rejects
+        every incoming exchange until its own first averaging point, and
+        two peers can burn their entire runs against each other's
+        unpublished windows (the pre-publish e2e flake)."""
+
+        async def main():
+            vols = await spawn_volunteers(2, GossipAverager)
+            try:
+                a, b = vols[0][3], vols[1][3]
+                b.publish(make_tree(4.0))  # b is "still compiling"
+                ra = await a.average(make_tree(0.0), 1)
+                return ra
+            finally:
+                await teardown(vols)
+
+        ra = run(main())
+        assert ra is not None
+        leaves_close(ra, 2.0)  # mixed with b's published 4.0 at equal weight
+
     def test_replayed_exchange_rejected(self):
         """An exchange frame replayed verbatim (same xid) must be rejected:
         the gossip inbox is un-keyed, so without the xid dedup a captured
