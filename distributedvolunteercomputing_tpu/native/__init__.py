@@ -258,13 +258,21 @@ def q8_encode(arr: np.ndarray, chunk: int = Q8_CHUNK) -> bytes:
     )
 
 
+def q8_coded_size(n: int, chunk: int = Q8_CHUNK) -> int:
+    """Exact q8 wire size for n f32 elements — the ONE home of the layout
+    (header u64 + f32 scale per chunk + int8 data); peers validate transfer
+    sizes against this instead of re-deriving the format."""
+    n_chunks = -(-n // chunk) if n else 0
+    return 8 + 4 * n_chunks + n
+
+
 def q8_decode(payload: bytes, chunk: int = Q8_CHUNK) -> np.ndarray:
     """Inverse of q8_encode; raises ValueError on malformed payloads."""
     if len(payload) < 8:
         raise ValueError("q8 payload too short for header")
     n = int(np.frombuffer(payload[:8], np.uint64)[0])
     n_chunks = -(-n // chunk) if n else 0
-    expect = 8 + 4 * n_chunks + n
+    expect = q8_coded_size(n, chunk)
     if len(payload) != expect:
         raise ValueError(f"q8 payload {len(payload)}B != expected {expect}B for n={n}")
     scales = np.frombuffer(payload[8 : 8 + 4 * n_chunks], np.float32)

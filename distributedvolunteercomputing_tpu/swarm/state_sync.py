@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from distributedvolunteercomputing_tpu import native
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
@@ -99,7 +100,15 @@ class StateSyncService:
         announce_ttl: float = 30.0,
         fetch_timeout: float = 60.0,
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+        wire: str = "f32",
     ):
+        # Wire codec for SERVED state (this side's provider role): bf16
+        # halves and q8 quarters the rejoin transfer, at the same tolerance
+        # the averaging wire already accepts. The puller decodes whatever
+        # the provider's fetch meta declares, so mixed-wire swarms still
+        # sync. (topk is grads-only and meaningless for a params snapshot.)
+        if wire not in ("f32", "bf16", "q8"):
+            raise ValueError(f"unknown state-sync wire {wire!r}")
         self.transport = transport
         self.dht = dht
         self.peer_id = peer_id
@@ -107,6 +116,7 @@ class StateSyncService:
         self.announce_ttl = announce_ttl
         self.fetch_timeout = fetch_timeout
         self.chunk_bytes = int(chunk_bytes)
+        self.wire = wire
         self._provider: Optional[StateProvider] = None
         self._sessions: Dict[str, _Session] = {}
         transport.register("state.fetch", self._rpc_fetch)
@@ -167,6 +177,10 @@ class StateSyncService:
 
                 def _serialize() -> bytes:
                     buf, _, _ = flatten_to_buffer(tree)
+                    if self.wire == "bf16":
+                        return native.f32_to_bf16(buf).tobytes()
+                    if self.wire == "q8":
+                        return native.q8_encode(buf)
                     return buf.tobytes()
 
                 # Param-sized flatten+copy off the event loop: serving state
@@ -194,6 +208,7 @@ class StateSyncService:
                 "total": len(st.buf),
                 "offset": offset,
                 "done": done,
+                "wire": self.wire,
             },
             chunk,
         )
@@ -217,12 +232,28 @@ class StateSyncService:
         out.sort(reverse=True)  # freshest first
         return out
 
-    async def _fetch_all(self, addr: Addr, expect_bytes: int) -> Tuple[int, bytearray]:
+    @staticmethod
+    def _expected_bytes(wire: str, n_elems: int) -> int:
+        """Exact coded size of an n_elems f32 tree under each wire. Raises
+        on unknown wires — silently treating a foreign codec as raw f32
+        would let same-sized garbage through the size check."""
+        if wire == "bf16":
+            return 2 * n_elems
+        if wire == "q8":
+            return native.q8_coded_size(n_elems)
+        if wire == "f32":
+            return 4 * n_elems
+        raise RPCError(f"provider declared unknown wire {wire!r}")
+
+    async def _fetch_all(self, addr: Addr, n_elems: int) -> Tuple[int, str, bytearray]:
         """Pull the full buffer from one provider in chunks; returns
-        (provider_step, payload). Raises on any failure — caller moves on.
+        (provider_step, wire, payload). Raises on any failure — caller
+        moves on. The provider's first response declares its wire codec;
+        the total must match that codec's exact size for our schema.
         Chunks write straight into one preallocated buffer: collecting
         parts and joining would hold ~2x the payload at the join."""
-        out = bytearray(expect_bytes)
+        out: Optional[bytearray] = None
+        wire = "f32"
         session = ""
         offset = 0
         while True:
@@ -234,8 +265,17 @@ class StateSyncService:
                 timeout=self.fetch_timeout,
             )
             total = int(ret["total"])
-            if total != expect_bytes:
-                raise RPCError(f"provider buffer {total}B != local schema {expect_bytes}B")
+            if out is None:  # first response: wire + size validation
+                wire = str(ret.get("wire", "f32"))
+                expect_bytes = self._expected_bytes(wire, n_elems)
+                if total != expect_bytes:
+                    raise RPCError(
+                        f"provider buffer {total}B != local schema "
+                        f"{expect_bytes}B (wire={wire})"
+                    )
+                out = bytearray(total)
+            elif total != len(out):
+                raise RPCError("provider total changed mid-session")
             if int(ret["offset"]) != offset or not chunk or offset + len(chunk) > total:
                 raise RPCError("chunk sequencing error")
             out[offset : offset + len(chunk)] = chunk
@@ -245,7 +285,7 @@ class StateSyncService:
                 if offset != total:
                     raise RPCError("provider finished short of its own total")
                 break
-        return int(ret["step"]), out
+        return int(ret["step"]), wire, out
 
     def _sane(self, buf: np.ndarray) -> bool:
         """Finite and magnitude-bounded, allocation-free: NaN propagates
@@ -269,8 +309,15 @@ class StateSyncService:
         expect = int(sum(s.size for s in specs))
         for step, pid, addr in await self._candidates(local_step + min_lead - 1):
             try:
-                got_step, payload = await self._fetch_all(addr, expect * 4)
-                buf = np.frombuffer(payload, np.float32)
+                got_step, wire, payload = await self._fetch_all(addr, expect)
+                if wire == "bf16":
+                    buf = native.bf16_to_f32(np.frombuffer(payload, np.uint16))
+                elif wire == "q8":
+                    buf = native.q8_decode(payload)  # accepts the bytearray; no copy
+                else:
+                    buf = np.frombuffer(payload, np.float32)
+                if buf.size != expect:
+                    raise RPCError(f"decoded {buf.size} elems != schema {expect}")
                 if not self._sane(buf):
                     log.warning(
                         "state pull from %s failed the sanity guard "

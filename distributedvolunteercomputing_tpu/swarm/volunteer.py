@@ -306,7 +306,11 @@ class Volunteer:
             # contributes swarm-current weights, not a cold init (or a
             # checkpoint from before a long absence).
             self.state_sync = StateSyncService(
-                self.transport, self.dht, self.cfg.peer_id, namespace=self.cfg.model
+                self.transport, self.dht, self.cfg.peer_id, namespace=self.cfg.model,
+                # Serve state over the averaging wire's codec (bf16 halves,
+                # q8 quarters a rejoin transfer); topk is grads-only, so
+                # such volunteers serve plain f32 snapshots.
+                wire=self.cfg.wire if self.cfg.wire in ("bf16", "q8") else "f32",
             )
 
             # State sync ships the bundle's SYNC SUBTREE (avg_select):

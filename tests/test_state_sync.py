@@ -247,3 +247,62 @@ def test_volunteer_pull_on_join(tmp_path):
             await v1.transport.close()
 
     run(scenario())
+
+
+def test_pull_decodes_provider_wire_codecs():
+    """A provider serving bf16 (or q8) state halves (quarters) the rejoin
+    transfer; the puller decodes whatever the fetch meta declares, so a
+    default-f32 puller syncs from any provider."""
+
+    async def scenario():
+        results = {}
+        ta, _, a = await _node(peer_id="a")
+        try:
+            for wire, tol in (("bf16", 3e-2), ("q8", 1e-2)):
+                tb = Transport()
+                from distributedvolunteercomputing_tpu.swarm.dht import DHTNode as _D
+
+                dhtb = _D(tb)
+                await dhtb.start(bootstrap=[ta.addr])
+                b = StateSyncService(tb, dhtb, f"prov-{wire}", namespace=wire,
+                                     fetch_timeout=10.0, wire=wire)
+                b.set_provider(lambda: (80, tree(1.2345)))
+                await b.announce()
+                # default-f32 PULLER on the same namespace
+                tc = Transport()
+                dhtc = _D(tc)
+                await dhtc.start(bootstrap=[ta.addr])
+                c = StateSyncService(tc, dhtc, f"pull-{wire}", namespace=wire,
+                                     fetch_timeout=10.0)
+                pulled = await c.pull(tree(0.0), local_step=0)
+                assert pulled is not None, wire
+                step, t = pulled
+                assert step == 80
+                np.testing.assert_allclose(t["w"], 1.2345, rtol=tol)
+                np.testing.assert_allclose(t["b"], 3 * 1.2345, rtol=tol)
+                results[wire] = True
+                await tb.close()
+                await tc.close()
+            return results
+        finally:
+            await ta.close()
+
+    assert run(scenario()) == {"bf16": True, "q8": True}
+
+
+def test_wire_size_mismatch_rejected():
+    """A provider whose coded size doesn't match the puller's schema under
+    the declared wire is rejected (falls back to None, not garbage)."""
+
+    async def scenario():
+        ta, _, a = await _node(peer_id="a", ns="sz")
+        tb, dhtb, b = await _node(boot=ta.addr, peer_id="b", ns="sz")
+        try:
+            b.set_provider(lambda: (80, tree(2.0, n=13)))  # wrong shape
+            await b.announce()
+            return await a.pull(tree(0.0), local_step=0)
+        finally:
+            await ta.close()
+            await tb.close()
+
+    assert run(scenario()) is None
