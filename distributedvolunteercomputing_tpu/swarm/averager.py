@@ -55,6 +55,11 @@ class _Round:
         self.full = asyncio.Event()
         self.result: Optional[np.ndarray] = None
         self.result_ready = asyncio.Event()
+        # Peer ids whose contributions actually entered the aggregate —
+        # served back in sync.fetch meta so a member with a pending top-k
+        # error-feedback residual knows whether its shipped mass landed
+        # (a degraded round may have dropped its late push).
+        self.included: List[str] = []
         self.t0 = time.monotonic()
 
 
@@ -113,6 +118,9 @@ class AveragerBase:
         # failed round (the trainer falls back to its raw local grad).
         self._ef_residual: Optional[np.ndarray] = None
         self._ef_pending: Optional[np.ndarray] = None
+        # Whether the last round's contribution actually entered the
+        # aggregate (sync members learn this from fetch meta; see average()).
+        self._contribution_included = True
         self.transport = transport
         self.dht = dht
         self.membership = membership
@@ -387,7 +395,7 @@ class SyncAverager(AveragerBase):
         await asyncio.wait_for(st.result_ready.wait(), timeout=self.gather_timeout + 3.0)
         if st.result is None:
             raise RPCError("round skipped by leader (too few contributions)")
-        return {"ok": True}, self._to_wire(st.result)
+        return {"ok": True, "included": st.included}, self._to_wire(st.result)
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
@@ -403,6 +411,12 @@ class SyncAverager(AveragerBase):
         wire_bytes, sent = self._compress_contribution(buf)
         t0 = time.monotonic()
         self._round_degraded = False
+        # The leader's own contribution always enters the aggregate; a
+        # member's may be dropped in a degraded round (late push), in which
+        # case its shipped top-k mass never landed and committing the
+        # residual would lose both. _member_round flips this from the
+        # leader-reported included set.
+        self._contribution_included = True
         try:
             if group.my_index == 0:
                 result = await self._lead_round(group, sent(), weight)
@@ -414,7 +428,7 @@ class SyncAverager(AveragerBase):
             self._observe_round_failure()
             self._commit_ef(False)
             return None
-        self._commit_ef(result is not None)
+        self._commit_ef(result is not None and self._contribution_included)
         if result is None:
             self._observe_round_failure()
         elif not self._round_degraded:
@@ -460,6 +474,7 @@ class SyncAverager(AveragerBase):
                 )
                 return None
             peers = sorted(good)
+            st.included = peers
             if self.method == "mean":
                 # Streaming weighted accumulation (native axpy when built):
                 # no [n_peers, D] stack copy for the common path.
@@ -495,9 +510,14 @@ class SyncAverager(AveragerBase):
         await self.transport.call(
             leader_addr, "sync.contribute", args, wire_bytes, timeout=self.effective_gather_timeout
         )
-        _, payload = await self.transport.call(
+        ret, payload = await self.transport.call(
             leader_addr, "sync.fetch", {"epoch": group.epoch}, timeout=self.gather_timeout + 6.0
         )
+        # Older leaders don't report the included set; treat absence as
+        # included (the pre-existing behavior) rather than stalling EF.
+        included = ret.get("included")
+        if included is not None:
+            self._contribution_included = self.peer_id in included
         self.rounds_ok += 1
         return self._unpack(self._buf_from_payload(payload))
 
@@ -652,9 +672,22 @@ class ButterflyAverager(AveragerBase):
         self._stages: Dict[Tuple[str, int], dict] = {}
         self.transport.register("bfly.exchange", self._rpc_exchange)
 
-    def _stage_state(self, epoch: str, stage: int) -> dict:
+    def _stage_state(self, epoch: str, stage: int, *, remote: bool = False) -> dict:
         key = (epoch, stage)
         if key not in self._stages:
+            if remote:
+                # Same asymmetry the byz path had in round 1: every (epoch,
+                # stage) a remote names allocates state AND pins the handler
+                # task for stage_timeout — and the local sweep only runs
+                # inside average(), which a peer that stops averaging never
+                # calls. Sweep on the RPC path and cap remotely-created
+                # entries (buf is None until the LOCAL peer reaches the
+                # stage, so "parked" is exactly that predicate), mirroring
+                # MAX_PARKED_ROUNDS on the gather paths.
+                self._sweep_stages()
+                parked = sum(1 for s in self._stages.values() if s["buf"] is None)
+                if parked >= self.MAX_PARKED_ROUNDS:
+                    raise RPCError("parked stage cap reached")
             self._stages[key] = {
                 "ready": asyncio.Event(),
                 "done": asyncio.Event(),
@@ -675,7 +708,7 @@ class ButterflyAverager(AveragerBase):
     async def _rpc_exchange(self, args: dict, payload: bytes):
         if not self._check_schema(args):
             raise RPCError("schema mismatch")
-        st = self._stage_state(args["epoch"], int(args["stage"]))
+        st = self._stage_state(args["epoch"], int(args["stage"]), remote=True)
         # Wait until the local peer reaches this stage (it may be behind).
         await asyncio.wait_for(st["ready"].wait(), timeout=self.stage_timeout)
         inbuf = self._buf_from_payload(payload)

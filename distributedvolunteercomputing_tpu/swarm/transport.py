@@ -20,11 +20,14 @@ Design:
   swarm tier — DHT records, membership, state sync, and averaging
   contributions all cross this transport, so identity spoofing (which the
   Byzantine first-write-wins rule implicitly trusts) requires the secret,
-  not just an open port. Within-window replay of an identical frame is
-  harmless at the protocol layer: sync/byzantine contributions key on
-  peer+token (idempotent re-park), DHT stores are last-writer-wins
-  re-publishes, butterfly stage slots are write-once per (epoch, stage),
-  and gossip exchanges carry a dedup xid (GossipAverager rejects repeats).
+  not just an open port. Replay is closed at this layer too: every REQUEST
+  carries a fresh uuid ``rid`` inside the MAC'd meta, so legitimate request
+  frames are never byte-identical — the server remembers the MACs it has
+  accepted within the auth window and rejects duplicates outright (a
+  captured membership heartbeat or DHT announce can NOT be re-played to
+  keep a departed peer alive). Responses need no cache: per-call
+  connections mean a client reads exactly one response on its own stream,
+  and the MAC binds the echoed ``rid`` to this request.
 """
 
 from __future__ import annotations
@@ -37,6 +40,7 @@ import struct
 import time
 import uuid
 import zlib
+from collections import deque
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
@@ -81,6 +85,11 @@ class Transport:
     ):
         self._secret = secret
         self._auth_window = auth_window
+        # Accepted-request MAC cache (replay rejection; see module doc).
+        # FIFO deque gives cheap age+cap eviction: entries arrive in ~ts
+        # order, so pruning from the left is enough.
+        self._seen_macs: Dict[str, float] = {}
+        self._seen_order: "deque[Tuple[float, str]]" = deque()
         self._host = host
         self._port = port
         # Bind address != reachable address when binding 0.0.0.0 (or behind
@@ -170,7 +179,34 @@ class Transport:
             ts = meta.get("ts")
             if not isinstance(ts, (int, float)) or abs(time.time() - ts) > self._auth_window:
                 raise RPCError("auth failure (frame timestamp outside window)")
+            if ftype == TYPE_REQ and not self._mac_fresh(got, float(ts)):
+                # A fresh rid is in every legitimate request's MAC'd meta,
+                # so an identical MAC within the window is a replay.
+                raise RPCError("auth failure (replayed request frame)")
         return ftype, meta, payload
+
+    # Hard cap on remembered request MACs: ~5 MB worst case, and at any
+    # realistic RPC rate the age-based pruning keeps it far smaller.
+    MAX_SEEN_MACS = 65536
+
+    def _mac_fresh(self, mac: str, ts: float) -> bool:
+        """Record ``mac``; False if it was already accepted in the window.
+
+        Entries are retained until max(accept_time, frame ts) + auth_window:
+        a frame from an ahead-of-clock peer stays timestamp-valid until
+        ts + window, so evicting by accept time alone would reopen a replay
+        window of exactly the sender's clock skew."""
+        now = time.time()
+        cutoff = now - self._auth_window
+        order, seen = self._seen_order, self._seen_macs
+        while order and (order[0][0] < cutoff or len(order) > self.MAX_SEEN_MACS):
+            _, old = order.popleft()
+            seen.pop(old, None)
+        if mac in seen:
+            return False
+        seen[mac] = now
+        order.append((max(now, ts), mac))
+        return True
 
     # -- server ------------------------------------------------------------
 

@@ -99,6 +99,11 @@ class VolunteerConfig:
     # identities, or inject contributions. A file, not a flag value —
     # secrets in argv leak via process listings.
     secret_file: Optional[str] = None
+    # Byzantine mode + the topk wire is a trap: topk forces method='mean'
+    # (robust estimators over sparse supports collapse to zero), so the run
+    # would carry the name "byzantine" with ZERO robustness. Refused unless
+    # this flag says the caller understands that trade.
+    allow_unrobust_topk: bool = False
 
     def __post_init__(self):
         if not self.peer_id:
@@ -114,8 +119,18 @@ class VolunteerConfig:
                 raise ValueError(
                     "wire='topk' requires --averaging sync or byzantine"
                 )
-            if self.averaging == "byzantine" and self.method != "mean":
-                raise ValueError("wire='topk' requires --method mean")
+            if self.averaging == "byzantine":
+                if self.method != "mean":
+                    raise ValueError("wire='topk' requires --method mean")
+                if not self.allow_unrobust_topk:
+                    raise ValueError(
+                        "--averaging byzantine --wire topk runs a plain "
+                        "weighted mean (topk forces method='mean'), i.e. NO "
+                        "Byzantine tolerance; use --averaging sync with "
+                        "topk, or pass --allow-unrobust-topk if you want "
+                        "byzantine's full-mesh/first-write-wins transport "
+                        "properties without a robust estimator"
+                    )
 
 
 def _parse_addrs(spec: Optional[str]) -> list:

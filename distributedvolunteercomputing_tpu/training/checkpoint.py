@@ -176,24 +176,28 @@ def maybe_restore(trainer, ckpt_dir: str) -> bool:
         restored = ckptr.restore(path, item=template)
     from distributedvolunteercomputing_tpu.training.steps import TrainState
 
-    trainer.state = TrainState(
-        params=jax.device_put(restored["params"]),
-        opt_state=jax.device_put(restored["opt_state"]),
-        step=jax.device_put(restored["step"]),
-        rng=jax.device_put(restored["rng"]),
+    host_state = TrainState(
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        step=restored["step"],
+        rng=restored["rng"],
     )
     if trainer.mesh is not None:
         # A mesh trainer's state lives SHARDED (tp/pp rules; 1/dp per chip
-        # under fsdp). Re-place the restored host trees exactly as __init__
-        # did — a plain device_put would replicate everything, which on a
-        # slice sized for fsdp is an immediate OOM.
+        # under fsdp). Place the restored HOST trees directly with the
+        # rule-derived shardings, exactly as __init__ did — any intermediate
+        # whole-tree device_put would materialize the full state on one
+        # chip first, which on a slice sized for fsdp (the one regime where
+        # the model does NOT fit one chip) is an immediate OOM.
         from distributedvolunteercomputing_tpu.parallel.train_step import (
             shard_train_state,
         )
 
         trainer.state, trainer._param_shardings = shard_train_state(
-            trainer.state, trainer.mesh, trainer.tx, fsdp=trainer.fsdp
+            host_state, trainer.mesh, trainer.tx, fsdp=trainer.fsdp
         )
+    else:
+        trainer.state = jax.tree_util.tree_map(jax.device_put, host_state)
     # Refresh the cross-thread snapshot: the state-sync provider must
     # announce/serve the RESTORED step, not the cold init from __init__.
     trainer._take_snapshot(step)

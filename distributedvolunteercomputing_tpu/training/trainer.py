@@ -140,10 +140,14 @@ class Trainer:
         self._inflight: Optional[tuple] = None  # (launch_step, payload0, future)
         if mesh is None and (fsdp or seq_sharded):
             raise ValueError("fsdp/seq_sharded require a mesh (--mesh dp=...,tp=...)")
-        if fsdp and averager is not None and average_what == "grads":
+        if fsdp and average_what == "grads":
             # The split grad/apply steps have no in-step constraint keeping
             # params at 1/dp, so ZeRO-3 would silently re-replicate — and
             # per-step host grad averaging defeats its purpose anyway.
+            # Independent of whether an averager is attached NOW: the config
+            # asked for grads-mode semantics, and accepting it only when the
+            # wiring happens to be absent would make the same flag set pass
+            # or fail on an unrelated condition.
             raise ValueError("fsdp is a params-mode feature; use average_what='params'")
         self.mesh = mesh
         self.fsdp = fsdp

@@ -152,6 +152,90 @@ def _gpt2_step(ctx):
     return f"loss={loss:.3f} {sps:.2f} samples/s -> {out}"
 
 
+@stage("attn_ab_flash_vs_xla")
+def _attn_ab(ctx):
+    """A/B the compiled Pallas flash kernel vs the fused-XLA attention core
+    on hardware: fwd+bwd at flagship bench shapes (gpt2_small heads:
+    B=8, H=12, T=1024, D=64, causal). Records per-impl compile + step time
+    and the cross-impl numeric diff to results/attn_ab.json so the default
+    "auto" routing is backed by measurement, not hypothesis (the bench
+    ladder's rung 4 hypothesizes Mosaic is the unstable piece — this stage
+    answers whether it even compiles here, and which core is faster).
+    Runs AFTER the bench-grade record stage on purpose: a Mosaic hang in
+    this stage must not cost the round its samples/sec number."""
+    import json
+
+    jax = ctx["jax"]
+    import jax.numpy as jnp
+
+    from distributedvolunteercomputing_tpu.ops import attention
+
+    B, H, T, D = (
+        int(x) for x in os.environ.get("DVC_PROBE_AB_SHAPE", "8,12,1024,64").split(",")
+    )
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, T, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, T, D), jnp.float32)
+    results = {"shapes": f"B{B} H{H} T{T} D{D} causal f32",
+               "device_kind": jax.devices()[0].device_kind,
+               "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    outs = {}
+    for impl in ("xla", "flash"):
+        attention.set_attention_impl(impl)
+        try:
+            def loss(q, k, v):
+                o = attention.attention_core_local(q, k, v, causal=True)
+                return o.astype(jnp.float32).sum(), o
+
+            f = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True))
+            t0 = time.monotonic()
+            (_, out), grads = f(q, k, v)
+            jax.block_until_ready((out, grads))
+            compile_s = time.monotonic() - t0
+            iters = 20
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                (_, out), grads = f(q, k, v)
+            jax.block_until_ready((out, grads))
+            dt_ms = (time.perf_counter() - t0) / iters * 1e3
+            outs[impl] = (out, grads[0])
+            results[impl] = {
+                "ok": True,
+                "compile_s": round(compile_s, 2),
+                "fwd_bwd_ms": round(dt_ms, 3),
+            }
+        except Exception as err:  # noqa: BLE001 — one impl failing IS a result
+            results[impl] = {
+                "ok": False,
+                "error": f"{type(err).__name__}: {str(err)[:300]}",
+            }
+        finally:
+            attention.set_attention_impl("auto")
+    if len(outs) == 2:
+        results["max_abs_diff_fwd"] = float(
+            jnp.max(jnp.abs(outs["xla"][0] - outs["flash"][0]))
+        )
+        results["max_abs_diff_dq"] = float(
+            jnp.max(jnp.abs(outs["xla"][1] - outs["flash"][1]))
+        )
+        results["winner"] = min(
+            ("xla", "flash"), key=lambda i: results[i]["fwd_bwd_ms"]
+        )
+    elif results.get("xla", {}).get("ok"):
+        results["winner"] = "xla (flash failed)"
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results", "attn_ab.json"
+    )
+    with open(out_path, "w") as fh:
+        json.dump(results, fh, indent=1)
+    summary = {
+        i: (f"{results[i]['fwd_bwd_ms']}ms" if results.get(i, {}).get("ok") else "FAIL")
+        for i in ("xla", "flash")
+    }
+    return f"{summary} -> {out_path}"
+
+
 def main() -> int:
     max_stage = int(sys.argv[1]) if len(sys.argv) > 1 else len(STAGES)
     ctx: dict = {}

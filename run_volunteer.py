@@ -60,6 +60,10 @@ def main() -> None:
     ap.add_argument("--topk-frac", type=float, default=0.01,
                     help="fraction of gradient entries kept per round by "
                          "--wire topk")
+    ap.add_argument("--allow-unrobust-topk", action="store_true",
+                    help="permit --averaging byzantine with --wire topk, "
+                         "which runs a plain weighted mean (no Byzantine "
+                         "tolerance); otherwise that combination is refused")
     ap.add_argument("--overlap", action=argparse.BooleanOptionalAction, default=True,
                     help="overlap WAN averaging rounds with local compute "
                          "(params mode; --no-overlap restores blocking rounds)")
@@ -141,6 +145,7 @@ def main() -> None:
         average_what=args.average_what,
         wire=args.wire,
         topk_frac=args.topk_frac,
+        allow_unrobust_topk=args.allow_unrobust_topk,
         overlap=args.overlap,
         max_staleness=args.max_staleness,
         min_group=args.min_group,

@@ -671,3 +671,115 @@ class TestTopkScope:
             VolunteerConfig(wire="topk", average_what="params")
         # grads mode is fine
         VolunteerConfig(wire="topk", average_what="grads", averaging="sync")
+
+    def test_byzantine_topk_refused_without_optin(self):
+        """byzantine+topk forces method='mean', i.e. zero robustness under
+        the name 'byzantine' — the config must refuse it unless the caller
+        explicitly opts in (--allow-unrobust-topk)."""
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        with pytest.raises(ValueError, match="allow-unrobust-topk"):
+            VolunteerConfig(
+                wire="topk", average_what="grads", averaging="byzantine",
+                method="mean",
+            )
+        # explicit opt-in is accepted
+        VolunteerConfig(
+            wire="topk", average_what="grads", averaging="byzantine",
+            method="mean", allow_unrobust_topk=True,
+        )
+        # a robust estimator with topk is still a hard error (opt-in or not)
+        with pytest.raises(ValueError, match="mean"):
+            VolunteerConfig(
+                wire="topk", average_what="grads", averaging="byzantine",
+                method="trimmed_mean", allow_unrobust_topk=True,
+            )
+
+
+class TestSyncTopkEFDegraded:
+    def test_dropped_contribution_does_not_commit_residual(self):
+        """A member whose top-k push lands AFTER the leader's degraded
+        aggregation fetches a result but its shipped mass never entered the
+        aggregate — the fetch meta's included set must stop it from banking
+        the error-feedback residual (which would lose shipped+banked mass
+        together)."""
+        async def main():
+            vols = await spawn_volunteers(
+                3, SyncAverager, wire="topk", topk_frac=0.3,
+                gather_timeout=2.0, join_timeout=6.0, min_group=2,
+            )
+            late = vols[2][3]  # peer ids sort "vol0"<"vol1"<"vol2": never leader
+            orig_call = late.transport.call
+
+            async def delayed_call(addr, method, args=None, payload=b"", **kw):
+                if method == "sync.contribute":
+                    await asyncio.sleep(3.0)  # past the leader's 2s deadline
+                return await orig_call(addr, method, args, payload, **kw)
+
+            late.transport.call = delayed_call
+            try:
+                r0, r1, r2 = await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 0),
+                    vols[1][3].average(make_tree(2.0), 0),
+                    late.average(make_tree(3.0), 0),
+                )
+                # the on-time pair aggregated and committed their residuals
+                assert r0 is not None and r1 is not None
+                assert vols[0][3]._ef_residual is not None
+                assert vols[1][3]._ef_residual is not None
+                # the late member still fetched a result...
+                assert r2 is not None
+                # ...but was told its contribution was dropped, so its
+                # pending residual was NOT banked
+                assert late._contribution_included is False
+                assert late._ef_residual is None
+            finally:
+                await teardown(vols)
+
+        run(main())
+
+
+class TestButterflyStageCap:
+    def test_parked_stage_cap_bounds_remote_allocations(self):
+        """A remote can name any (epoch, stage) in bfly.exchange; each one
+        allocates stage state and pins the handler for stage_timeout. The
+        RPC path must sweep + cap parked entries (mirrors MAX_PARKED_ROUNDS
+        on the gather paths) so a peer that stops averaging can't grow
+        state without bound."""
+        async def main():
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=None)
+            mem = SwarmMembership(dht, "solo", ttl=10.0)
+            await mem.join()
+            bf = ButterflyAverager(t, dht, mem, stage_timeout=0.3)
+            payload = np.zeros(4, np.float32).tobytes()
+
+            async def fire(i):
+                try:
+                    await bf._rpc_exchange(
+                        {"epoch": f"bogus{i}", "stage": 0, "peer": "evil",
+                         "weight": 1.0},
+                        payload,
+                    )
+                    return "ok"
+                except RPCError as e:
+                    return "capped" if "cap" in str(e) else "rpc"
+                except asyncio.TimeoutError:
+                    return "parked"
+
+            try:
+                n_extra = 16
+                results = await asyncio.gather(
+                    *(fire(i) for i in range(bf.MAX_PARKED_ROUNDS + n_extra))
+                )
+                # over-cap exchanges are refused IMMEDIATELY (no pinned task)
+                assert results.count("capped") == n_extra, results
+                # under-cap ones parked until their stage_timeout expired
+                assert results.count("parked") == bf.MAX_PARKED_ROUNDS
+                # and the state dict never exceeded the cap
+                assert len(bf._stages) <= bf.MAX_PARKED_ROUNDS
+            finally:
+                await t.close()
+
+        run(main())

@@ -145,6 +145,62 @@ class TestTransport:
 
         assert run(main()) == "rejected"
 
+    def test_auth_rejects_replayed_request_frame(self):
+        """A captured request frame (e.g. a membership heartbeat) re-sent
+        within the auth window must be refused: every legitimate request
+        carries a fresh rid inside the MAC'd meta, so the server treats an
+        already-accepted MAC as a replay."""
+        import json as _json
+        import time as _time
+        import zlib as _zlib
+
+        from distributedvolunteercomputing_tpu.swarm.transport import (
+            _HEADER, MAGIC, TYPE_ERR, TYPE_REQ, TYPE_RESP, VERSION,
+        )
+
+        async def main():
+            server = Transport(secret=b"s3kr1t")
+            calls = []
+
+            async def ping(args, payload):
+                calls.append(args)
+                return {"ok": True}, b""
+
+            server.register("ping", ping)
+            addr = await server.start()
+            # Craft ONE authenticated request frame (what an eavesdropper
+            # inside the window holds), then send the identical bytes twice
+            # on two fresh connections.
+            signer = Transport(secret=b"s3kr1t")
+            meta = {
+                "rid": "feedfacefeedface", "method": "ping", "args": {"n": 1},
+                "ts": round(_time.time(), 3),
+            }
+            meta["auth"] = signer._mac(TYPE_REQ, meta, b"")
+            meta_b = _json.dumps(meta).encode()
+            frame = _HEADER.pack(
+                MAGIC, VERSION, TYPE_REQ, len(meta_b), 0,
+                _zlib.crc32(b"") & 0xFFFFFFFF,
+            ) + meta_b
+
+            async def send_raw():
+                reader, writer = await asyncio.open_connection(*addr)
+                try:
+                    writer.write(frame)
+                    await writer.drain()
+                    return await signer._read_frame(reader)
+                finally:
+                    writer.close()
+
+            ftype1, meta1, _ = await send_raw()
+            ftype2, meta2, _ = await send_raw()
+            await server.close()
+            assert ftype1 == TYPE_RESP and meta1["ret"] == {"ok": True}
+            assert ftype2 == TYPE_ERR and "replay" in meta2.get("error", "")
+            assert len(calls) == 1  # the handler ran exactly once
+
+        run(main())
+
     def test_unknown_method_raises(self):
         async def main():
             server = Transport()
