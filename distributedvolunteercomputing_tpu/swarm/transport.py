@@ -20,14 +20,19 @@ Design:
   swarm tier — DHT records, membership, state sync, and averaging
   contributions all cross this transport, so identity spoofing (which the
   Byzantine first-write-wins rule implicitly trusts) requires the secret,
-  not just an open port. Replay is closed at this layer too: every REQUEST
-  carries a fresh uuid ``rid`` inside the MAC'd meta, so legitimate request
-  frames are never byte-identical — the server remembers the MACs it has
-  accepted within the auth window and rejects duplicates outright (a
-  captured membership heartbeat or DHT announce can NOT be re-played to
-  keep a departed peer alive). Responses need no cache: per-call
-  connections mean a client reads exactly one response on its own stream,
-  and the MAC binds the echoed ``rid`` to this request.
+  not just an open port. Replay is closed at this layer too, on two axes:
+  SAME-NODE replay — every request carries a fresh uuid ``rid`` inside the
+  MAC'd meta, so legitimate request frames are never byte-identical, and
+  the server rejects an already-accepted MAC within the auth window;
+  CROSS-NODE replay — the MAC also binds ``dst`` (the address the caller
+  dialed), so a frame captured on its way to node X is refused by node Y
+  (a captured membership heartbeat or DHT announce can NOT be re-played
+  anywhere to keep a departed peer alive). Authenticated swarms must
+  therefore dial peers at their advertised addresses — which every code
+  path does (addresses always come from DHT/membership records).
+  Responses need no cache: per-call connections mean a client reads
+  exactly one response on its own stream, and the MAC binds the echoed
+  ``rid`` to this request.
 """
 
 from __future__ import annotations
@@ -179,11 +184,33 @@ class Transport:
             ts = meta.get("ts")
             if not isinstance(ts, (int, float)) or abs(time.time() - ts) > self._auth_window:
                 raise RPCError("auth failure (frame timestamp outside window)")
-            if ftype == TYPE_REQ and not self._mac_fresh(got, float(ts)):
-                # A fresh rid is in every legitimate request's MAC'd meta,
-                # so an identical MAC within the window is a replay.
-                raise RPCError("auth failure (replayed request frame)")
+            if ftype == TYPE_REQ:
+                if not self._dst_is_me(meta.get("dst")):
+                    # The MAC binds the address the caller DIALED: a frame
+                    # captured en route to another node must not be
+                    # replayable here (per-node seen-MAC caches can't see
+                    # each other).
+                    raise RPCError("auth failure (frame addressed to a different node)")
+                if not self._mac_fresh(got, float(ts)):
+                    # A fresh rid is in every legitimate request's MAC'd
+                    # meta, so an identical MAC within the window is a
+                    # replay.
+                    raise RPCError("auth failure (replayed request frame)")
         return ftype, meta, payload
+
+    def _dst_is_me(self, dst) -> bool:
+        """Is the MAC'd destination this node? Port must match the bound
+        port; the host may be any name this node is legitimately dialed by
+        (advertised, bound, or loopback). Alias sets of distinct nodes
+        cannot collide: same machine implies distinct ports, distinct
+        machines implies distinct hosts."""
+        if not (isinstance(dst, (list, tuple)) and len(dst) == 2):
+            return False
+        host, port = dst
+        if port != self._port:
+            return False
+        aliases = {self._advertise_host, self._host, "127.0.0.1", "localhost"}
+        return host in aliases
 
     # Hard cap on remembered request MACs: ~5 MB worst case, and at any
     # realistic RPC rate the age-based pruning keeps it far smaller.
@@ -276,8 +303,14 @@ class Transport:
             reader, writer = await asyncio.open_connection(*addr)
             try:
                 rid = uuid.uuid4().hex[:16]
+                # dst (the dialed address) rides inside the MAC'd meta so an
+                # authenticated frame is only acceptable at the node it was
+                # sent to (see module doc: cross-node replay).
                 await self._write_frame(
-                    writer, TYPE_REQ, {"rid": rid, "method": method, "args": args or {}}, payload
+                    writer, TYPE_REQ,
+                    {"rid": rid, "method": method, "args": args or {},
+                     "dst": [addr[0], addr[1]]},
+                    payload,
                 )
                 ftype, meta, resp_payload = await self._read_frame(reader)
                 # Errors first: a frame-level rejection (corrupt request) has

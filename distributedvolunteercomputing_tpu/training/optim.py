@@ -21,7 +21,9 @@ def make_optimizer(
     grad_clip: Optional[float] = 1.0,
     momentum: float = 0.9,
 ) -> optax.GradientTransformation:
-    if total_steps and total_steps > warmup_steps:
+    # optax needs decay_steps strictly past warmup (warmup is clamped to >=1
+    # below, so a 1-step run would otherwise ask for a 0-step cosine decay).
+    if total_steps and total_steps > max(warmup_steps, 1):
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0,
             peak_value=lr,

@@ -168,13 +168,23 @@ class TestTransport:
 
             server.register("ping", ping)
             addr = await server.start()
+            # A second node in the same swarm (same secret): the captured
+            # frame must be unusable there too (cross-node replay).
+            other = Transport(secret=b"s3kr1t")
+
+            async def ping2(args, payload):
+                calls.append(("other", args))
+                return {"ok": True}, b""
+
+            other.register("ping", ping2)
+            other_addr = await other.start()
             # Craft ONE authenticated request frame (what an eavesdropper
             # inside the window holds), then send the identical bytes twice
             # on two fresh connections.
             signer = Transport(secret=b"s3kr1t")
             meta = {
                 "rid": "feedfacefeedface", "method": "ping", "args": {"n": 1},
-                "ts": round(_time.time(), 3),
+                "dst": [addr[0], addr[1]], "ts": round(_time.time(), 3),
             }
             meta["auth"] = signer._mac(TYPE_REQ, meta, b"")
             meta_b = _json.dumps(meta).encode()
@@ -183,8 +193,8 @@ class TestTransport:
                 _zlib.crc32(b"") & 0xFFFFFFFF,
             ) + meta_b
 
-            async def send_raw():
-                reader, writer = await asyncio.open_connection(*addr)
+            async def send_raw(to):
+                reader, writer = await asyncio.open_connection(*to)
                 try:
                     writer.write(frame)
                     await writer.drain()
@@ -192,12 +202,17 @@ class TestTransport:
                 finally:
                     writer.close()
 
-            ftype1, meta1, _ = await send_raw()
-            ftype2, meta2, _ = await send_raw()
+            ftype1, meta1, _ = await send_raw(addr)
+            ftype2, meta2, _ = await send_raw(addr)
+            ftype3, meta3, _ = await send_raw(other_addr)
             await server.close()
+            await other.close()
             assert ftype1 == TYPE_RESP and meta1["ret"] == {"ok": True}
+            # same-node replay: rejected by the seen-MAC cache
             assert ftype2 == TYPE_ERR and "replay" in meta2.get("error", "")
-            assert len(calls) == 1  # the handler ran exactly once
+            # cross-node replay: rejected by the MAC'd dst binding
+            assert ftype3 == TYPE_ERR and "different node" in meta3.get("error", "")
+            assert len(calls) == 1  # the handler ran exactly once, on one node
 
         run(main())
 
