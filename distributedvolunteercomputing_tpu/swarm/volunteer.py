@@ -72,6 +72,9 @@ class VolunteerConfig:
     init_seed: int = 0  # TASK-constant: shared initial params (see Trainer)
     steps: int = 1000
     target_loss: Optional[float] = None
+    # "stop" ends the run at the target; "record" trains the full --steps
+    # and reports when the target was first crossed (time-to-target-loss).
+    target_mode: str = "stop"
     eval_every: int = 0  # 0 = no held-out evaluation
     eval_batches: int = 4
     metrics_path: Optional[str] = None
@@ -265,6 +268,7 @@ class Volunteer:
                     save_async(trainer, ckpt_dir)
 
         data = None
+        eval_data = None
         if self.cfg.data_path:
             import zlib
 
@@ -274,10 +278,15 @@ class Volunteer:
             # data: every volunteer sees the full file in a different order.
             # crc32, not hash(): PYTHONHASHSEED randomization would make the
             # per-peer order non-reproducible across restarts.
-            data = npz_batch_iter(
-                self.cfg.data_path, self.cfg.batch_size,
-                seed=zlib.crc32(self.cfg.peer_id.encode()) & 0x7FFFFFFF,
-            )
+            data_seed = zlib.crc32(self.cfg.peer_id.encode()) & 0x7FFFFFFF
+            data = npz_batch_iter(self.cfg.data_path, self.cfg.batch_size, seed=data_seed)
+            if self.cfg.eval_every:
+                # Independent shuffled stream over the same file: eval draws
+                # never perturb the training order (matches the synthetic
+                # path's separate-rng held-out semantics).
+                eval_data = npz_batch_iter(
+                    self.cfg.data_path, self.cfg.batch_size, seed=data_seed ^ 0x5EED
+                )
         mesh = None
         if self.cfg.mesh:
             from distributedvolunteercomputing_tpu.parallel.mesh import (
@@ -310,6 +319,7 @@ class Volunteer:
             on_step=on_step,
             eval_every=self.cfg.eval_every,
             eval_batches=self.cfg.eval_batches,
+            eval_data=eval_data,
         )
         if self.cfg.checkpoint_dir:
             from distributedvolunteercomputing_tpu.training.checkpoint import maybe_restore
@@ -338,7 +348,27 @@ class Volunteer:
             # mid-training would hit deleted arrays.
             def provider():
                 step, params = self.trainer.host_snapshot()
-                return step, bundle.avg_select(params)
+                tree = bundle.avg_select(params)
+                # Fault-injection hook (SURVEY.md §5), the state-sync twin of
+                # DVC_CHAOS_CONTRIB_SCALE: "lie,scale" makes this volunteer a
+                # BYZANTINE state provider — it announces/serves step+lie
+                # (pull targets the freshest provider, so a big lie attracts
+                # every rejoiner) and serves its real tree scaled by `scale`:
+                # IN-RANGE garbage the puller's sanity guard cannot catch
+                # (finite, bounded), the exact case where the rejoiner's only
+                # defense is its next robust averaging round (state_sync.py
+                # trust model). Test-only; unset in production.
+                poison = os.environ.get("DVC_CHAOS_STATE_POISON")
+                if poison:
+                    import jax
+                    import numpy as np
+
+                    lie, scale = (float(x) for x in poison.split(","))
+                    tree = jax.tree_util.tree_map(
+                        lambda a: np.asarray(a, np.float32) * scale, tree
+                    )
+                    step = int(step + lie)
+                return step, tree
 
             self.state_sync.set_provider(provider)
             pulled = await self.state_sync.pull(
@@ -405,6 +435,7 @@ class Volunteer:
         result = self.trainer.run(
             steps=self.cfg.steps,
             target_loss=self.cfg.target_loss,
+            target_mode=self.cfg.target_mode,
             stop_flag=self._stop.is_set,
         )
         if self.cfg.checkpoint_dir:

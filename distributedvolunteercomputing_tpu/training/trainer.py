@@ -25,7 +25,7 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import time
-from typing import Any, Callable, Dict, Iterable, Optional
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import jax
 import numpy as np
@@ -89,11 +89,14 @@ class Trainer:
         # Periodic held-out evaluation: every ``eval_every`` steps, mean loss
         # over ``eval_batches`` batches WITHOUT updating params, recorded as
         # an "eval" metrics event. With synthetic data the eval stream is an
-        # independent rng stream (true held-out); with a custom ``data``
-        # iterable, eval consumes its next batches (loss-before-update on
-        # data the optimizer hasn't seen).
+        # independent rng stream (true held-out). With a custom ``data``
+        # iterable, pass ``eval_data`` (an independently shuffled stream over
+        # the same dataset) for matching semantics; without it, eval falls
+        # back to consuming ``data``'s next batches — loss-before-update,
+        # but it perturbs the training order volunteers were promised.
         eval_every: int = 0,
         eval_batches: int = 4,
+        eval_data: Optional[Iterable[Batch]] = None,
     ):
         if eval_every and eval_batches < 1:
             raise ValueError(f"eval_batches must be >= 1, got {eval_batches}")
@@ -191,6 +194,8 @@ class Trainer:
         self.eval_batches = eval_batches
         self._eval_fn = None
         self._it: Optional[Any] = None
+        self._eval_data = eval_data
+        self._eval_it: Optional[Any] = None
         # Held-out stream: a distinct fold of the volunteer seed, so eval
         # batches never collide with any training batch at any seed.
         self._eval_rng = jax.random.fold_in(data_rng, 0x5EED)
@@ -276,7 +281,16 @@ class Trainer:
         total = 0.0
         done = 0
         for _ in range(n):
-            if self._data is not None:
+            if self._eval_data is not None:
+                # Dedicated held-out stream (independently shuffled over the
+                # same dataset): training batch order is untouched by eval.
+                if self._eval_it is None:
+                    self._eval_it = iter(self._eval_data)
+                try:
+                    batch = next(self._eval_it)
+                except StopIteration:
+                    break  # finite eval set exhausted
+            elif self._data is not None:
                 if self._it is None:  # standalone use before run()
                     self._it = iter(self._data)
                 try:
@@ -398,10 +412,20 @@ class Trainer:
         self,
         steps: int,
         target_loss: Optional[float] = None,
+        target_mode: str = "stop",
         log_every: int = 50,
         stop_flag: Optional[Callable[[], bool]] = None,
     ) -> Dict[str, float]:
-        """Train for ``steps`` (or until ``target_loss``); returns summary."""
+        """Train for ``steps``; returns summary.
+
+        ``target_loss`` with ``target_mode="stop"`` ends the run at the
+        first crossing (config-1 semantics); with ``"record"`` the run keeps
+        going for the full ``steps`` and the summary reports WHEN the target
+        was first crossed (``target_crossed_step`` / ``target_crossed_s``) —
+        the time-to-target-loss half of the driver metric (BASELINE.json:2)
+        measured without giving up the fixed-steps throughput row."""
+        if target_mode not in ("stop", "record"):
+            raise ValueError(f"unknown target_mode {target_mode!r}")
         it = iter(self._data) if self._data is not None else iter(self.data_iter())
         self._it = it  # evaluate() draws from the same iterator for custom data
         # Tracing hook (SURVEY.md §5): DVC_PROFILE_DIR=<dir> captures a
@@ -425,6 +449,7 @@ class Trainer:
         start_step = int(self.state.step)
         t_start = time.monotonic()
         ran_steps = 0
+        target_crossed: Optional[Tuple[int, float]] = None  # (step, wall_s)
         for i in range(steps):
             if stop_flag is not None and stop_flag():
                 log.info("stop flag set; exiting train loop at step %d", int(self.state.step))
@@ -511,8 +536,19 @@ class Trainer:
                     self.metrics.samples_per_sec(),
                 )
             if target_loss is not None and last_loss <= target_loss:
-                log.info("target loss %.4f reached at step %d", target_loss, step_no)
-                break
+                if target_crossed is None:
+                    target_crossed = (step_no, time.monotonic() - t_start)
+                    log.info(
+                        "target loss %.4f reached at step %d (%.1fs)",
+                        target_loss, step_no, target_crossed[1],
+                    )
+                    self.metrics.record_event(
+                        step_no, "target_crossed",
+                        {"target_loss": target_loss,
+                         "wall_s": round(target_crossed[1], 3)},
+                    )
+                if target_mode == "stop":
+                    break
         if profiling:  # loop ended inside the trace window
             jax.profiler.stop_trace()
         # Drain an in-flight round so the returned params are contracted and
@@ -522,9 +558,16 @@ class Trainer:
         if m is not None:
             last_loss = float(m["loss"])  # sync once at the end regardless
         wall = time.monotonic() - t_start
-        return {
+        summary = {
             "final_loss": last_loss,
             "steps": int(self.state.step),
             "wall_time_s": wall,
             "samples_per_sec": ran_steps * self.batch_size / wall if wall > 0 else 0.0,
         }
+        if target_loss is not None:
+            summary["target_loss"] = target_loss
+            summary["target_crossed_step"] = target_crossed[0] if target_crossed else None
+            summary["target_crossed_s"] = (
+                round(target_crossed[1], 3) if target_crossed else None
+            )
+        return summary

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Deterministic .npz dataset generator for the file-backed data path.
+
+The sandbox has zero egress (training/data.py:3), so the real MNIST/CIFAR
+files the reference's configs 1-2 train on (BASELINE.json:7-8) cannot be
+downloaded. This writes datasets with the SAME shapes and the same
+class-conditional-blob learnability recipe as the synthetic stream, but as a
+fixed finite file — which is what actually exercises the ``--data`` path end
+to end: np.load, key/schema validation, per-peer shuffle sharding, epoch
+reshuffles, partial-batch dropping, and the separate held-out eval stream.
+
+Deterministic by construction (fixed default seed, no clock): two calls with
+the same arguments produce byte-identical files.
+
+Usage:
+  python experiments/make_npz.py --task mnist --out /tmp/mnist.npz
+  python experiments/make_npz.py --task cifar10 --out /tmp/cifar.npz --n 2048
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+SHAPES = {
+    # task: (x shape per example, n_classes) — mnist flat [784] (the MLP
+    # reshapes anyway), cifar10 NHWC [32, 32, 3] (the resnet stem wants it).
+    "mnist": ((784,), 10),
+    "cifar10": ((32, 32, 3), 10),
+}
+
+
+def make(task: str, n: int, seed: int, noise: float = 0.3) -> dict:
+    shape, n_classes = SHAPES[task]
+    rng = np.random.default_rng(seed)
+    protos = rng.standard_normal((n_classes,) + shape, dtype=np.float32)
+    y = rng.integers(0, n_classes, size=n)
+    x = protos[y] + noise * rng.standard_normal((n,) + shape, dtype=np.float32)
+    return {"x": x.astype(np.float32), "y": y.astype(np.int32)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--task", choices=sorted(SHAPES), required=True)
+    ap.add_argument("--out", required=True, help="output .npz path")
+    ap.add_argument("--n", type=int, default=4096, help="number of examples")
+    ap.add_argument("--seed", type=int, default=1234)
+    args = ap.parse_args()
+    data = make(args.task, args.n, args.seed)
+    np.savez(args.out, **data)
+    print(
+        f"{args.out}: x{data['x'].shape} y{data['y'].shape} "
+        f"(task={args.task} seed={args.seed})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,10 @@ def main() -> None:
                          "across the swarm (for LoRA it pins the shared frozen base)")
     ap.add_argument("--steps", type=int, default=1000)
     ap.add_argument("--target-loss", type=float, default=None)
+    ap.add_argument("--target-mode", default="stop", choices=("stop", "record"),
+                    help="stop: end the run at --target-loss; record: train "
+                         "the full --steps and report when the target was "
+                         "first crossed (time-to-target-loss)")
     ap.add_argument("--eval-every", type=int, default=0,
                     help="held-out eval cadence in steps (0 = off); mean "
                          "loss over --eval-batches recorded as an 'eval' "
@@ -165,6 +169,7 @@ def main() -> None:
         init_seed=args.init_seed,
         steps=args.steps,
         target_loss=args.target_loss,
+        target_mode=args.target_mode,
         eval_every=args.eval_every,
         eval_batches=args.eval_batches,
         metrics_path=args.metrics,

@@ -92,6 +92,26 @@ class TestTrainerLocalSGD:
         t = Trainer(get_model("mnist_mlp"), batch_size=32, lr=1e-2, optimizer="adam", seed=1)
         summary = t.run(steps=500, target_loss=10.0, log_every=0)  # trivially satisfied
         assert summary["steps"] == 1
+        assert summary["target_crossed_step"] == 1
+        assert summary["target_crossed_s"] is not None
+
+    def test_target_mode_record_trains_full_budget(self):
+        """time-to-target-loss (BASELINE.json:2): record mode reports the
+        first crossing but keeps training the full step budget, so one run
+        yields BOTH the fixed-steps throughput row and the crossing time."""
+        t = Trainer(get_model("mnist_mlp"), batch_size=32, lr=1e-2, optimizer="adam", seed=1)
+        summary = t.run(steps=12, target_loss=10.0, target_mode="record", log_every=0)
+        assert summary["steps"] == 12  # did NOT stop at the (trivial) target
+        assert summary["target_crossed_step"] == 1
+        assert summary["target_crossed_s"] >= 0.0
+        # an unreachable target records a null crossing, not a crash
+        t2 = Trainer(get_model("mnist_mlp"), batch_size=32, lr=1e-2, optimizer="adam", seed=1)
+        s2 = t2.run(steps=3, target_loss=-1.0, target_mode="record", log_every=0)
+        assert s2["target_crossed_step"] is None and s2["target_crossed_s"] is None
+        import pytest
+
+        with pytest.raises(ValueError, match="target_mode"):
+            t2.run(steps=1, target_mode="bogus")
 
     def test_checkpoint_gc_keeps_last_n(self, tmp_path, monkeypatch):
         """Periodic saves must not grow the directory without bound: after

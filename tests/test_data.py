@@ -97,3 +97,58 @@ def test_trainer_runs_on_npz(tmp_path):
     summary = t.run(steps=30, log_every=0)
     assert np.isfinite(summary["final_loss"])
     assert int(t.state.step) == 30
+
+
+def test_eval_stream_does_not_perturb_training(tmp_path):
+    """With a dedicated eval_data stream, periodic eval must leave the
+    training batch order untouched: two trainers with identical seeds — one
+    evaluating every step, one never — end at bit-identical params. (The
+    legacy fallback without eval_data consumes training batches, which this
+    test would catch as a param divergence.)"""
+    import jax
+
+    from distributedvolunteercomputing_tpu.models import get_model
+    from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+    path = _write_npz(tmp_path / "mnist.npz", n=64)
+
+    def make_trainer(eval_every):
+        return Trainer(
+            get_model("mnist_mlp"), batch_size=16, lr=1e-2, seed=7,
+            data=npz_batch_iter(path, 16, seed=3),
+            eval_every=eval_every, eval_batches=2,
+            eval_data=npz_batch_iter(path, 16, seed=99) if eval_every else None,
+        )
+
+    t_eval = make_trainer(eval_every=1)
+    t_plain = make_trainer(eval_every=0)
+    t_eval.run(steps=6, log_every=0)
+    t_plain.run(steps=6, log_every=0)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(t_eval.state.params),
+        jax.tree_util.tree_leaves(t_plain.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_make_npz_deterministic(tmp_path):
+    """experiments/make_npz.py: same args -> byte-identical file content."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "experiments", "make_npz.py")
+    outs = []
+    for name in ("a.npz", "b.npz"):
+        out = tmp_path / name
+        subprocess.run(
+            [sys.executable, script, "--task", "mnist", "--out", str(out),
+             "--n", "128"],
+            check=True, capture_output=True,
+        )
+        with np.load(out) as d:
+            outs.append({k: d[k].copy() for k in d})
+    np.testing.assert_array_equal(outs[0]["x"], outs[1]["x"])
+    np.testing.assert_array_equal(outs[0]["y"], outs[1]["y"])
+    assert outs[0]["x"].shape == (128, 784)
