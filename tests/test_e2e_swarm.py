@@ -389,6 +389,71 @@ class TestSwarmE2E:
                 if v.poll() is None:
                     v.kill()
 
+    def test_rejoiner_converges_despite_poisoned_state_pull(self):
+        """Adversarial state sync (the trust model's residual risk,
+        state_sync.py:31-40): a byzantine provider announces a wildly
+        inflated step — so every rejoiner targets it — and serves IN-RANGE
+        garbage (its real params sign-flipped: finite, magnitude-bounded,
+        invisible to the sanity guard). The rejoiner must adopt the poison
+        (verified from its log) and then converge anyway: its next
+        byzantine rounds contract it to the robust aggregate, and the
+        honest-majority trimmed mean discards its outlier contribution."""
+        coord, addr = start_coordinator()
+        vols = []
+        try:
+            common = [
+                "--averaging", "byzantine", "--method", "trimmed_mean",
+                "--average-every", "6", "--min-group", "2",
+                "--join-timeout", "20", "--gather-timeout", "15",
+            ]
+
+            def start_bg(peer_id, extra, env_extra=None):
+                # Background providers: stdout to DEVNULL — they log a line
+                # per round for up to 2000 steps and nobody drains their
+                # pipe; a full 64KB pipe buffer would block a provider's
+                # next log write and wedge it mid-test.
+                env = _env()
+                env.update(env_extra or {})
+                return subprocess.Popen(
+                    [sys.executable, os.path.join(REPO, "run_volunteer.py"),
+                     "--coordinator", addr, "--peer-id", peer_id,
+                     "--batch-size", "16", "--lr", "0.01", *TINY_MLP,
+                     *common, *extra],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    env=env,
+                )
+
+            # Providers run effectively forever (killed at teardown; only the
+            # rejoiner is awaited) — under CPU contention a jax subprocess
+            # can take a minute to come up, and a provider that finishes and
+            # LEAVES before the rejoiner's pull would vacuously pass the
+            # no-candidates path instead of exercising the poisoned pull.
+            vols = [start_bg(f"honest{i}", ["--steps", "2000", "--seed", str(i)])
+                    for i in range(3)]
+            vols.append(start_bg(
+                "poisoner", ["--steps", "2000", "--seed", "9"],
+                {"DVC_CHAOS_STATE_POISON": "1000,-1"},
+            ))
+            time.sleep(12)  # swarm trains; the poisoner's lying announce is out
+            rejoiner = start_volunteer(
+                addr, "rejoiner", common + ["--steps", "30", "--seed", "5"]
+            )
+            vols.append(rejoiner)
+            s, out = wait_done(rejoiner, timeout=240)
+            # The poisoned pull actually happened: targeted the liar's step.
+            m = re.search(r"pulled state at step (\d+) from poisoner", out)
+            assert m, f"rejoiner never pulled from the poisoner:\n{out[-2000:]}"
+            assert int(m.group(1)) > 900, m.group(0)
+            # ...and robust rounds contracted it back to the swarm anyway.
+            assert s["rounds_ok"] >= 1, out
+            assert s["final_loss"] == s["final_loss"], out  # not NaN
+            assert s["final_loss"] < 1.5, out  # well under the ~2.3 chance line
+        finally:
+            coord.kill()
+            for v in vols:
+                if v.poll() is None:
+                    v.kill()
+
     def test_sigterm_preemption_graceful(self, tmp_path):
         """SIGTERM (TPU-VM preemption notice) -> checkpoint + clean exit."""
         ckpt = str(tmp_path / "ckpt")
