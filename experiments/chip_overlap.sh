@@ -1,0 +1,80 @@
+#!/bin/bash
+# Chip-window agenda item (VERDICT r3 weak #4): settle the compute/WAN
+# overlap criterion ON HARDWARE. The localhost matrix can't — on one shared
+# CPU core the "device" compute and the averaging round contend for the same
+# cycles, so the measured overlap ratio (0.71-0.75) conflates averaging cost
+# with scheduling. On a real chip the device computes while the HOST runs the
+# round, which is the whole point of the overlap design (trainer.py
+# _launch_overlap_round).
+#
+# Topology: volunteer A on the TPU chip, volunteer B on CPU (a heterogeneous
+# swarm — also exercises mixed-backend averaging, which no committed artifact
+# shows yet). Three measurements of A's samples/sec:
+#   1. baseline: A alone, no averaging
+#   2. overlapped sync rounds with B (the default)
+#   3. blocking rounds (--no-overlap)
+# Criterion: (2) >= 0.90 x (1).
+#
+# Run INSIDE a good chip window (chip_watcher.sh finds one):
+#   bash experiments/chip_overlap.sh
+# Results APPEND to experiments/results/chip_overlap.jsonl; tags already
+# recorded are skipped, so a sweep interrupted by a wedge resumes where it
+# left off instead of discarding the evidence it already captured.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+OUT=experiments/results/chip_overlap.jsonl
+touch "$OUT"
+MODEL="--model gpt2_small --model-override n_layers=4 --model-override d_model=256 \
+ --model-override n_heads=4 --model-override d_ff=1024 --model-override vocab=8192 \
+ --model-override max_len=256"
+STEPS="--steps 120 --batch-size 16 --lr 1e-4"
+AVG="--averaging sync --average-every 10 --join-timeout 25 --gather-timeout 60"
+
+run_tpu() { # $1=tag  $2...=extra args for the TPU volunteer
+    local tag=$1; shift
+    if grep -q "\"tag\": \"$tag\", \"summary\"" "$OUT"; then
+        echo "tag $tag already recorded; skipping"
+        return
+    fi
+    python coordinator.py >"/tmp/co_$tag.log" 2>&1 &
+    local cpid=$!
+    local addr=""
+    for _ in $(seq 60); do  # jax import alone can take tens of seconds under load
+        addr=$(grep -o "COORDINATOR_READY .*" "/tmp/co_$tag.log" | awk '{print $2}')
+        [ -n "$addr" ] && break
+        sleep 2
+    done
+    if [ -z "$addr" ]; then echo "{\"tag\": \"$tag\", \"error\": \"no coordinator\"}" >>"$OUT"; kill $cpid 2>/dev/null; return; fi
+    # CPU peer (only for averaging tags)
+    local bpid=""
+    if [ "$tag" != "baseline" ]; then
+        JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python run_volunteer.py \
+            --coordinator "$addr" --peer-id cpu-peer $MODEL $STEPS $AVG --seed 1 \
+            >"/tmp/vb_$tag.log" 2>&1 &
+        bpid=$!
+    fi
+    # TPU volunteer (default platform = the axon chip; 25 min cap)
+    timeout 1500 python run_volunteer.py --coordinator "$addr" --peer-id tpu-vol \
+        $MODEL $STEPS --seed 0 "$@" >"/tmp/va_$tag.log" 2>&1
+    local sps
+    sps=$(grep -o 'VOLUNTEER_DONE .*' "/tmp/va_$tag.log" | sed 's/VOLUNTEER_DONE //')
+    if [ -n "$sps" ]; then
+        echo "{\"tag\": \"$tag\", \"summary\": $sps}" >>"$OUT"
+    else
+        # JSON-escape the log tail properly (backslashes/control chars in a
+        # traceback would otherwise produce an unparseable jsonl line).
+        tail -c 200 "/tmp/va_$tag.log" \
+            | python -c "import json,sys; print(json.dumps({\"tag\": \"$tag\", \"error\": sys.stdin.read()}))" \
+            >>"$OUT"
+    fi
+    # Scoped cleanup: kill only THIS run's processes (a blanket pkill would
+    # take down unrelated e2e/matrix volunteers running elsewhere).
+    kill $cpid $bpid 2>/dev/null
+    sleep 2
+}
+
+run_tpu baseline --averaging none
+run_tpu overlap $AVG --overlap
+run_tpu blocking $AVG --no-overlap
+echo "chip_overlap done:"
+cat "$OUT"

@@ -7,6 +7,16 @@
 # Background: the chip answers some fresh processes and wedges for hours at a
 # time (BENCH_r01..r03 history). This watcher turns "hope bench.py catches a
 # good window at round end" into "catch any good window all session".
+#
+# Probe cadence backs off exponentially (4 min -> 32 min cap) while the chip
+# stays wedged: the round-3 session's ONE good window came BEFORE the
+# watcher existed, and 13+ hours of constant ~4-minute probe cycles — each
+# of which SIGKILLs a client mid-backend-init when the timeout fires —
+# never saw another. Killing a client mid-init is the one thing observed to
+# EXTEND wedges (memory: axon-chip-quirks), so aggressive polling may have
+# been keeping the chip down. Backoff trades detection latency (<= 32 min,
+# cheap against a multi-hour window) for real recovery gaps. Any successful
+# init resets the cadence to fast.
 cd /root/repo || exit 1
 mkdir -p experiments/results
 LOG=experiments/results/chip_watcher.log
@@ -14,15 +24,21 @@ OUT=experiments/results/tpu_probe_success.json
 # A record left over from a previous round must not satisfy this round's
 # loop (the workdir persists across rounds) — set it aside at startup.
 [ -f "$OUT" ] && mv "$OUT" "$OUT.prev"
-echo "$(date +%T) watcher start" >>"$LOG"
+echo "$(date +%T) watcher start (backoff mode)" >>"$LOG"
+SLEEP=90
 while [ ! -f "$OUT" ]; do
     if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "$(date +%T) chip ALIVE -> staged probe" >>"$LOG"
         timeout 900 python experiments/chip_probe.py >>"$LOG" 2>&1
         echo "$(date +%T) probe rc=$?" >>"$LOG"
+        SLEEP=90  # chip is answering: go back to fast cadence
     else
-        echo "$(date +%T) wedged (init no answer in 150s)" >>"$LOG"
+        echo "$(date +%T) wedged (init no answer in 150s); next probe in ${SLEEP}s" >>"$LOG"
+        [ -f "$OUT" ] || sleep "$SLEEP"
+        SLEEP=$((SLEEP * 2))
+        [ "$SLEEP" -gt 1800 ] && SLEEP=1800
+        continue
     fi
-    [ -f "$OUT" ] || sleep 90
+    [ -f "$OUT" ] || sleep "$SLEEP"
 done
 echo "$(date +%T) SUCCESS recorded; watcher exiting" >>"$LOG"

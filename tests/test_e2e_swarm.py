@@ -46,11 +46,16 @@ def start_coordinator(extra=()):
     raise RuntimeError("coordinator did not become ready")
 
 
-def start_volunteer(coord_addr, peer_id, extra, env_extra=None):
+def start_volunteer(coord_addr, peer_id, extra, env_extra=None, capture=True):
+    """``capture=False`` routes output to DEVNULL — for background
+    volunteers nobody wait_done()s: an undrained PIPE fills its 64KB kernel
+    buffer and blocks the volunteer's next log write mid-run."""
     env = _env()
     if env_extra:
         env.update(env_extra)
     coord = ["--coordinator", coord_addr] if coord_addr else []
+    out = subprocess.PIPE if capture else subprocess.DEVNULL
+    err = subprocess.STDOUT if capture else subprocess.DEVNULL
     return subprocess.Popen(
         [
             sys.executable, os.path.join(REPO, "run_volunteer.py"),
@@ -61,8 +66,37 @@ def start_volunteer(coord_addr, peer_id, extra, env_extra=None):
             *TINY_MLP,
             *extra,
         ],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        stdout=out, stderr=err, text=True, env=env,
     )
+
+
+def wait_swarm_alive(coord_addr, n, timeout=180):
+    """Poll the coordinator's coord.status until >= n peers are alive —
+    deterministic readiness instead of sleep(): under CPU contention a jax
+    subprocess can take a minute to come up."""
+    import asyncio
+
+    from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+    host, _, port = coord_addr.rpartition(":")
+
+    async def poll():
+        t = Transport()
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    ret, _ = await t.call((host, int(port)), "coord.status", timeout=5.0)
+                    if int(ret.get("n_alive", 0)) >= n:
+                        return True
+                except Exception:
+                    pass
+                await asyncio.sleep(2.0)
+            return False
+        finally:
+            await t.close()
+
+    return asyncio.run(poll())
 
 
 def wait_done(proc, timeout=180):
@@ -407,43 +441,64 @@ class TestSwarmE2E:
                 "--join-timeout", "20", "--gather-timeout", "15",
             ]
 
-            def start_bg(peer_id, extra, env_extra=None):
-                # Background providers: stdout to DEVNULL — they log a line
-                # per round for up to 2000 steps and nobody drains their
-                # pipe; a full 64KB pipe buffer would block a provider's
-                # next log write and wedge it mid-test.
-                env = _env()
-                env.update(env_extra or {})
-                return subprocess.Popen(
-                    [sys.executable, os.path.join(REPO, "run_volunteer.py"),
-                     "--coordinator", addr, "--peer-id", peer_id,
-                     "--batch-size", "16", "--lr", "0.01", *TINY_MLP,
-                     *common, *extra],
-                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-                    env=env,
-                )
-
             # Providers run effectively forever (killed at teardown; only the
             # rejoiner is awaited) — under CPU contention a jax subprocess
             # can take a minute to come up, and a provider that finishes and
             # LEAVES before the rejoiner's pull would vacuously pass the
             # no-candidates path instead of exercising the poisoned pull.
-            vols = [start_bg(f"honest{i}", ["--steps", "2000", "--seed", str(i)])
-                    for i in range(3)]
-            vols.append(start_bg(
-                "poisoner", ["--steps", "2000", "--seed", "9"],
-                {"DVC_CHAOS_STATE_POISON": "1000,-1"},
+            # capture=False: nobody drains their output.
+            #
+            # Topology is deliberately minimal (1 honest + poisoner +
+            # rejoiner): every extra jax process on the one shared core
+            # stretches the honest leader's round cadence from seconds to
+            # minutes, and the rejoiner's begin-wait windows stop aligning
+            # with it (observed as flaky 'no begin from leader' skips at
+            # 4-5 processes).
+            #
+            # Order matters too: the honest peer FIRST, poisoner only after
+            # it's alive. Startup pulls are how the poison spreads — an
+            # honest peer booting after the poisoner would pull the lie
+            # itself and re-announce the inflated step under its own
+            # (honest) id, and the rejoiner would then pull honest params
+            # from it (observed in an earlier run of this test).
+            # --steps is effectively unbounded: on a QUIET machine this tiny
+            # model trains at thousands of steps/s, so a "large" finite
+            # budget (4000) is gone in seconds and the providers are dead
+            # before the rejoiner's jax import finishes — observed as the
+            # rejoiner pulling fine and then failing every round against an
+            # empty swarm.
+            vols = [start_volunteer(
+                addr, "honest0", common + ["--steps", "100000000", "--seed", "0"],
+                capture=False,
+            )]
+            assert wait_swarm_alive(addr, 1), "honest provider never came up"
+            # Lie far above any honest announce in this test's lifetime
+            # (the poisoner adds it to its own live step, so it stays ahead
+            # of honest peers training at the same rate).
+            vols.append(start_volunteer(
+                addr, "poisoner",
+                common + ["--steps", "100000000", "--seed", "9"],
+                {"DVC_CHAOS_STATE_POISON": "1000000000,-1"}, capture=False,
             ))
-            time.sleep(12)  # swarm trains; the poisoner's lying announce is out
+            assert wait_swarm_alive(addr, 2), "poisoner never came up"
+            time.sleep(3)  # join -> state announce gap
+            # Blocking rounds (--no-overlap): the rejoiner's local steps are
+            # ~ms each post-adoption, so overlapped mode would fire exactly
+            # ONE round attempt for the whole run — whether it aligns with
+            # the honest leader's next begin is a coin flip. Blocking mode
+            # retries at every cadence until one round completes.
             rejoiner = start_volunteer(
-                addr, "rejoiner", common + ["--steps", "30", "--seed", "5"]
+                addr, "rejoiner",
+                common + ["--no-overlap", "--steps", "120", "--seed", "5"],
             )
             vols.append(rejoiner)
             s, out = wait_done(rejoiner, timeout=240)
             # The poisoned pull actually happened: targeted the liar's step.
             m = re.search(r"pulled state at step (\d+) from poisoner", out)
             assert m, f"rejoiner never pulled from the poisoner:\n{out[-2000:]}"
-            assert int(m.group(1)) > 900, m.group(0)
+            # The lie is 1e9 (far above any honest announce, comfortably
+            # inside int32 for the adopted step counter).
+            assert int(m.group(1)) > 900_000_000, m.group(0)
             # ...and robust rounds contracted it back to the swarm anyway.
             assert s["rounds_ok"] >= 1, out
             assert s["final_loss"] == s["final_loss"], out  # not NaN

@@ -45,6 +45,7 @@ def test_sync_round_at_gpt2_small_scale(wire):
         return ra, rb, dt
 
     ra, rb, dt = run_long(main())
+    _record_soak(wire, dt, ok=(ra is not None and rb is not None and dt < 240.0))
     assert ra is not None and rb is not None, "round failed at payload scale"
     # mean(1, 3) = 2 exactly in f32; bf16 wire rounds each CONTRIBUTION, and
     # 1.0/3.0 are exactly representable in bf16, so the mean is still exact.
@@ -59,3 +60,30 @@ def test_sync_round_at_gpt2_small_scale(wire):
 
 def run_long(coro):
     return asyncio.run(asyncio.wait_for(coro, timeout=420))
+
+
+def _record_soak(wire: str, dt: float, ok: bool) -> None:
+    """Append the measured round time to experiments/results/soak.jsonl —
+    the committed evidence that a ~500 MB (f32) / ~250 MB (bf16) round
+    completes within budget (VERDICT r3 #6), recorded before the asserts so
+    even a budget miss leaves its timing on disk. ``ok`` marks whether the
+    round succeeded AND met the budget — a failing run must not read as
+    proof of success."""
+    import json
+    import os
+    import time as _t
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "experiments", "results", "soak.jsonl")
+    with open(path, "a") as fh:
+        fh.write(json.dumps({
+            "test": "sync_round_gpt2_small_scale",
+            "wire": wire,
+            "ok": ok,
+            "seconds": round(dt, 2),
+            "floats": GPT2_SMALL_FLOATS,
+            "payload_mb_per_contribution": round(
+                GPT2_SMALL_FLOATS * (4 if wire == "f32" else 2) / 1e6, 1
+            ),
+            "recorded_at": _t.strftime("%Y-%m-%dT%H:%M:%SZ", _t.gmtime()),
+        }) + "\n")
