@@ -87,6 +87,12 @@ class VolunteerConfig:
     # Adaptive round deadlines (EWMA of successful rounds; see AveragerBase):
     # a dead peer costs seconds instead of the full gather budget.
     adaptive_timeout: bool = False
+    # DiLoCo-style outer optimizer over params-mode rounds (see Trainer):
+    # Nesterov momentum on the per-round aggregate delta instead of adopting
+    # the raw mean — convergence-per-round at the same WAN byte budget.
+    outer_optimizer: str = "none"  # none | nesterov
+    outer_lr: float = 0.7
+    outer_momentum: float = 0.9
     # In-slice mesh: "dp=2,tp=2"-style spec over THIS volunteer's local
     # devices (a TPU slice); empty = single-device step. The WAN tier still
     # sees one volunteer either way. ``fsdp`` shards params+optimizer over
@@ -111,6 +117,25 @@ class VolunteerConfig:
     def __post_init__(self):
         if not self.peer_id:
             self.peer_id = f"vol-{uuid.uuid4().hex[:8]}"
+        if self.outer_optimizer != "none":
+            if self.average_what != "params":
+                raise ValueError("--outer-optimizer requires --average-what params")
+            if self.averaging not in ("sync", "byzantine"):
+                # The outer step's math assumes every member adopts a COMMON
+                # aggregate each round (anchor - average is the swarm's
+                # consensus delta). Gossip averages are pairwise — per-round
+                # momentum would push each volunteer 1.33x past a DIFFERENT
+                # partner's midpoint (lr 0.7, mu 0.9), amplifying
+                # disagreement; butterfly degrades to subset averages under
+                # churn with the same issue. Only the gather-style modes,
+                # where all members adopt one aggregate, are validated
+                # (experiments/outer_opt.py).
+                raise ValueError(
+                    "--outer-optimizer requires --averaging sync or byzantine "
+                    "(gossip/butterfly rounds are pairwise/subset averages, "
+                    "not a common aggregate — momentum over them amplifies "
+                    "disagreement)"
+                )
         if self.wire == "topk":
             # Fail at config time, before the transport binds or membership
             # announces anything. Top-k of a parameter tree would zero most
@@ -320,6 +345,9 @@ class Volunteer:
             eval_every=self.cfg.eval_every,
             eval_batches=self.cfg.eval_batches,
             eval_data=eval_data,
+            outer_optimizer=self.cfg.outer_optimizer,
+            outer_lr=self.cfg.outer_lr,
+            outer_momentum=self.cfg.outer_momentum,
         )
         if self.cfg.checkpoint_dir:
             from distributedvolunteercomputing_tpu.training.checkpoint import maybe_restore

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Outer-optimizer convergence comparison (DiLoCo-style Nesterov vs plain
+averaging) at a fixed round cadence and WAN byte budget.
+
+Two identical 2-volunteer sync swarms on the gpt2 proxy (the hardest proxy
+in the matrix), --average-every 15 over 90 steps — 6 WAN rounds each, same
+bytes — differing ONLY in the outer step. At the same communication budget,
+the outer momentum should reach a lower loss (convergence-per-round is the
+claim; samples/sec is unaffected by construction since the outer step is a
+host-side O(params) transform per round).
+
+Run:  python experiments/outer_opt.py
+Results: experiments/results/outer_opt.jsonl (one row per arm).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from run_matrix import RESULTS, TINY_GPT2, record, run_swarm  # noqa: E402
+
+TIMEOUTS = ["--join-timeout", "25", "--gather-timeout", "25"]
+
+
+def arm(tag: str, extra: list) -> dict:
+    base = ["--model", "gpt2_small", *TINY_GPT2, "--averaging", "sync",
+            "--average-every", "15", "--steps", "90", "--batch-size", "16",
+            "--lr", "0.003", *TIMEOUTS, *extra]
+    rows = run_swarm(f"outer_opt/{tag}", [
+        (f"{tag}{i}", base + ["--seed", str(i)]) for i in range(2)
+    ])
+    return record(f"outer_opt_{tag}", rows)
+
+
+def main() -> None:
+    results = {
+        "plain": arm("plain", []),
+        "nesterov": arm("nesterov", [
+            "--outer-optimizer", "nesterov",
+            "--outer-lr", "0.7", "--outer-momentum", "0.9",
+        ]),
+    }
+    out = os.path.join(RESULTS, "outer_opt.jsonl")
+    with open(out, "w") as fh:
+        for tag, agg in results.items():
+            fh.write(json.dumps({"arm": tag, **agg}) + "\n")
+    delta = results["plain"]["final_loss_mean"] - results["nesterov"]["final_loss_mean"]
+    print(f"outer_opt: plain {results['plain']['final_loss_mean']} vs "
+          f"nesterov {results['nesterov']['final_loss_mean']} "
+          f"(delta {delta:+.4f}; positive = outer wins)")
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,13 @@ def main() -> None:
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--join-timeout", type=float, default=10.0)
     ap.add_argument("--gather-timeout", type=float, default=20.0)
+    ap.add_argument("--outer-optimizer", default="none", choices=("none", "nesterov"),
+                    help="DiLoCo-style outer optimizer over params-mode "
+                         "averaging rounds: Nesterov momentum on the "
+                         "per-round aggregate delta (better convergence per "
+                         "round at the same WAN bytes)")
+    ap.add_argument("--outer-lr", type=float, default=0.7)
+    ap.add_argument("--outer-momentum", type=float, default=0.9)
     ap.add_argument("--adaptive-timeout", action="store_true",
                     help="bound round waits by an EWMA of successful round "
                          "times (dead peers cost seconds, not the full "
@@ -178,6 +185,9 @@ def main() -> None:
         join_timeout=args.join_timeout,
         gather_timeout=args.gather_timeout,
         adaptive_timeout=args.adaptive_timeout,
+        outer_optimizer=args.outer_optimizer,
+        outer_lr=args.outer_lr,
+        outer_momentum=args.outer_momentum,
     )
     if cfg.averaging != "none":
         # Build/load the native host core BEFORE the event loop exists: the

@@ -695,6 +695,24 @@ class TestTopkScope:
                 method="trimmed_mean", allow_unrobust_topk=True,
             )
 
+    def test_outer_optimizer_restricted_to_consensus_modes(self):
+        """The outer step's math assumes a COMMON per-round aggregate:
+        pairwise (gossip) and subset (degraded butterfly) averages would be
+        amplified, not contracted, by the momentum — refused at config
+        time."""
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        for mode in ("gossip", "butterfly"):
+            with pytest.raises(ValueError, match="sync or byzantine"):
+                VolunteerConfig(averaging=mode, outer_optimizer="nesterov")
+        with pytest.raises(ValueError, match="params"):
+            VolunteerConfig(
+                averaging="sync", average_what="grads",
+                outer_optimizer="nesterov",
+            )
+        VolunteerConfig(averaging="sync", outer_optimizer="nesterov")
+        VolunteerConfig(averaging="byzantine", outer_optimizer="nesterov")
+
 
 class TestSyncTopkEFDegraded:
     def test_dropped_contribution_does_not_commit_residual(self):

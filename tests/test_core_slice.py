@@ -113,6 +113,130 @@ class TestTrainerLocalSGD:
         with pytest.raises(ValueError, match="target_mode"):
             t2.run(steps=1, target_mode="bogus")
 
+    def test_outer_optimizer_nesterov_math(self):
+        """DiLoCo outer step, hand-checked over three rounds: with anchor a,
+        round average v, g = a - v, m' = mu*m + g, a' = a - lr*(mu*m' + g).
+        Round 1 seeds the anchor and passes the average through."""
+        import numpy as np
+
+        t = Trainer(
+            get_model("mnist_mlp", d_hidden=4), batch_size=8,
+            outer_optimizer="nesterov", outer_lr=0.5, outer_momentum=0.9,
+        )
+        lr, mu = 0.5, 0.9
+
+        def tree(x):
+            return {"w": np.full((3,), x, np.float32)}
+
+        # round 1: seed anchor, pass through
+        out1 = t._outer_transform(tree(10.0))
+        np.testing.assert_allclose(out1["w"], 10.0)
+        # round 2: v=7 -> g = 10-7 = 3; m = 3; a' = 10 - 0.5*(0.9*3 + 3) = 7.15
+        out2 = t._outer_transform(tree(7.0))
+        np.testing.assert_allclose(out2["w"], 7.15, rtol=1e-6)
+        # round 3: v=7 -> g = 7.15-7 = 0.15; m = 0.9*3 + 0.15 = 2.85
+        #          a' = 7.15 - 0.5*(0.9*2.85 + 0.15) = 7.15 - 1.3575 = 5.7925
+        out3 = t._outer_transform(tree(7.0))
+        np.testing.assert_allclose(out3["w"], 5.7925, rtol=1e-6)
+
+    def test_outer_optimizer_identity_config_matches_plain_averaging(self):
+        """lr=1, mu=0 reduces the outer step to plain adoption of the round
+        average — the safety property that makes the default parameters a
+        strict generalization."""
+        import numpy as np
+
+        t = Trainer(
+            get_model("mnist_mlp", d_hidden=4), batch_size=8,
+            outer_optimizer="nesterov", outer_lr=1.0, outer_momentum=0.0,
+        )
+        for v in (4.0, -2.0, 11.5):
+            out = t._outer_transform({"w": np.full((5,), v, np.float32)})
+            np.testing.assert_allclose(out["w"], v, rtol=1e-6)
+
+    def test_outer_optimizer_reset_on_adoption(self):
+        """A state-sync adoption invalidates the momentum stream: the next
+        round must re-seed the anchor instead of differencing against a
+        pre-adoption one."""
+        import numpy as np
+
+        t = Trainer(
+            get_model("mnist_mlp", d_hidden=4), batch_size=8,
+            outer_optimizer="nesterov", outer_lr=0.5, outer_momentum=0.9,
+        )
+        t._outer_transform({"w": np.full((3,), 10.0, np.float32)})
+        assert t._outer_anchor is not None
+        t.adopt_params(t.state.params, step=50)
+        assert t._outer_anchor is None and t._outer_m is None
+        # next round re-seeds: passes the average through unchanged
+        out = t._outer_transform({"w": np.full((3,), 3.0, np.float32)})
+        np.testing.assert_allclose(out["w"], 3.0)
+
+    def test_outer_optimizer_overlap_path(self):
+        """The overlap merge must apply the outer step to the ROUND result
+        and ride the local-progress delta on top — and a staleness-dropped
+        round must not touch the momentum stream. Drives the real
+        _finish_overlap_round with fabricated completed futures, so the
+        ordering (ok/staleness checks BEFORE the outer transform) is pinned
+        deterministically."""
+        import concurrent.futures
+
+        import numpy as np
+
+        t = Trainer(
+            get_model("mnist_mlp", d_hidden=4), batch_size=8,
+            averager=lambda p, s: p, overlap=True,
+            outer_optimizer="nesterov", outer_lr=0.5, outer_momentum=0.9,
+        )
+
+        def payload_like(value):
+            return jax.tree_util.tree_map(
+                lambda x: np.full_like(np.asarray(x), value),
+                t.bundle.avg_select(t.state.params),
+            )
+
+        def finish_with(averaged, launch_step, step_no):
+            p0 = jax.tree_util.tree_map(
+                np.asarray, t.bundle.avg_select(t.state.params)
+            )
+            fut = concurrent.futures.Future()
+            fut.set_result((averaged, 0.01))
+            t._inflight = (launch_step, p0, fut)
+            t._finish_overlap_round(step_no)
+
+        # round 1: seeds the anchor; no local steps taken since snapshot, so
+        # params land exactly on the averaged tree
+        finish_with(payload_like(10.0), 1, 1)
+        for leaf in jax.tree_util.tree_leaves(t.state.params):
+            np.testing.assert_allclose(np.asarray(leaf), 10.0)
+        # round 2: v=7 -> Nesterov a' = 10 - 0.5*(0.9*3 + 3) = 7.15
+        finish_with(payload_like(7.0), 2, 2)
+        for leaf in jax.tree_util.tree_leaves(t.state.params):
+            np.testing.assert_allclose(np.asarray(leaf), 7.15, rtol=1e-6)
+        anchor_before = jax.tree_util.tree_leaves(t._outer_anchor)[0].copy()
+        m_before = jax.tree_util.tree_leaves(t._outer_m)[0].copy()
+        # stale round: dropped BEFORE the outer transform — anchor, momentum
+        # and params all untouched
+        t.max_staleness = 1
+        finish_with(payload_like(0.0), 10, 20)
+        np.testing.assert_array_equal(
+            jax.tree_util.tree_leaves(t._outer_anchor)[0], anchor_before
+        )
+        np.testing.assert_array_equal(
+            jax.tree_util.tree_leaves(t._outer_m)[0], m_before
+        )
+        for leaf in jax.tree_util.tree_leaves(t.state.params):
+            np.testing.assert_allclose(np.asarray(leaf), 7.15, rtol=1e-6)
+
+    def test_outer_optimizer_rejects_grads_mode(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="params"):
+            Trainer(
+                get_model("mnist_mlp", d_hidden=4), batch_size=8,
+                averager=lambda p, s: p, average_what="grads",
+                outer_optimizer="nesterov",
+            )
+
     def test_checkpoint_gc_keeps_last_n(self, tmp_path, monkeypatch):
         """Periodic saves must not grow the directory without bound: after
         each save, all but the newest KEEP_LAST snapshots are removed, and

@@ -154,6 +154,29 @@ class TestSwarmE2E:
         finally:
             coord.kill()
 
+    def test_two_volunteers_sync_outer_optimizer(self):
+        """DiLoCo-style outer Nesterov over sync params rounds, end to end
+        through the real entrypoints: rounds complete and losses stay sane
+        (the outer step must contract toward consensus, not diverge)."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                "--averaging", "sync", "--average-every", "10", "--steps", "60",
+                "--outer-optimizer", "nesterov", "--outer-lr", "0.7",
+                "--outer-momentum", "0.9",
+                "--join-timeout", "25", "--gather-timeout", "25",
+            ]
+            v0 = start_volunteer(addr, "ov0", common + ["--seed", "0"])
+            v1 = start_volunteer(addr, "ov1", common + ["--seed", "1"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            assert s0["rounds_ok"] >= 1, out0
+            assert s1["rounds_ok"] >= 1, out1
+            assert s0["final_loss"] == s0["final_loss"], out0  # not NaN
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5, (out0, out1)
+        finally:
+            coord.kill()
+
     def test_two_volunteers_gossip_averaging(self):
         """Config-3 shape at process level (2 volunteers): gossip partners
         are selected from membership records' avg_ns — the exact plumbing a
