@@ -54,6 +54,7 @@ class _Round:
         self.tokens: Optional[Dict[str, str]] = None
         self.full = asyncio.Event()
         self.result: Optional[np.ndarray] = None
+        self.result_wire: bytes = b""  # encoded once; served to every fetch
         self.result_ready = asyncio.Event()
         # Peer ids whose contributions actually entered the aggregate —
         # served back in sync.fetch meta so a member with a pending top-k
@@ -330,6 +331,33 @@ class AveragerBase:
             return native.topk_decode(payload)
         return np.frombuffer(payload, np.float32).copy()
 
+    # -- off-loop wrappers for payload-sized work --------------------------
+    # Flatten/codec/aggregate over a full param tree is seconds of CPU at
+    # GPT-2 scale (measured: q8 of the 498 MB tree ~2.6 s). Run synchronously
+    # it stalls the event loop — heartbeats, DHT RPCs, and matchmaking
+    # begins all miss their (5 s) deadlines, failing rounds that would
+    # otherwise succeed. Same policy as state_sync's _serialize: the loop
+    # schedules, worker threads move bytes. Per-averager work stays serial
+    # (one average() at a time); RPC-path decodes may run concurrently on
+    # distinct payloads, so callers must re-check insert conditions after
+    # the await (the loop may have run other handlers meanwhile).
+
+    async def _pack_and_compress(self, tree: Any):
+        """(buf, wire_bytes, dense_fn) off the event loop."""
+
+        def work():
+            buf = self._pack(tree)
+            wire, sent = self._compress_contribution(buf)
+            return buf, wire, sent
+
+        return await asyncio.to_thread(work)
+
+    async def _decode_payload(self, payload: bytes) -> np.ndarray:
+        return await asyncio.to_thread(self._buf_from_payload, payload)
+
+    async def _encode_wire(self, buf: np.ndarray) -> bytes:
+        return await asyncio.to_thread(self._to_wire, buf)
+
     # -- public API --------------------------------------------------------
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
@@ -376,7 +404,14 @@ class SyncAverager(AveragerBase):
             raise RPCError("invalid contribution token for this round")
         if key not in st.contribs and len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
             raise RPCError("round contribution cap reached")
-        st.contribs[key] = (float(args["weight"]), self._buf_from_payload(payload))
+        buf = await self._decode_payload(payload)
+        # Re-check after the await (other handlers ran while we decoded):
+        # a same-key entry landed -> idempotent ack without overwriting
+        # (first write wins, retries succeed); cap reached -> refuse.
+        if key not in st.contribs:
+            if len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
+                raise RPCError("round contribution cap reached")
+            st.contribs[key] = (float(args["weight"]), buf)
         if st.expected:
             valid = {
                 p for p, t in st.contribs
@@ -386,16 +421,27 @@ class SyncAverager(AveragerBase):
                 st.full.set()
         return {"ok": True}, b""
 
+    # Extra wait beyond the gather deadline for the leader's OFF-LOOP
+    # aggregation + encode to land: with aggregation on a worker thread the
+    # member-side timers now actually fire on schedule, so the old +3s
+    # margin expired mid-aggregation at param scale.
+    AGGREGATION_HEADROOM = 30.0
+
     async def _rpc_fetch(self, args: dict, payload: bytes):
         st = self._rounds.get(args["epoch"])
         if st is None:
             raise RPCError("unknown or finished round epoch")
-        # Must outwait the leader's own gather deadline (plus margin), or a
-        # member's fetch races the aggregation and loses by milliseconds.
-        await asyncio.wait_for(st.result_ready.wait(), timeout=self.gather_timeout + 3.0)
+        # Must outwait the leader's own gather deadline plus its off-loop
+        # aggregation, or a member's fetch races the result and loses.
+        await asyncio.wait_for(
+            st.result_ready.wait(),
+            timeout=self.gather_timeout + self.AGGREGATION_HEADROOM,
+        )
         if st.result is None:
             raise RPCError("round skipped by leader (too few contributions)")
-        return {"ok": True, "included": st.included}, self._to_wire(st.result)
+        # result_wire is encoded ONCE when the result lands (n members
+        # fetching must not cost n identical codec passes).
+        return {"ok": True, "included": st.included}, st.result_wire
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
@@ -405,10 +451,9 @@ class SyncAverager(AveragerBase):
         if group is None:
             self.rounds_skipped += 1
             return None
-        buf = self._pack(tree)
         # One compression per round, leader or member: the leader's own
         # contribution enters the aggregate exactly as a peer would see it.
-        wire_bytes, sent = self._compress_contribution(buf)
+        buf, wire_bytes, sent = await self._pack_and_compress(tree)
         t0 = time.monotonic()
         self._round_degraded = False
         # The leader's own contribution always enters the aggregate; a
@@ -419,7 +464,9 @@ class SyncAverager(AveragerBase):
         self._contribution_included = True
         try:
             if group.my_index == 0:
-                result = await self._lead_round(group, sent(), weight)
+                result = await self._lead_round(
+                    group, await asyncio.to_thread(sent), weight
+                )
             else:
                 result = await self._member_round(group, weight, wire_bytes)
         except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
@@ -475,18 +522,25 @@ class SyncAverager(AveragerBase):
                 return None
             peers = sorted(good)
             st.included = peers
-            if self.method == "mean":
-                # Streaming weighted accumulation (native axpy when built):
-                # no [n_peers, D] stack copy for the common path.
-                total_w = float(sum(good[p][0] for p in peers))
-                acc = np.zeros(buf.size, np.float32)
-                for p in peers:
-                    w_p, buf_p = good[p]
-                    native.weighted_sum_inplace(acc, buf_p, w_p / total_w)
-                st.result = acc
-            else:
+
+            def _aggregate() -> np.ndarray:
+                if self.method == "mean":
+                    # Streaming weighted accumulation (native axpy when
+                    # built): no [n_peers, D] stack copy for the common path.
+                    total_w = float(sum(good[p][0] for p in peers))
+                    acc = np.zeros(buf.size, np.float32)
+                    for p in peers:
+                        w_p, buf_p = good[p]
+                        native.weighted_sum_inplace(acc, buf_p, w_p / total_w)
+                    return acc
                 stack = np.stack([good[p][1] for p in peers])
-                st.result = robust.aggregate(stack, self.method, **dict(self.method_kw))
+                return robust.aggregate(stack, self.method, **dict(self.method_kw))
+
+            # Seconds of array math at param scale — off the loop (members'
+            # fetches park on result_ready; heartbeats must keep flowing).
+            st.result = await asyncio.to_thread(_aggregate)
+            # Encode the wire form ONCE before releasing the fetch waiters.
+            st.result_wire = await self._encode_wire(st.result)
             st.result_ready.set()
             self.rounds_ok += 1
             # Keep state around long enough for members to fetch.
@@ -511,7 +565,10 @@ class SyncAverager(AveragerBase):
             leader_addr, "sync.contribute", args, wire_bytes, timeout=self.effective_gather_timeout
         )
         ret, payload = await self.transport.call(
-            leader_addr, "sync.fetch", {"epoch": group.epoch}, timeout=self.gather_timeout + 6.0
+            leader_addr, "sync.fetch", {"epoch": group.epoch},
+            # Outwait the leader-side fetch wait (gather + aggregation
+            # headroom) plus transfer margin.
+            timeout=self.gather_timeout + self.AGGREGATION_HEADROOM + 6.0,
         )
         # Older leaders don't report the included set; treat absence as
         # included (the pre-existing behavior) rather than stalling EF.
@@ -519,7 +576,9 @@ class SyncAverager(AveragerBase):
         if included is not None:
             self._contribution_included = self.peer_id in included
         self.rounds_ok += 1
-        return self._unpack(self._buf_from_payload(payload))
+        return await asyncio.to_thread(
+            lambda: self._unpack(self._buf_from_payload(payload))
+        )
 
 
 class GossipAverager(AveragerBase):
@@ -580,7 +639,7 @@ class GossipAverager(AveragerBase):
         if self._current is None:
             raise RPCError("peer has no params published yet")
         my_w, my_buf = self._current
-        inbuf = self._buf_from_payload(payload)
+        inbuf = await self._decode_payload(payload)
         if inbuf.size != my_buf.size:
             raise RPCError(f"buffer size {inbuf.size} != local {my_buf.size}")
         if len(self._inbox) < self.MAX_PARKED_CONTRIBS:
@@ -592,21 +651,27 @@ class GossipAverager(AveragerBase):
             # degrades to pull-only instead of growing without bound.
             log.debug("gossip inbox full (%d); dropping incoming contribution",
                       len(self._inbox))
-        return {"weight": my_w}, self._to_wire(my_buf)
+        return {"weight": my_w}, await self._encode_wire(my_buf)
 
     def _mix(self, w1, b1, w2, b2) -> Tuple[float, np.ndarray]:
         total = w1 + w2
         return total, (b1 * (w1 / total) + b2 * (w2 / total))
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
-        buf = self._pack(tree)
-        w = weight
-        # 1. fold in whatever neighbours pushed since last time
         inbox, self._inbox = self._inbox, []
-        for iw, ibuf in inbox:
-            if ibuf.size != buf.size:  # banked before our schema changed
-                continue
-            w, buf = self._mix(w, buf, iw, ibuf)
+
+        def _fold():
+            buf = self._pack(tree)
+            w = weight
+            # 1. fold in whatever neighbours pushed since last time
+            for iw, ibuf in inbox:
+                if ibuf.size != buf.size:  # banked before our schema changed
+                    continue
+                w, buf = self._mix(w, buf, iw, ibuf)
+            return w, buf
+
+        # Payload-scale flatten + up to inbox-cap mixes: off the loop.
+        w, buf = await asyncio.to_thread(_fold)
         self._current = (w, buf)
         # 2. push-pull with one random live peer — same-namespace peers only.
         # Gossip has no rendezvous key, so the namespace filter happens here:
@@ -632,14 +697,16 @@ class GossipAverager(AveragerBase):
                     "gossip.exchange",
                     {"peer": self.peer_id, "weight": w, "schema": self._schema,
                      "xid": uuid.uuid4().hex},
-                    self._to_wire(buf),
+                    await self._encode_wire(buf),
                     timeout=self.effective_gather_timeout,
                 )
                 self._observe_round_time(time.monotonic() - t0)
-                rbuf = self._buf_from_payload(payload)
+                rbuf = await self._decode_payload(payload)
                 if rbuf.size != buf.size:
                     raise RPCError(f"peer buffer size {rbuf.size} != local {buf.size}")
-                w, buf = self._mix(w, buf, float(ret["weight"]), rbuf)
+                w, buf = await asyncio.to_thread(
+                    self._mix, w, buf, float(ret["weight"]), rbuf
+                )
                 self._current = (w, buf)
                 mixed = True
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
@@ -649,7 +716,7 @@ class GossipAverager(AveragerBase):
             self.rounds_skipped += 1
             return None
         self.rounds_ok += 1
-        return self._unpack(buf)
+        return await asyncio.to_thread(self._unpack, buf)
 
 
 class ButterflyAverager(AveragerBase):
@@ -711,12 +778,12 @@ class ButterflyAverager(AveragerBase):
         st = self._stage_state(args["epoch"], int(args["stage"]), remote=True)
         # Wait until the local peer reaches this stage (it may be behind).
         await asyncio.wait_for(st["ready"].wait(), timeout=self.stage_timeout)
-        inbuf = self._buf_from_payload(payload)
+        inbuf = await self._decode_payload(payload)
         if inbuf.size != st["buf"].size:
             raise RPCError(f"buffer size {inbuf.size} != local {st['buf'].size}")
         st["in"] = (float(args["weight"]), inbuf)
         st["done"].set()
-        return {"weight": st["w"]}, self._to_wire(st["buf"])
+        return {"weight": st["w"]}, await self._encode_wire(st["buf"])
 
     @staticmethod
     def _mix(w1: float, b1: np.ndarray, w2: float, b2: np.ndarray) -> Tuple[float, np.ndarray]:
@@ -746,7 +813,7 @@ class ButterflyAverager(AveragerBase):
             if partner_idx >= n:
                 continue
             partner_id, partner_addr = group.members[partner_idx]
-            buf = self._wire_roundtrip(buf)
+            buf = await asyncio.to_thread(self._wire_roundtrip, buf)
             st = self._stage_state(group.epoch, s)
             st["buf"], st["w"] = buf, w
             st["ready"].set()
@@ -762,16 +829,16 @@ class ButterflyAverager(AveragerBase):
                             "weight": w,
                             "schema": self._schema,
                         },
-                        self._to_wire(buf),
+                        await self._encode_wire(buf),
                         timeout=self.stage_timeout,
                     )
-                    pw, pbuf = float(ret["weight"]), self._buf_from_payload(payload)
+                    pw, pbuf = float(ret["weight"]), await self._decode_payload(payload)
                 else:
                     await asyncio.wait_for(st["done"].wait(), timeout=self.stage_timeout)
                     pw, pbuf = st["in"]
                 if pbuf.size != buf.size:
                     raise RPCError(f"partner buffer size {pbuf.size} != local {buf.size}")
-                w, buf = self._mix(w, buf, pw, pbuf)
+                w, buf = await asyncio.to_thread(self._mix, w, buf, pw, pbuf)
                 mixed_any = True
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info(
@@ -784,7 +851,7 @@ class ButterflyAverager(AveragerBase):
             self.rounds_skipped += 1
             return None
         self.rounds_ok += 1
-        return self._unpack(buf)
+        return await asyncio.to_thread(self._unpack, buf)
 
 
 class ByzantineAverager(AveragerBase):
@@ -831,7 +898,14 @@ class ByzantineAverager(AveragerBase):
             raise RPCError("duplicate contribution for peer (first write wins)")
         if not st.expected and len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
             raise RPCError("round contribution cap reached")
-        buf = self._buf_from_payload(payload)
+        buf = await self._decode_payload(payload)
+        # Re-check after the await: first write wins, so a contribution that
+        # landed while we decoded keeps its slot and THIS one is the forgery
+        # (or a pointless retry) — refuse rather than overwrite.
+        if peer in st.contribs:
+            raise RPCError("duplicate contribution for peer (first write wins)")
+        if not st.expected and len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
+            raise RPCError("round contribution cap reached")
         st.contribs[peer] = (float(args["weight"]), buf)
         if st.expected and set(st.contribs) >= st.expected:
             st.full.set()
@@ -845,13 +919,12 @@ class ByzantineAverager(AveragerBase):
         if group is None:
             self.rounds_skipped += 1
             return None
-        buf = self._pack(tree)
-        wire_bytes, sent = self._compress_contribution(buf)
+        buf, wire_bytes, sent = await self._pack_and_compress(tree)
         st = self._rounds.get(group.epoch)
         if st is None:
             st = self._rounds[group.epoch] = _Round([])
         st.expected = set(pid for pid, _ in group.members)
-        st.contribs[self.peer_id] = (weight, sent())
+        st.contribs[self.peer_id] = (weight, await asyncio.to_thread(sent))
         if set(st.contribs) >= st.expected:
             st.full.set()
 
@@ -893,7 +966,6 @@ class ByzantineAverager(AveragerBase):
             return None
         self._commit_ef(True)
         peers = sorted(received)
-        stack = np.stack([received[p][1] for p in peers])
         kw = dict(self.method_kw)
         if self.method == "mean":
             kw["weights"] = np.array([received[p][0] for p in peers])
@@ -906,7 +978,14 @@ class ByzantineAverager(AveragerBase):
         self.rounds_ok += 1
         if not degraded:
             self._observe_round_time(time.monotonic() - t0)
-        return self._unpack(robust.aggregate(stack, self.method, **kw))
+        # [n_peers, D] stack + robust estimator at param scale: off the loop.
+        return await asyncio.to_thread(
+            lambda: self._unpack(
+                robust.aggregate(
+                    np.stack([received[p][1] for p in peers]), self.method, **kw
+                )
+            )
+        )
 
 
 AVERAGERS = {

@@ -67,9 +67,75 @@ def _gc(ckpt_dir: str, just_saved: int, keep: int = 0) -> None:
         path = os.path.join(ckpt_dir, f"step_{step}")
         try:
             shutil.rmtree(path)
+            # The outer-state sidecar lives BESIDE the snapshot dir.
+            outer = _outer_state_path(path)
+            if os.path.exists(outer):
+                os.remove(outer)
             log.info("checkpoint GC: removed %s", path)
         except OSError as e:
             log.warning("checkpoint GC failed for %s: %s", path, e)
+
+
+def _outer_state_path(snapshot_path: str) -> str:
+    # Beside (not inside) the orbax directory: orbax owns its directory
+    # layout, and a foreign file inside it could break its metadata checks.
+    return snapshot_path + ".outer.npz"
+
+
+def _save_outer_state(trainer, snapshot_path: str) -> None:
+    """Persist the DiLoCo outer anchor/momentum beside the snapshot.
+
+    A separate optional file, NOT a new key in the orbax tree: the restore
+    template is built from the live TrainState, so widening the tree would
+    break restores of every pre-existing checkpoint. Losing the momentum
+    stream on every preemption would forfeit the outer optimizer's gain in
+    exactly the churn regime the framework targets."""
+    anchor = getattr(trainer, "_outer_anchor", None)
+    if getattr(trainer, "outer_optimizer", "none") == "none" or anchor is None:
+        return
+    from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer
+
+    buf_a, _, _ = flatten_to_buffer(anchor)
+    buf_m, _, _ = flatten_to_buffer(trainer._outer_m)
+    try:
+        np.savez(_outer_state_path(snapshot_path), anchor=buf_a, m=buf_m)
+    except OSError as e:
+        log.warning("outer-state save failed (continuing): %s", e)
+
+
+def _maybe_restore_outer_state(trainer, snapshot_path: str) -> None:
+    """Rebuild anchor/momentum from the sidecar if it matches the current
+    payload schema; silently absent otherwise (the next round re-seeds —
+    the documented cold-start semantics)."""
+    if getattr(trainer, "outer_optimizer", "none") == "none":
+        return
+    path = _outer_state_path(snapshot_path)
+    if not os.path.exists(path):
+        return
+    from distributedvolunteercomputing_tpu.utils.pytree import (
+        tree_specs,
+        unflatten_from_buffer,
+    )
+
+    # Specs only — no D2H gather of the payload (tree_specs reads
+    # shape/dtype straight off the jax leaves).
+    specs, treedef = tree_specs(trainer.bundle.avg_select(trainer.state.params))
+    expect = int(sum(s.size for s in specs))
+    try:
+        with np.load(path) as d:
+            buf_a, buf_m = d["anchor"], d["m"]
+    except (OSError, ValueError, KeyError) as e:
+        log.warning("outer-state restore failed (re-seeding): %s", e)
+        return
+    if buf_a.size != expect or buf_m.size != expect:
+        log.warning(
+            "outer-state size %d != payload schema %d; re-seeding",
+            buf_a.size, expect,
+        )
+        return
+    trainer._outer_anchor = unflatten_from_buffer(buf_a, specs, treedef)
+    trainer._outer_m = unflatten_from_buffer(buf_m, specs, treedef)
+    log.info("restored outer-optimizer state from %s", path)
 
 
 def save(trainer, ckpt_dir: str) -> str:
@@ -79,6 +145,7 @@ def save(trainer, ckpt_dir: str) -> str:
     path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, _state_to_pytree(trainer), force=True)
+    _save_outer_state(trainer, path)
     log.info("checkpoint saved: %s", path)
     _gc(ckpt_dir, just_saved=step)
     return path
@@ -111,16 +178,38 @@ def save_async(trainer, ckpt_dir: str) -> bool:
     step = int(host_tree["step"])
     path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
 
+    # Outer-optimizer state is snapshotted on the CALLER thread too (it is
+    # host numpy mutated only between steps on this same thread); the
+    # writer thread just serializes the copies.
+    outer_bufs = None
+    if getattr(trainer, "outer_optimizer", "none") != "none" and getattr(
+        trainer, "_outer_anchor", None
+    ) is not None:
+        from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer
+
+        outer_bufs = (
+            flatten_to_buffer(trainer._outer_anchor)[0],
+            flatten_to_buffer(trainer._outer_m)[0],
+        )
+
     def _write():
         import orbax.checkpoint as ocp
 
         try:
             with ocp.PyTreeCheckpointer() as ckptr:
                 ckptr.save(path, host_tree, force=True)
-            log.info("checkpoint saved (async): %s", path)
-            _gc(ckpt_dir, just_saved=step)
         except Exception as e:  # noqa: BLE001 — a failed periodic save must not kill training
             log.warning("async checkpoint save failed: %s", e)
+            return
+        # Sidecar failure must not mislabel the landed snapshot as failed,
+        # and must never skip GC (that's how a disk fills).
+        if outer_bufs is not None:
+            try:
+                np.savez(_outer_state_path(path), anchor=outer_bufs[0], m=outer_bufs[1])
+            except OSError as e:
+                log.warning("outer-state save failed (snapshot is intact): %s", e)
+        log.info("checkpoint saved (async): %s", path)
+        _gc(ckpt_dir, just_saved=step)
 
     t = threading.Thread(target=_write, name="ckpt-writer", daemon=True)
     trainer._ckpt_writer = t
@@ -198,6 +287,7 @@ def maybe_restore(trainer, ckpt_dir: str) -> bool:
         )
     else:
         trainer.state = jax.tree_util.tree_map(jax.device_put, host_state)
+    _maybe_restore_outer_state(trainer, path)
     # Refresh the cross-thread snapshot: the state-sync provider must
     # announce/serve the RESTORED step, not the cold init from __init__.
     trainer._take_snapshot(step)

@@ -227,6 +227,58 @@ class TestTrainerLocalSGD:
         for leaf in jax.tree_util.tree_leaves(t.state.params):
             np.testing.assert_allclose(np.asarray(leaf), 7.15, rtol=1e-6)
 
+    def test_outer_optimizer_state_survives_checkpoint_resume(self, tmp_path):
+        """The momentum stream persists across preemption (sidecar .npz
+        beside the orbax snapshot): a resumed trainer continues the Nesterov
+        sequence exactly where the saved one would have."""
+        import numpy as np
+
+        from distributedvolunteercomputing_tpu.training import checkpoint
+
+        def make():
+            return Trainer(
+                get_model("mnist_mlp", d_hidden=4), batch_size=8,
+                outer_optimizer="nesterov", outer_lr=0.5, outer_momentum=0.9,
+            )
+
+        def payload_like(t, value):
+            return jax.tree_util.tree_map(
+                lambda x: np.full_like(np.asarray(x), value),
+                t.bundle.avg_select(t.state.params),
+            )
+
+        a = make()
+        a._outer_transform(payload_like(a, 10.0))
+        a._outer_transform(payload_like(a, 7.0))  # anchor now 7.15, m = 3
+        checkpoint.save(a, str(tmp_path))
+        b = make()
+        assert checkpoint.maybe_restore(b, str(tmp_path))
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(a._outer_anchor),
+            jax.tree_util.tree_leaves(b._outer_anchor),
+        ):
+            np.testing.assert_array_equal(la, lb)
+        # both continue identically: round 3 lands on the hand-checked 5.7925
+        out_a = a._outer_transform(payload_like(a, 7.0))
+        out_b = b._outer_transform(payload_like(b, 7.0))
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(out_a), jax.tree_util.tree_leaves(out_b)
+        ):
+            np.testing.assert_allclose(la, lb, rtol=1e-7)
+            np.testing.assert_allclose(np.asarray(lb), 5.7925, rtol=1e-6)
+        # a mismatched schema re-seeds instead of loading garbage
+        c = Trainer(
+            get_model("mnist_mlp", d_hidden=8), batch_size=8,
+            outer_optimizer="nesterov",
+        )
+        # restore params will fail template match before outer state matters;
+        # drive the sidecar path directly with the wrong-schema trainer
+        import os
+
+        snap = os.path.join(str(tmp_path), f"step_{int(a.state.step)}")
+        checkpoint._maybe_restore_outer_state(c, snap)
+        assert c._outer_anchor is None  # re-seeded, not mis-loaded
+
     def test_outer_optimizer_rejects_grads_mode(self):
         import pytest
 

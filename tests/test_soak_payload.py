@@ -22,7 +22,7 @@ GPT2_SMALL_FLOATS = 124_439_808  # models/gpt2.py default config param count
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("wire", ["f32", "bf16"])
+@pytest.mark.parametrize("wire", ["f32", "bf16", "q8"])
 def test_sync_round_at_gpt2_small_scale(wire):
     async def main():
         tree_a = {"flat": np.full((GPT2_SMALL_FLOATS,), 1.0, np.float32)}
@@ -83,7 +83,7 @@ def _record_soak(wire: str, dt: float, ok: bool) -> None:
             "seconds": round(dt, 2),
             "floats": GPT2_SMALL_FLOATS,
             "payload_mb_per_contribution": round(
-                GPT2_SMALL_FLOATS * (4 if wire == "f32" else 2) / 1e6, 1
+                GPT2_SMALL_FLOATS * {"f32": 4, "bf16": 2, "q8": 1}[wire] / 1e6, 1
             ),
             "recorded_at": _t.strftime("%Y-%m-%dT%H:%M:%SZ", _t.gmtime()),
         }) + "\n")

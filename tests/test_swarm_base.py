@@ -216,6 +216,21 @@ class TestTransport:
 
         run(main())
 
+    def test_dst_alias_matching(self):
+        """The MAC'd destination must match this node: port exactly, host
+        by legitimate alias (advertised, bound, loopback). Distinct nodes'
+        alias sets can't collide — same machine implies distinct ports."""
+        t = Transport(host="0.0.0.0", advertise_host="10.1.2.3")
+        t._port = 7000
+        assert t._dst_is_me(["10.1.2.3", 7000])   # advertised
+        assert t._dst_is_me(["0.0.0.0", 7000])    # bound
+        assert t._dst_is_me(["127.0.0.1", 7000])  # loopback dial
+        assert t._dst_is_me(["localhost", 7000])
+        assert not t._dst_is_me(["10.9.9.9", 7000])   # another machine
+        assert not t._dst_is_me(["10.1.2.3", 7001])   # another node, same host
+        assert not t._dst_is_me(None)                 # frame without dst
+        assert not t._dst_is_me(["10.1.2.3"])         # malformed
+
     def test_unknown_method_raises(self):
         async def main():
             server = Transport()
