@@ -25,8 +25,12 @@ from run_matrix import RESULTS, TINY_GPT2, record, run_swarm  # noqa: E402
 TIMEOUTS = ["--join-timeout", "25", "--gather-timeout", "25"]
 
 
-def arm(tag: str, extra: list) -> dict:
-    base = ["--model", "gpt2_small", *TINY_GPT2, "--averaging", "sync",
+NESTEROV = ["--outer-optimizer", "nesterov",
+            "--outer-lr", "0.7", "--outer-momentum", "0.9"]
+
+
+def arm(tag: str, averaging: list, extra: list) -> dict:
+    base = ["--model", "gpt2_small", *TINY_GPT2, *averaging,
             "--average-every", "15", "--steps", "90", "--batch-size", "16",
             "--lr", "0.003", *TIMEOUTS, *extra]
     rows = run_swarm(f"outer_opt/{tag}", [
@@ -36,21 +40,27 @@ def arm(tag: str, extra: list) -> dict:
 
 
 def main() -> None:
+    sync = ["--averaging", "sync"]
+    # Byzantine pairs the outer step with robust aggregation (config-5's
+    # mode); 2 honest peers, trimmed_mean degrades to the mean at n=2 —
+    # the point here is composition, the robustness e2e lives in tests.
+    byz = ["--averaging", "byzantine", "--method", "trimmed_mean",
+           "--min-group", "2"]
     results = {
-        "plain": arm("plain", []),
-        "nesterov": arm("nesterov", [
-            "--outer-optimizer", "nesterov",
-            "--outer-lr", "0.7", "--outer-momentum", "0.9",
-        ]),
+        "plain": arm("plain", sync, []),
+        "nesterov": arm("nesterov", sync, NESTEROV),
+        "byz_plain": arm("byz_plain", byz, []),
+        "byz_nesterov": arm("byz_nesterov", byz, NESTEROV),
     }
     out = os.path.join(RESULTS, "outer_opt.jsonl")
     with open(out, "w") as fh:
         for tag, agg in results.items():
             fh.write(json.dumps({"arm": tag, **agg}) + "\n")
-    delta = results["plain"]["final_loss_mean"] - results["nesterov"]["final_loss_mean"]
-    print(f"outer_opt: plain {results['plain']['final_loss_mean']} vs "
-          f"nesterov {results['nesterov']['final_loss_mean']} "
-          f"(delta {delta:+.4f}; positive = outer wins)")
+    for pair in (("plain", "nesterov"), ("byz_plain", "byz_nesterov")):
+        delta = results[pair[0]]["final_loss_mean"] - results[pair[1]]["final_loss_mean"]
+        print(f"outer_opt: {pair[0]} {results[pair[0]]['final_loss_mean']} vs "
+              f"{pair[1]} {results[pair[1]]['final_loss_mean']} "
+              f"(delta {delta:+.4f}; positive = outer wins)")
 
 
 if __name__ == "__main__":
