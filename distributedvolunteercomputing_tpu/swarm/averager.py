@@ -84,6 +84,7 @@ class AveragerBase:
         namespace: str = "",
         wire: str = "f32",
         topk_frac: float = 0.01,
+        topk_warmup_rounds: int = 0,
         adaptive_timeout: bool = False,
     ):
         if wire not in ("f32", "bf16", "q8", "topk"):
@@ -110,7 +111,19 @@ class AveragerBase:
                 )
             if not 0.0 < topk_frac <= 1.0:
                 raise ValueError(f"topk_frac must be in (0, 1], got {topk_frac}")
+            if topk_warmup_rounds < 0:
+                raise ValueError(
+                    f"topk_warmup_rounds must be >= 0, got {topk_warmup_rounds}"
+                )
         self.topk_frac = topk_frac
+        # DGC-style sparsity warmup (Deep Gradient Compression's remedy for
+        # early-training divergence under aggressive sparsification, which
+        # the measured 80-round comparison shows: topk@1% converges behind
+        # dense): over the first N SUCCESSFUL rounds the kept fraction ramps
+        # exponentially from 1.0 (dense) to topk_frac, so early rounds — the
+        # ones that contract init noise — ship (nearly) everything and the
+        # aggressive fraction only applies once training stabilizes.
+        self.topk_warmup_rounds = int(topk_warmup_rounds)
         # Error-feedback residual (Deep Gradient Compression): entries a
         # contribution drops are banked and added to the NEXT contribution,
         # so every gradient coordinate eventually ships. The residual is
@@ -293,10 +306,18 @@ class AveragerBase:
             return wire, lambda: self._buf_from_payload(wire)
         if self._ef_residual is not None and self._ef_residual.size == buf.size:
             buf = buf + self._ef_residual
-        wire = native.topk_encode(buf, frac=self.topk_frac)
+        wire = native.topk_encode(buf, frac=self._effective_topk_frac())
         sent = native.topk_decode(wire)
         self._ef_pending = buf - sent
         return wire, lambda: sent
+
+    def _effective_topk_frac(self) -> float:
+        """Current kept fraction under the warmup schedule (see __init__);
+        the configured topk_frac once warmup completes or when disabled."""
+        n = self.topk_warmup_rounds
+        if n <= 0 or self.rounds_ok >= n:
+            return self.topk_frac
+        return float(self.topk_frac ** (self.rounds_ok / n))
 
     def _commit_ef(self, ok: bool) -> None:
         """Resolve the staged error-feedback residual for the last

@@ -61,6 +61,10 @@ class VolunteerConfig:
     # entries per round (error feedback banks the rest). ~50x fewer DCN
     # bytes at 0.01. Grads mode + sync/byzantine only.
     topk_frac: float = 0.01
+    # DGC-style sparsity warmup: ramp the kept fraction from dense to
+    # topk_frac over the first N successful rounds (0 = off). Early rounds
+    # contract init noise and need (nearly) full gradients.
+    topk_warmup_rounds: int = 0
     min_group: int = 2
     max_group: int = 16
     batch_size: int = 32  # samples per optimizer step (across accum microbatches)
@@ -146,6 +150,10 @@ class VolunteerConfig:
             if self.averaging not in ("sync", "byzantine"):
                 raise ValueError(
                     "wire='topk' requires --averaging sync or byzantine"
+                )
+            if self.topk_warmup_rounds < 0:
+                raise ValueError(
+                    f"topk_warmup_rounds must be >= 0, got {self.topk_warmup_rounds}"
                 )
             if self.averaging == "byzantine":
                 if self.method != "mean":
@@ -260,6 +268,7 @@ class Volunteer:
                 gather_timeout=self.cfg.gather_timeout,
                 wire=self.cfg.wire,
                 topk_frac=self.cfg.topk_frac,
+                topk_warmup_rounds=self.cfg.topk_warmup_rounds,
                 adaptive_timeout=self.cfg.adaptive_timeout,
             )
             if self.cfg.averaging == "byzantine" and (

@@ -60,6 +60,10 @@ def main() -> None:
     ap.add_argument("--topk-frac", type=float, default=0.01,
                     help="fraction of gradient entries kept per round by "
                          "--wire topk")
+    ap.add_argument("--topk-warmup-rounds", type=int, default=0,
+                    help="ramp the topk kept fraction from dense to "
+                         "--topk-frac over the first N successful rounds "
+                         "(DGC-style sparsity warmup; 0 = off)")
     ap.add_argument("--allow-unrobust-topk", action="store_true",
                     help="permit --averaging byzantine with --wire topk, "
                          "which runs a plain weighted mean (no Byzantine "
@@ -156,6 +160,7 @@ def main() -> None:
         average_what=args.average_what,
         wire=args.wire,
         topk_frac=args.topk_frac,
+        topk_warmup_rounds=args.topk_warmup_rounds,
         allow_unrobust_topk=args.allow_unrobust_topk,
         overlap=args.overlap,
         max_staleness=args.max_staleness,

@@ -714,6 +714,45 @@ class TestTopkScope:
         VolunteerConfig(averaging="byzantine", outer_optimizer="nesterov")
 
 
+class TestTopkWarmup:
+    def test_effective_frac_schedule(self):
+        """DGC-style warmup: exponential ramp from dense to topk_frac over
+        the first N successful rounds, then the configured fraction."""
+        async def main():
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=None)
+            mem = SwarmMembership(dht, "solo", ttl=10.0)
+            await mem.join()
+            try:
+                avg = SyncAverager(
+                    t, dht, mem, wire="topk", topk_frac=0.01,
+                    topk_warmup_rounds=4,
+                )
+                seq = []
+                for r in range(6):
+                    avg.rounds_ok = r
+                    seq.append(avg._effective_topk_frac())
+                # r=0 dense; exponential decay; r>=4 at the target
+                assert seq[0] == 1.0
+                np.testing.assert_allclose(
+                    seq[:5], [0.01 ** (r / 4) for r in range(4)] + [0.01],
+                    rtol=1e-12,
+                )
+                assert seq[5] == 0.01
+                assert all(a > b for a, b in zip(seq[:4], seq[1:5]))
+                # warmup off (default): always the configured fraction
+                flat = SyncAverager(t, dht, mem, wire="topk", topk_frac=0.01)
+                flat.rounds_ok = 0
+                assert flat._effective_topk_frac() == 0.01
+                with pytest.raises(ValueError, match="topk_warmup_rounds"):
+                    SyncAverager(t, dht, mem, wire="topk", topk_warmup_rounds=-1)
+            finally:
+                await t.close()
+
+        run(main())
+
+
 class TestSyncTopkEFDegraded:
     def test_dropped_contribution_does_not_commit_residual(self):
         """A member whose top-k push lands AFTER the leader's degraded
