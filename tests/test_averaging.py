@@ -420,6 +420,31 @@ class TestByzantine:
             assert r is not None
             assert 0.9 < float(r["w"].mean()) < 1.1
 
+    def test_bulyan_method_at_guarantee_scale(self):
+        """Bulyan through the full-mesh averager at n=7 (= 4f+3 for f=1):
+        six honest peers near 1.0 and one attacker at 500 — every honest
+        member's aggregate stays in the honest cluster."""
+        async def main():
+            vols = await spawn_volunteers(
+                7, ByzantineAverager, min_group=7, max_group=7,
+                method="bulyan", method_kw={"n_byzantine": 1},
+                join_timeout=15.0, gather_timeout=20.0,
+            )
+            honest_vals = (1.0, 1.02, 0.98, 1.01, 0.99, 1.03)
+            try:
+                return await asyncio.gather(
+                    *(vols[i][3].average(make_tree(honest_vals[i]), 1)
+                      for i in range(6)),
+                    vols[6][3].average(make_tree(500.0), 1),
+                )
+            finally:
+                await teardown(vols)
+
+        results = run(main())
+        for r in results[:6]:
+            assert r is not None
+            assert 0.9 < float(r["w"].mean()) < 1.1, float(r["w"].mean())
+
 
 class TestIdentityGuards:
     """Security regressions: forged/duplicate contributions are rejected."""
