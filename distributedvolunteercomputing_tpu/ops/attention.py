@@ -43,7 +43,31 @@ def sequence_parallel(mesh, axis: str = "sp", impl: str = "ring"):
 # Attention core selection: "xla" (fused einsum-softmax-einsum), "flash"
 # (pallas kernel, ops/pallas_attention.py), or "auto" (flash on TPU for
 # mask-free sequences long enough to fill a block, xla otherwise).
+#
+# auto routing is measurement-backed (round 4, TPU v5 lite,
+# experiments/results/attn_sweep.json + attn_ab.json + the bench A/B),
+# and dtype-aware because the measurements differ by dtype:
+#   - f32: flagship end-to-end (gpt2_small, bs=8, T=1024) runs 59.07
+#     samples/s with the flash kernel vs 51.11 with the XLA core (+15.6%);
+#     per-op fwd+bwd agrees from T=1024 (1.02-1.22x). -> flash from 1024.
+#   - bf16: per-op XLA wins at T<=2048 (flash 0.85-0.95x) and flash wins
+#     at T=4096 (1.48x); no end-to-end bf16 A/B exists yet. -> flash from
+#     4096 only.
+# Known residual: at T=8192 flash did not compile on the dev tunnel
+# (remote-compile-helper HTTP 500). That is infra, not a kernel property:
+# the PURE-XLA full-model compile at bs=16/32 died with the identical
+# HTTP 500 (BASELINE.md TPU table) — the tunnel's helper kills large
+# compiles of any kind. On a standard TPU runtime flash is the
+# memory-feasible option at long T (no [T,T] score matrix); users on a
+# runtime where it won't compile can force DVC_ATTN_IMPL=xla.
+# Micro-benchmarks on this chip's tunneled runtime need care —
+# block_until_ready does not synchronize (experiments/timing_diag.py), so
+# only chained-execution numbers (the bench, the differenced sweep) are
+# trusted for this decision.
 _impl = os.environ.get("DVC_ATTN_IMPL", "auto")
+# Measured crossovers for auto routing (see block comment above).
+_AUTO_FLASH_MIN_T_F32 = 1024
+_AUTO_FLASH_MIN_T_OTHER = 4096
 
 
 def set_attention_impl(name: str) -> None:
@@ -75,7 +99,10 @@ def _route_to_flash(q: jax.Array, k: jax.Array, causal: bool, mask) -> bool:
         return True
     from distributedvolunteercomputing_tpu.utils.jaxenv import tpu_backend
 
-    return _impl == "auto" and tpu_backend() and q.shape[-2] >= 128
+    min_t = (
+        _AUTO_FLASH_MIN_T_F32 if q.dtype == jnp.float32 else _AUTO_FLASH_MIN_T_OTHER
+    )
+    return _impl == "auto" and tpu_backend() and q.shape[-2] >= min_t
 
 
 def attention_core(

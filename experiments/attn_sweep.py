@@ -1,0 +1,163 @@
+"""Attention-impl crossover sweep on hardware: XLA fused core vs Pallas flash.
+
+chip_probe's attn_ab stage answers "which core wins at the flagship bench
+shape" (one point: T=1024 f32 -> xla). The `auto` routing needs more than one
+point: the flash kernel's claim is O(T) HBM traffic vs the XLA core's O(T^2)
+score matrix, so there should be a sequence length where flash takes over.
+This sweep measures fwd+bwd time for both impls across T (token budget held
+~constant: B = max(1, 8192 // T)) in bf16 (the training dtype) and f32, and
+writes per-config rows + the measured crossover to
+experiments/results/attn_sweep.json. The routing threshold in
+ops/attention.py cites this artifact.
+
+Run only in a live chip window (backend init hangs when the chip is wedged).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def time_impl(attention, jax, jnp, impl, B, H, T, D, dtype):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, T, D), dtype)
+    k = jax.random.normal(ks[1], (B, H, T, D), dtype)
+    v = jax.random.normal(ks[2], (B, H, T, D), dtype)
+    attention.set_attention_impl(impl)
+    try:
+        # Timing on the tunneled axon platform (see experiments/
+        # timing_diag.py): block_until_ready does NOT wait for execution
+        # (~0.03ms "times" at any shape, ~1000x the chip's FLOP rate), and
+        # per-call device round-trips swamp kernel time. The only reliable
+        # recipe, matching the full-model bench's methodology:
+        #   - chain iterations inside ONE compiled fori_loop (no execution
+        #     can be elided or cache-served; all three grads feed the carry
+        #     so dk/dv aren't dead-code-eliminated),
+        #   - return only a SCALAR and fetch it to host (device_get is the
+        #     one call observed to synchronize),
+        #   - run two iteration counts and difference the wall times, which
+        #     cancels upload latency + dispatch + fetch overhead.
+        def loss(q, k, v):
+            o = attention.attention_core_local(q, k, v, causal=True)
+            return o.astype(jnp.float32).sum()
+
+        def chained(iters):
+            def run(q, k, v):
+                def body(_, qkv):
+                    q, k, v = qkv
+                    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+                    def renorm(x, g):
+                        # Keep magnitudes stable across iterations.
+                        return (g / (jnp.float32(1e-6) + jnp.abs(g).max())).astype(x.dtype)
+
+                    return (renorm(q, dq), renorm(k, dk), renorm(v, dv))
+
+                q, k, v = jax.lax.fori_loop(0, iters, body, (q, k, v))
+                return q.astype(jnp.float32).sum()
+
+            return jax.jit(run)
+
+        n_lo, n_hi = 4, 24
+        f_lo, f_hi = chained(n_lo), chained(n_hi)
+        t0 = time.monotonic()
+        float(f_lo(q, k, v))
+        float(f_hi(q, k, v))
+        # Compile + 28 executed iterations — a warmup figure, not pure
+        # compile time (at large T the execution share dominates).
+        first_calls_s = time.monotonic() - t0
+
+        def timed(fn):
+            t0 = time.perf_counter()
+            float(fn(q, k, v))
+            return time.perf_counter() - t0
+
+        # Interleave the arms (lo, hi, lo, hi, ...) so a monotonic drift in
+        # tunnel latency hits both arms alike, and take per-arm minima:
+        # robust to one-off stalls.
+        lo_times, hi_times = [], []
+        for _ in range(3):
+            lo_times.append(timed(f_lo))
+            hi_times.append(timed(f_hi))
+        dt_ms = (min(hi_times) - min(lo_times)) / (n_hi - n_lo) * 1e3
+        if dt_ms <= 0.05:
+            # A differenced time at or below dispatch noise means a stalled
+            # lo arm swallowed the signal — a non-measurement, not a fast
+            # kernel. Report it as failed so winner/crossover (and the
+            # routing constants that cite them) can't be decided by noise.
+            return {
+                "ok": False,
+                "error": f"non-positive/noise differenced time ({dt_ms:.4f} ms)"
+                         " — tunnel stall during the lo arm",
+            }
+        return {
+            "ok": True,
+            "first_calls_s": round(first_calls_s, 2),
+            "fwd_bwd_ms": round(dt_ms, 3),
+        }
+    except Exception as err:  # noqa: BLE001 — one impl failing IS a result
+        return {"ok": False, "error": f"{type(err).__name__}: {str(err)[:200]}"}
+    finally:
+        attention.set_attention_impl("auto")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from distributedvolunteercomputing_tpu.ops import attention
+
+    H, D = 12, 64
+    rows = []
+    for dtype_name in ("bfloat16", "float32"):
+        dtype = jnp.dtype(dtype_name)
+        for T in (512, 1024, 2048, 4096, 8192):
+            B = max(1, 8192 // T)
+            row = {"dtype": dtype_name, "B": B, "H": H, "T": T, "D": D}
+            for impl in ("xla", "flash"):
+                print(f"sweep {dtype_name} T={T} B={B} {impl} ...", flush=True)
+                row[impl] = time_impl(attention, jax, jnp, impl, B, H, T, D, dtype)
+            if row["xla"].get("ok") and row["flash"].get("ok"):
+                row["winner"] = min(("xla", "flash"), key=lambda i: row[i]["fwd_bwd_ms"])
+                row["speedup_flash"] = round(
+                    row["xla"]["fwd_bwd_ms"] / row["flash"]["fwd_bwd_ms"], 3
+                )
+            print(f"  -> {json.dumps(row)}", flush=True)
+            rows.append(row)
+    # Crossover per dtype: smallest T from which flash wins at EVERY larger
+    # measured T (suffix-win). A flash compile failure at some T also breaks
+    # the suffix — routing to a kernel that may not compile is never right.
+    crossover = {}
+    for dtype_name in ("bfloat16", "float32"):
+        drows = sorted(
+            (r for r in rows if r["dtype"] == dtype_name), key=lambda r: r["T"]
+        )
+        best = None
+        for r in reversed(drows):  # largest T first; stop at first non-win
+            if r.get("winner") == "flash":
+                best = r["T"]
+            else:
+                break
+        crossover[dtype_name] = best
+    out = {
+        "device_kind": jax.devices()[0].device_kind,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": rows,
+        "flash_wins_from_T": crossover,
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results", "attn_sweep.json"
+    )
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {path}")
+    print(json.dumps(crossover))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,11 @@ import os
 import sys
 import time
 
+# The probe is launched as `python experiments/chip_probe.py`, so sys.path[0]
+# is experiments/ — put the repo root first so the package imports without an
+# install step (the workdir is re-provisioned between rounds).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 STAGES = []
 
 
@@ -134,8 +139,7 @@ def _gpt2_step(ctx):
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     # est_mfu via the same 6ND convention as bench.py (lower bound: remat
-    # recompute not counted).
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # recompute not counted). Repo root is already on sys.path (module top).
     try:
         from bench import _peak_flops
 
@@ -158,9 +162,13 @@ def _attn_ab(ctx):
     on hardware: fwd+bwd at flagship bench shapes (gpt2_small heads:
     B=8, H=12, T=1024, D=64, causal). Records per-impl compile + step time
     and the cross-impl numeric diff to results/attn_ab.json so the default
-    "auto" routing is backed by measurement, not hypothesis (the bench
-    ladder's rung 4 hypothesizes Mosaic is the unstable piece — this stage
-    answers whether it even compiles here, and which core is faster).
+    "auto" routing is backed by measurement, not hypothesis.
+    Timing delegates to experiments/attn_sweep.time_impl — the chained-
+    fori_loop + scalar-fetch + differenced-iteration recipe, the only one
+    that reflects real execution on the tunneled axon runtime (open-loop
+    block_until_ready timing returns ~0.03ms at any shape; see
+    experiments/timing_diag.py and the round-4 bench A/B, where the full
+    model ran FASTER with the kernel the open-loop timing called slower).
     Runs AFTER the bench-grade record stage on purpose: a Mosaic hang in
     this stage must not cost the round its samples/sec number."""
     import json
@@ -168,57 +176,52 @@ def _attn_ab(ctx):
     jax = ctx["jax"]
     import jax.numpy as jnp
 
+    from experiments.attn_sweep import time_impl
     from distributedvolunteercomputing_tpu.ops import attention
 
     B, H, T, D = (
         int(x) for x in os.environ.get("DVC_PROBE_AB_SHAPE", "8,12,1024,64").split(",")
     )
+    results = {"shapes": f"B{B} H{H} T{T} D{D} causal f32",
+               "device_kind": jax.devices()[0].device_kind,
+               "methodology": "chained fori_loop, scalar fetch, differenced iters",
+               "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    for impl in ("xla", "flash"):
+        results[impl] = time_impl(attention, jax, jnp, impl, B, H, T, D, jnp.float32)
+    # Numeric cross-check (one fwd+dq per impl; correctness, not timing).
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32)
     k = jax.random.normal(ks[1], (B, H, T, D), jnp.float32)
     v = jax.random.normal(ks[2], (B, H, T, D), jnp.float32)
-    results = {"shapes": f"B{B} H{H} T{T} D{D} causal f32",
-               "device_kind": jax.devices()[0].device_kind,
-               "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
     outs = {}
     for impl in ("xla", "flash"):
+        if not results[impl].get("ok"):
+            continue
         attention.set_attention_impl(impl)
         try:
             def loss(q, k, v):
                 o = attention.attention_core_local(q, k, v, causal=True)
                 return o.astype(jnp.float32).sum(), o
 
-            f = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True))
-            t0 = time.monotonic()
-            (_, out), grads = f(q, k, v)
-            jax.block_until_ready((out, grads))
-            compile_s = time.monotonic() - t0
-            iters = 20
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                (_, out), grads = f(q, k, v)
-            jax.block_until_ready((out, grads))
-            dt_ms = (time.perf_counter() - t0) / iters * 1e3
-            outs[impl] = (out, grads[0])
-            results[impl] = {
-                "ok": True,
-                "compile_s": round(compile_s, 2),
-                "fwd_bwd_ms": round(dt_ms, 3),
-            }
-        except Exception as err:  # noqa: BLE001 — one impl failing IS a result
-            results[impl] = {
-                "ok": False,
-                "error": f"{type(err).__name__}: {str(err)[:300]}",
-            }
+            # Keep the tensors on device; only scalars cross the tunnel.
+            outs[impl] = jax.jit(
+                jax.value_and_grad(loss, argnums=(0,), has_aux=True)
+            )(q, k, v)
+        except Exception as err:  # noqa: BLE001 — don't lose the timings
+            results[impl]["crosscheck_error"] = f"{type(err).__name__}: {str(err)[:200]}"
         finally:
             attention.set_attention_impl("auto")
     if len(outs) == 2:
-        results["max_abs_diff_fwd"] = float(
-            jnp.max(jnp.abs(outs["xla"][0] - outs["flash"][0]))
-        )
-        results["max_abs_diff_dq"] = float(
-            jnp.max(jnp.abs(outs["xla"][1] - outs["flash"][1]))
-        )
+        (_, out_x), grads_x = outs["xla"]
+        (_, out_f), grads_f = outs["flash"]
+        try:
+            results["max_abs_diff_fwd"] = float(jnp.max(jnp.abs(out_x - out_f)))
+            results["max_abs_diff_dq"] = float(
+                jnp.max(jnp.abs(grads_x[0] - grads_f[0]))
+            )
+        except Exception as err:  # noqa: BLE001 — don't lose the timings
+            results["crosscheck_error"] = f"{type(err).__name__}: {str(err)[:200]}"
+    if results.get("xla", {}).get("ok") and results.get("flash", {}).get("ok"):
         results["winner"] = min(
             ("xla", "flash"), key=lambda i: results[i]["fwd_bwd_ms"]
         )

@@ -1,0 +1,78 @@
+"""Diagnose the attn_sweep timing artifact on the axon remote platform.
+
+attn_sweep measured 0.02ms fwd+bwd at shapes where chip_probe measured 8ms —
+block_until_ready(grads) is apparently not waiting for real completion here.
+Times the same jitted grad three ways at one shape to see which sync method
+reflects real execution: (a) block_until_ready per iter, (b) one block after
+N iters, (c) chained data dependency + scalar device_get.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from distributedvolunteercomputing_tpu.ops import attention
+
+B, H, T, D = 8, 12, 1024, 64
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32)
+k = jax.random.normal(ks[1], (B, H, T, D), jnp.float32)
+v = jax.random.normal(ks[2], (B, H, T, D), jnp.float32)
+
+attention.set_attention_impl("xla")
+
+
+def loss(q, k, v):
+    o = attention.attention_core_local(q, k, v, causal=True)
+    return o.astype(jnp.float32).sum()
+
+
+f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+g = f(q, k, v)
+jax.block_until_ready(g)
+print("compiled", flush=True)
+
+# (a) block each iteration
+t0 = time.perf_counter()
+for _ in range(10):
+    g = f(q, k, v)
+    jax.block_until_ready(g)
+print(f"a per-iter block: {(time.perf_counter()-t0)/10*1e3:.3f} ms", flush=True)
+
+# (b) one block at the end
+t0 = time.perf_counter()
+for _ in range(10):
+    g = f(q, k, v)
+jax.block_until_ready(g)
+print(f"b end block:      {(time.perf_counter()-t0)/10*1e3:.3f} ms", flush=True)
+
+# (c) chained dependency: feed grad back in, then fetch a scalar
+t0 = time.perf_counter()
+qq = q
+for _ in range(10):
+    g = f(qq, k, v)
+    qq = g[0]
+s = float(jax.device_get(jnp.sum(qq)))
+print(f"c chained+get:    {(time.perf_counter()-t0)/10*1e3:.3f} ms (s={s:.3g})", flush=True)
+
+# (d) the probe's exact pattern: value_and_grad with aux out, block on both
+def loss2(q, k, v):
+    o = attention.attention_core_local(q, k, v, causal=True)
+    return o.astype(jnp.float32).sum(), o
+
+
+f2 = jax.jit(jax.value_and_grad(loss2, argnums=(0, 1, 2), has_aux=True))
+(_, out), g2 = f2(q, k, v)
+jax.block_until_ready((out, g2))
+t0 = time.perf_counter()
+for _ in range(10):
+    (_, out), g2 = f2(q, k, v)
+jax.block_until_ready((out, g2))
+print(f"d probe pattern:  {(time.perf_counter()-t0)/10*1e3:.3f} ms", flush=True)
