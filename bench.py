@@ -504,6 +504,12 @@ def _bench_main() -> int:
     from distributedvolunteercomputing_tpu.models import get_model
     from distributedvolunteercomputing_tpu.training.optim import make_optimizer
     from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+    from distributedvolunteercomputing_tpu.utils.jaxenv import enable_compile_cache
+
+    # Persistent compile cache: fresh-child ladder rungs re-compile the same
+    # programs; a disk hit cuts each rung's compile stage to seconds (timing
+    # is unaffected — the cache changes compile time, not step time).
+    enable_compile_cache()
 
     if os.environ.get("DVC_BENCH_WARM_LADDER") == "1":
         # Judge-observed (r02 bisect) success path: the flagship config passed

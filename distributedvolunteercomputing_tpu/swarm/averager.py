@@ -36,7 +36,7 @@ from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.matchmaking import Group, Matchmaker
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
 from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer, unflatten_from_buffer
 
 log = get_logger(__name__)
@@ -53,6 +53,11 @@ class _Round:
         # contributions in" check can't be tripped by forged entries.
         self.tokens: Optional[Dict[str, str]] = None
         self.full = asyncio.Event()
+        # powersgd only: raw wire payloads per contribution key, kept so the
+        # sync leader can serve the EXACT factored mean (concatenated
+        # weighted factor pairs) instead of a dense result — by linearity
+        # decode(merge(payloads)) == weighted mean of the decoded denses.
+        self.payloads: Dict[Any, bytes] = {}
         self.result: Optional[np.ndarray] = None
         self.result_wire: bytes = b""  # encoded once; served to every fetch
         self.result_ready = asyncio.Event()
@@ -85,10 +90,23 @@ class AveragerBase:
         wire: str = "f32",
         topk_frac: float = 0.01,
         topk_warmup_rounds: int = 0,
+        powersgd_rank: int = 4,
         adaptive_timeout: bool = False,
     ):
-        if wire not in ("f32", "bf16", "q8", "topk"):
+        if wire not in ("f32", "bf16", "q8", "topk", "powersgd"):
             raise ValueError(f"unknown wire dtype {wire!r}")
+        if wire == "powersgd":
+            # Low-rank is a GRADIENT compressor for gather-style protocols,
+            # same reasoning as topk below — but unlike topk it composes
+            # with the robust estimators (reconstructions are DENSE, so
+            # krum/trimmed/bulyan see ordinary vectors): any method is fine.
+            if self.mode not in ("sync", "byzantine"):
+                raise ValueError(
+                    f"wire='powersgd' is not supported for {self.mode} averaging "
+                    "(gather-style sync/byzantine only)"
+                )
+            if powersgd_rank < 1:
+                raise ValueError(f"powersgd_rank must be >= 1, got {powersgd_rank}")
         if wire == "topk":
             # Top-k is a GRADIENT compressor for gather-style protocols:
             # pairwise mixing (gossip/butterfly) compounds the truncation at
@@ -124,6 +142,8 @@ class AveragerBase:
         # ones that contract init noise — ship (nearly) everything and the
         # aggressive fraction only applies once training stabilizes.
         self.topk_warmup_rounds = int(topk_warmup_rounds)
+        self.powersgd_rank = int(powersgd_rank)
+        self._psgd_codec = None  # built lazily: needs _specs from first _pack
         # Error-feedback residual (Deep Gradient Compression): entries a
         # contribution drops are banked and added to the NEXT contribution,
         # so every gradient coordinate eventually ships. The residual is
@@ -258,7 +278,11 @@ class AveragerBase:
             # being accepted on the receive path (e.g. a gossip push banked
             # into the wrong inbox). With the namespace folded in, every
             # averager's _check_schema rejects it at the door.
-            wire_tag = f"topk:{self.topk_frac}" if self.wire == "topk" else self.wire
+            wire_tag = self.wire
+            if self.wire == "topk":
+                wire_tag = f"topk:{self.topk_frac}"
+            elif self.wire == "powersgd":
+                wire_tag = f"powersgd:{self.powersgd_rank}"
             self._schema = hashlib.sha1(
                 repr(
                     [(s.shape, s.dtype) for s in specs] + [wire_tag, self.namespace]
@@ -275,6 +299,17 @@ class AveragerBase:
         # early-arriving contribution from a faster peer is normal).
         return self._schema is None or args.get("schema") == self._schema
 
+    def _psgd(self):
+        """The PowerSGD codec for this averager's buffers (lazy: the plan
+        needs ``_specs``, which exist after the first ``_pack``)."""
+        if self._psgd_codec is None:
+            from distributedvolunteercomputing_tpu.swarm import powersgd
+
+            self._psgd_codec = powersgd.PowerSGDCodec(
+                self._specs, rank=self.powersgd_rank
+            )
+        return self._psgd_codec
+
     def _to_wire(self, buf: np.ndarray) -> bytes:
         if self.wire == "bf16":
             return native.f32_to_bf16(buf).tobytes()
@@ -285,6 +320,12 @@ class AveragerBase:
             # dense); top-k TRUNCATION is only ever applied to contributions
             # via _compress_contribution, where error feedback catches it.
             return native.topk_encode(buf)
+        if self.wire == "powersgd":
+            # Results ship dense (in the self-describing container): no
+            # error feedback exists on the result path, so low-rank
+            # truncation there would be silent, uncorrected error — the
+            # same dense-results policy as topk above.
+            return self._psgd().encode_dense(buf)
         return buf.tobytes()
 
     def _compress_contribution(
@@ -299,15 +340,24 @@ class AveragerBase:
         codec this is (_to_wire, lazy decode of the same bytes); the dense
         view is lazy because sync members never need it — only the leader
         and the byzantine path stack their own contribution."""
-        if self.wire != "topk":
+        if self.wire not in ("topk", "powersgd"):
             wire = self._to_wire(buf)
             if self.wire == "f32":
                 return wire, lambda: buf
             return wire, lambda: self._buf_from_payload(wire)
+        # Lossy-truncation codecs share the error-feedback protocol: add the
+        # banked residual, truncate, stage (buf - sent) as PENDING until the
+        # round's outcome commits or discards it (_commit_ef).
         if self._ef_residual is not None and self._ef_residual.size == buf.size:
             buf = buf + self._ef_residual
-        wire = native.topk_encode(buf, frac=self._effective_topk_frac())
-        sent = native.topk_decode(wire)
+        if self.wire == "powersgd":
+            from distributedvolunteercomputing_tpu.swarm import powersgd
+
+            wire = self._psgd().encode(buf)
+            sent = powersgd.decode(wire)
+        else:
+            wire = native.topk_encode(buf, frac=self._effective_topk_frac())
+            sent = native.topk_decode(wire)
         self._ef_pending = buf - sent
         return wire, lambda: sent
 
@@ -341,6 +391,9 @@ class AveragerBase:
             return native.q8_decode(native.q8_encode(buf))
         if self.wire == "topk":
             return native.topk_decode(native.topk_encode(buf))
+        # powersgd: pairwise modes are refused at construction; the only
+        # non-contribution sends are dense-container results, an exact
+        # round-trip — so the raw buffer IS the as-peers-see-it view.
         return buf
 
     def _buf_from_payload(self, payload: bytes) -> np.ndarray:
@@ -350,6 +403,13 @@ class AveragerBase:
             return native.q8_decode(payload)
         if self.wire == "topk":
             return native.topk_decode(payload)
+        if self.wire == "powersgd":
+            # Self-describing container (low-rank contributions AND dense
+            # results); needs no codec state, so early pushes that arrive
+            # before this node's first pack decode fine.
+            from distributedvolunteercomputing_tpu.swarm import powersgd
+
+            return powersgd.decode(payload)
         return np.frombuffer(payload, np.float32).copy()
 
     # -- off-loop wrappers for payload-sized work --------------------------
@@ -433,6 +493,12 @@ class SyncAverager(AveragerBase):
             if len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
                 raise RPCError("round contribution cap reached")
             st.contribs[key] = (float(args["weight"]), buf)
+            if self.wire == "powersgd" and self.method == "mean":
+                # Keep the compressed form too: the leader serves the round
+                # result as the exact factored mean of these (see _Round).
+                # Robust methods never merge factored (nonlinear), so they
+                # don't pay the retention.
+                st.payloads[key] = payload
         if st.expected:
             valid = {
                 p for p, t in st.contribs
@@ -486,12 +552,12 @@ class SyncAverager(AveragerBase):
         try:
             if group.my_index == 0:
                 result = await self._lead_round(
-                    group, await asyncio.to_thread(sent), weight
+                    group, await asyncio.to_thread(sent), weight, wire_bytes
                 )
             else:
                 result = await self._member_round(group, weight, wire_bytes)
         except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
-            log.info("sync round %d failed (%s); continuing local", round_no, e)
+            log.info("sync round %d failed (%s); continuing local", round_no, errstr(e))
             self.rounds_skipped += 1
             self._observe_round_failure()
             self._commit_ef(False)
@@ -503,7 +569,13 @@ class SyncAverager(AveragerBase):
             self._observe_round_time(time.monotonic() - t0)
         return result
 
-    async def _lead_round(self, group: Group, buf: np.ndarray, weight: float):
+    async def _lead_round(
+        self,
+        group: Group,
+        buf: np.ndarray,
+        weight: float,
+        wire_bytes: bytes = b"",
+    ):
         member_ids = [pid for pid, _ in group.members]
         st = self._rounds.get(group.epoch)
         if st is None:
@@ -516,7 +588,12 @@ class SyncAverager(AveragerBase):
         st.contribs = {
             (p, t): c for (p, t), c in st.contribs.items() if tokens.get(p) == t
         }
+        st.payloads = {
+            k: pl for k, pl in st.payloads.items() if k in st.contribs
+        }
         st.contribs[(self.peer_id, group.token)] = (weight, buf)
+        if self.wire == "powersgd" and wire_bytes:
+            st.payloads[(self.peer_id, group.token)] = wire_bytes
         if {p for p, _ in st.contribs} >= st.expected:
             st.full.set()
         try:
@@ -561,7 +638,32 @@ class SyncAverager(AveragerBase):
             # fetches park on result_ready; heartbeats must keep flowing).
             st.result = await asyncio.to_thread(_aggregate)
             # Encode the wire form ONCE before releasing the fetch waiters.
-            st.result_wire = await self._encode_wire(st.result)
+            if self.wire == "powersgd" and self.method == "mean":
+                # Serve the EXACT factored mean (concatenated weighted
+                # factor pairs): same value members would get densely, at a
+                # fraction of the result-fetch bytes. Falls back to the
+                # dense container if any contribution's payload is missing
+                # (e.g. a parked entry from before this leader's round).
+                good_keys = {(p, t) for (p, t) in st.contribs if p in good}
+
+                def _merge_or_dense() -> bytes:
+                    from distributedvolunteercomputing_tpu.swarm import powersgd
+
+                    try:
+                        pairs = [
+                            (st.contribs[k][0], st.payloads[k]) for k in good_keys
+                        ]
+                        return powersgd.merge(pairs)
+                    except (KeyError, ValueError):
+                        # Missing payload (parked before this round) or a
+                        # crafted container whose entry split disagrees with
+                        # the others — the round must not die over the
+                        # result ENCODING; serve the dense container.
+                        return self._to_wire(st.result)
+
+                st.result_wire = await asyncio.to_thread(_merge_or_dense)
+            else:
+                st.result_wire = await self._encode_wire(st.result)
             st.result_ready.set()
             self.rounds_ok += 1
             # Keep state around long enough for members to fetch.
@@ -731,7 +833,7 @@ class GossipAverager(AveragerBase):
                 self._current = (w, buf)
                 mixed = True
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
-                log.info("gossip with %s failed (%s)", pid, e)
+                log.info("gossip with %s failed (%s)", pid, errstr(e))
                 self._observe_round_failure()
         if not mixed:
             self.rounds_skipped += 1
@@ -864,7 +966,7 @@ class ButterflyAverager(AveragerBase):
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info(
                     "butterfly round %d stage %d with %s failed (%s); skipping stage",
-                    round_no, s, partner_id, e,
+                    round_no, s, partner_id, errstr(e),
                 )
             finally:
                 self._stages.pop((group.epoch, s), None)
@@ -963,7 +1065,7 @@ class ByzantineAverager(AveragerBase):
                     timeout=self.effective_gather_timeout,
                 )
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
-                log.info("byz push to %s failed: %s", addr, e)
+                log.info("byz push to %s failed: %s", addr, errstr(e))
 
         t0 = time.monotonic()
         degraded = False

@@ -30,7 +30,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
 
@@ -151,7 +151,7 @@ class DHTNode:
                 )
                 self._add_contact(int(ret["id"]), tuple(ret["addr"]))
             except (RPCError, OSError, asyncio.TimeoutError) as e:
-                log.warning("bootstrap peer %s unreachable: %s", peer, e)
+                log.warning("bootstrap peer %s unreachable: %s", peer, errstr(e))
         if bootstrap:
             # Standard Kademlia join: lookup own id to populate the table.
             await self._lookup(self.node_id)
@@ -310,7 +310,7 @@ class DHTNode:
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — maintenance must not die
-                log.debug("dht maintenance iteration failed: %s", e)
+                log.debug("dht maintenance iteration failed: %s", errstr(e))
 
     async def _republish_owned(self) -> None:
         now = time.monotonic()

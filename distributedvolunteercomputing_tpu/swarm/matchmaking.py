@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
 
@@ -210,7 +210,7 @@ class Matchmaker:
                 )
                 reached.append(pid)
             except Exception as e:
-                log.warning("round %s: member %s unreachable at begin: %s", round_key, pid, e)
+                log.warning("round %s: member %s unreachable at begin: %s", round_key, pid, errstr(e))
         if not reached:
             return None
         return Group(

@@ -18,7 +18,7 @@ import time
 from typing import Dict, Optional
 
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
 
@@ -74,7 +74,7 @@ class SwarmMembership:
                         PEERS_KEY, self._record(), subkey=self.peer_id, ttl=self.ttl
                     )
                 except Exception as e:
-                    log.warning("heartbeat store failed: %s", e)
+                    log.warning("heartbeat store failed: %s", errstr(e))
         except asyncio.CancelledError:
             pass
 

@@ -56,7 +56,7 @@ import numpy as np
 from distributedvolunteercomputing_tpu import native
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 from distributedvolunteercomputing_tpu.utils.pytree import (
     flatten_to_buffer,
     tree_specs,
@@ -332,5 +332,5 @@ class StateSyncService:
                 # of the read-only frombuffer view.
                 return got_step, unflatten_from_buffer(buf, specs, treedef)
             except (RPCError, OSError, asyncio.TimeoutError, ValueError) as e:
-                log.info("state pull from %s failed (%s); trying next", pid, e)
+                log.info("state pull from %s failed (%s); trying next", pid, errstr(e))
         return None

@@ -48,7 +48,7 @@ import zlib
 from collections import deque
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
 
@@ -270,7 +270,7 @@ class Transport:
                 try:
                     resp_meta, resp_payload = await handler(meta.get("args", {}), payload)
                 except Exception as e:  # handler errors go back on the wire
-                    log.debug("handler %s raised: %s", method, e)
+                    log.debug("handler %s raised: %s", method, errstr(e))
                     await self._write_frame(
                         writer, TYPE_ERR, {"rid": rid, "error": f"{type(e).__name__}: {e}"}, b""
                     )

@@ -32,7 +32,7 @@ from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
 from distributedvolunteercomputing_tpu.swarm.state_sync import StateSyncService
 from distributedvolunteercomputing_tpu.swarm.transport import Transport, read_secret
 from distributedvolunteercomputing_tpu.training.trainer import Trainer
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
 
@@ -56,7 +56,7 @@ class VolunteerConfig:
     # samples/sec at payload scale (BASELINE.md north-star).
     overlap: bool = True
     max_staleness: int = 0  # steps; 0 = unbounded (rounds self-bound via timeouts)
-    wire: str = "f32"  # f32|bf16|q8|topk — WAN payload codec
+    wire: str = "f32"  # f32|bf16|q8|topk|powersgd — WAN payload codec
     # wire="topk" fraction: ship only the top |value| fraction of gradient
     # entries per round (error feedback banks the rest). ~50x fewer DCN
     # bytes at 0.01. Grads mode + sync/byzantine only.
@@ -65,6 +65,12 @@ class VolunteerConfig:
     # topk_frac over the first N successful rounds (0 = off). Early rounds
     # contract init noise and need (nearly) full gradients.
     topk_warmup_rounds: int = 0
+    # wire="powersgd" target rank: each >=2D gradient tensor ships as a
+    # rank-r (P, Q) pair — (n+m)·r floats instead of n·m — with warm-started
+    # power iteration + the same error feedback as topk. Unlike topk it
+    # composes with the robust estimators (reconstructions are dense), so
+    # byzantine mode keeps its guarantees. Grads mode + sync/byzantine only.
+    powersgd_rank: int = 4
     min_group: int = 2
     max_group: int = 16
     batch_size: int = 32  # samples per optimizer step (across accum microbatches)
@@ -139,6 +145,21 @@ class VolunteerConfig:
                     "(gossip/butterfly rounds are pairwise/subset averages, "
                     "not a common aggregate — momentum over them amplifies "
                     "disagreement)"
+                )
+        if self.wire == "powersgd":
+            # Fail at config time (same policy as topk below). Low-rank of a
+            # parameter tree would truncate the model itself, and pairwise
+            # protocols compound truncation per hop — but robust estimators
+            # are FINE: reconstructions are dense vectors.
+            if self.average_what != "grads":
+                raise ValueError("wire='powersgd' requires --average-what grads")
+            if self.averaging not in ("sync", "byzantine"):
+                raise ValueError(
+                    "wire='powersgd' requires --averaging sync or byzantine"
+                )
+            if self.powersgd_rank < 1:
+                raise ValueError(
+                    f"powersgd_rank must be >= 1, got {self.powersgd_rank}"
                 )
         if self.wire == "topk":
             # Fail at config time, before the transport binds or membership
@@ -233,7 +254,7 @@ class Volunteer:
         try:
             return fut.result(timeout=self.cfg.join_timeout + self.cfg.gather_timeout + 15.0)
         except Exception as e:
-            log.warning("averaging at step %d failed: %s", step, e)
+            log.warning("averaging at step %d failed: %s", step, errstr(e))
             return None
 
     # -- lifecycle ---------------------------------------------------------
@@ -269,6 +290,7 @@ class Volunteer:
                 wire=self.cfg.wire,
                 topk_frac=self.cfg.topk_frac,
                 topk_warmup_rounds=self.cfg.topk_warmup_rounds,
+                powersgd_rank=self.cfg.powersgd_rank,
                 adaptive_timeout=self.cfg.adaptive_timeout,
             )
             if self.cfg.averaging == "byzantine" and (

@@ -16,7 +16,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
 
@@ -73,7 +73,7 @@ def _gc(ckpt_dir: str, just_saved: int, keep: int = 0) -> None:
                 os.remove(outer)
             log.info("checkpoint GC: removed %s", path)
         except OSError as e:
-            log.warning("checkpoint GC failed for %s: %s", path, e)
+            log.warning("checkpoint GC failed for %s: %s", path, errstr(e))
 
 
 def _outer_state_path(snapshot_path: str) -> str:
@@ -100,7 +100,7 @@ def _save_outer_state(trainer, snapshot_path: str) -> None:
     try:
         np.savez(_outer_state_path(snapshot_path), anchor=buf_a, m=buf_m)
     except OSError as e:
-        log.warning("outer-state save failed (continuing): %s", e)
+        log.warning("outer-state save failed (continuing): %s", errstr(e))
 
 
 def _maybe_restore_outer_state(trainer, snapshot_path: str) -> None:
@@ -125,7 +125,7 @@ def _maybe_restore_outer_state(trainer, snapshot_path: str) -> None:
         with np.load(path) as d:
             buf_a, buf_m = d["anchor"], d["m"]
     except (OSError, ValueError, KeyError) as e:
-        log.warning("outer-state restore failed (re-seeding): %s", e)
+        log.warning("outer-state restore failed (re-seeding): %s", errstr(e))
         return
     if buf_a.size != expect or buf_m.size != expect:
         log.warning(
@@ -199,7 +199,7 @@ def save_async(trainer, ckpt_dir: str) -> bool:
             with ocp.PyTreeCheckpointer() as ckptr:
                 ckptr.save(path, host_tree, force=True)
         except Exception as e:  # noqa: BLE001 — a failed periodic save must not kill training
-            log.warning("async checkpoint save failed: %s", e)
+            log.warning("async checkpoint save failed: %s", errstr(e))
             return
         # Sidecar failure must not mislabel the landed snapshot as failed,
         # and must never skip GC (that's how a disk fills).
@@ -207,7 +207,7 @@ def save_async(trainer, ckpt_dir: str) -> bool:
             try:
                 np.savez(_outer_state_path(path), anchor=outer_bufs[0], m=outer_bufs[1])
             except OSError as e:
-                log.warning("outer-state save failed (snapshot is intact): %s", e)
+                log.warning("outer-state save failed (snapshot is intact): %s", errstr(e))
         log.info("checkpoint saved (async): %s", path)
         _gc(ckpt_dir, just_saved=step)
 

@@ -39,7 +39,7 @@ from distributedvolunteercomputing_tpu.training.steps import (
     make_grad_step,
     make_train_step,
 )
-from distributedvolunteercomputing_tpu.utils.logging import get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
 
@@ -118,6 +118,12 @@ class Trainer:
             raise ValueError(
                 f"accum_steps={accum_steps} must be >=1 and divide batch_size={batch_size}"
             )
+        # Persistent XLA compilation cache: volunteers churn (rejoin =
+        # re-trace + re-compile, 20-40s on the chip); the cache turns every
+        # rejoin after the first into a disk hit. DVC_COMPILE_CACHE= opts out.
+        from distributedvolunteercomputing_tpu.utils.jaxenv import enable_compile_cache
+
+        enable_compile_cache()
         self.bundle = bundle
         self.batch_size = batch_size
         self.accum_steps = accum_steps
@@ -449,7 +455,7 @@ class Trainer:
             # margin here only guards against a wedged callback at exit.
             averaged, avg_s = fut.result(timeout=600.0 if wait else 0.0)
         except Exception as e:  # noqa: BLE001 — a failed round never kills training
-            log.warning("overlapped averaging launched at step %d failed: %s", launch_step, e)
+            log.warning("overlapped averaging launched at step %d failed: %s", launch_step, errstr(e))
             self.metrics.record_event(
                 step_no, "avg_round", {"ok": False, "what": "params", "overlap": True}
             )

@@ -54,6 +54,43 @@ def pin_platform(
     return want or None
 
 
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at a durable directory.
+
+    Volunteer churn is the framework's normal operating mode (SURVEY.md §1
+    L3): every rejoin re-traces and re-compiles the train step, a 20-40s
+    stall on the TPU chip before the volunteer contributes again. The
+    persistent cache turns every rejoin after the first into a disk hit.
+    Resolution order: explicit arg > ``DVC_COMPILE_CACHE`` env (empty string
+    disables) > ``~/.cache/dvc_jax_cache``. Safe to call repeatedly; returns
+    the directory enabled, or None when disabled/unavailable.
+
+    TPU-only: XLA:CPU persists AOT results whose machine-feature stamp can
+    fail at load (observed in-repo: `cpu_aot_loader` feature-mismatch spam +
+    SIGILL warnings that broke a swarm e2e when the cache was enabled
+    unconditionally), and CPU compiles are fast enough not to need a cache.
+    The 20-40s compiles this exists for are the TPU ones."""
+    if path is None:
+        path = os.environ.get("DVC_COMPILE_CACHE")
+        if path == "":
+            return None
+        if path is None:
+            path = os.path.expanduser("~/.cache/dvc_jax_cache")
+    try:
+        if not tpu_backend():
+            return None
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache every program: the default 1s floor would skip the small
+        # steps proxies/tests compile most often, and disk here is cheap.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return path
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        return None
+
+
 def tpu_backend() -> bool:
     """True when the default backend is TPU silicon — including the sandbox's
     "axon" PJRT plugin (a real TPU chip behind a tunnel, platform-named axon).

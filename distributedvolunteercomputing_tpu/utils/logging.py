@@ -14,6 +14,19 @@ import sys
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
 
 
+def errstr(e: BaseException) -> str:
+    """``TypeName: message`` for log lines.
+
+    Logging the bare exception renders common failures invisibly:
+    ``str(asyncio.TimeoutError())`` and ``str(CancelledError())`` are "",
+    which produced real ``averaging at step 90 failed: `` lines during the
+    round-4 hardware overlap run — the one context (a wedged chip, a timed-
+    out round) where the TYPE is the whole diagnosis."""
+    msg = str(e)
+    name = type(e).__name__
+    return f"{name}: {msg}" if msg else name
+
+
 def get_logger(name: str) -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers and not logging.getLogger().handlers:

@@ -1,0 +1,105 @@
+"""Is the flagship step time dispatch-bound or compute-bound?
+
+bench.py's measure loop issues one jitted step per Python call; on the
+tunneled axon runtime each call is an HTTP dispatch. The 20 calls chain
+through the donated TrainState, so IF the runtime pipelines async dispatches
+the tunnel latency hides and the measured 135ms/step is real compute. This
+probe settles it: run the same train step (a) as bench does, one dispatch
+per step, and (b) as a lax.scan of N steps inside ONE compiled call — no
+per-step dispatch at all. If (b) is meaningfully faster per step, bench
+under-reports the chip and a multi-step mode is worth shipping; if equal,
+the step is compute-bound and the MFU work moves to the step itself.
+
+Writes experiments/results/step_scan_probe.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from distributedvolunteercomputing_tpu.models import get_model
+from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+
+BS = int(os.environ.get("DVC_PROBE_BATCH", "8"))
+ITERS = 20
+SCAN_N = 10
+
+
+def main():
+    bundle = get_model("gpt2_small", remat=False)
+    tx = make_optimizer("adamw", lr=1e-4)
+    params = bundle.init(jax.random.PRNGKey(1))
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+    del params
+    step = make_train_step(bundle.loss_fn, tx)
+    batch = bundle.make_batch(jax.random.PRNGKey(0), BS)
+    print(f"built {n_params/1e6:.1f}M params", flush=True)
+
+    # (a) bench-style: one dispatch per step, sync once at the end.
+    for _ in range(3):
+        state, m = step(state, batch)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, m = step(state, batch)
+    loss_a = float(m["loss"])
+    per_step_a = (time.perf_counter() - t0) / ITERS
+    print(f"(a) per-dispatch: {per_step_a*1e3:.1f} ms/step loss={loss_a:.3f}", flush=True)
+
+    # (b) scan-over-steps: SCAN_N steps in one compiled call, built on the
+    # same traced body the jitted step uses (training/steps.py
+    # train_step_body), so (a) and (b) run identical math.
+    from distributedvolunteercomputing_tpu.training.steps import train_step_body
+
+    def multi(state):
+        def body(s, _):
+            s2, mm = train_step_body(bundle.loss_fn, tx, s, batch)
+            return s2, mm["loss"]
+
+        return jax.lax.scan(body, state, None, length=SCAN_N)
+
+    multi_j = jax.jit(multi, donate_argnums=(0,))
+    t0 = time.monotonic()
+    state, losses = multi_j(state)
+    float(losses[-1])
+    compile_s = time.monotonic() - t0
+    t0 = time.perf_counter()
+    state, losses = multi_j(state)
+    loss_b = float(losses[-1])
+    per_step_b = (time.perf_counter() - t0) / SCAN_N
+    print(
+        f"(b) scanned: {per_step_b*1e3:.1f} ms/step (compile+first {compile_s:.1f}s) "
+        f"loss={loss_b:.3f}",
+        flush=True,
+    )
+
+    out = {
+        "device_kind": jax.devices()[0].device_kind,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "batch_size": BS,
+        "per_dispatch_ms": round(per_step_a * 1e3, 2),
+        "scanned_ms": round(per_step_b * 1e3, 2),
+        "dispatch_overhead_ms": round((per_step_a - per_step_b) * 1e3, 2),
+        "samples_per_sec_dispatch": round(BS / per_step_a, 2),
+        "samples_per_sec_scanned": round(BS / per_step_b, 2),
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results", "step_scan_probe.json"
+    )
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,12 +51,16 @@ def main() -> None:
     ap.add_argument("--average-every", type=int, default=10)
     ap.add_argument("--average-what", default="params", choices=("params", "grads"),
                     help="params = local-SGD periodic averaging; grads = GradientAverager")
-    ap.add_argument("--wire", default="f32", choices=("f32", "bf16", "q8", "topk"),
+    ap.add_argument("--wire", default="f32",
+                    choices=("f32", "bf16", "q8", "topk", "powersgd"),
                     help="WAN payload codec; bf16 halves DCN traffic, q8 "
                          "quarters it (chunked int8, <=0.4%% element error), "
                          "topk ships only the largest-magnitude gradient "
                          "entries with error feedback (grads mode, "
-                         "sync/byzantine; ~50x fewer bytes at default frac)")
+                         "sync/byzantine; ~50x fewer bytes at default frac), "
+                         "powersgd ships rank-r factor pairs per tensor "
+                         "(grads mode, sync/byzantine; composes with robust "
+                         "methods, unlike topk)")
     ap.add_argument("--topk-frac", type=float, default=0.01,
                     help="fraction of gradient entries kept per round by "
                          "--wire topk")
@@ -64,6 +68,10 @@ def main() -> None:
                     help="ramp the topk kept fraction from dense to "
                          "--topk-frac over the first N successful rounds "
                          "(DGC-style sparsity warmup; 0 = off)")
+    ap.add_argument("--psgd-rank", type=int, default=4,
+                    help="target rank for --wire powersgd (per->=2D-tensor "
+                         "low-rank factor pairs; higher = more bytes, less "
+                         "truncation)")
     ap.add_argument("--allow-unrobust-topk", action="store_true",
                     help="permit --averaging byzantine with --wire topk, "
                          "which runs a plain weighted mean (no Byzantine "
@@ -162,6 +170,7 @@ def main() -> None:
         wire=args.wire,
         topk_frac=args.topk_frac,
         topk_warmup_rounds=args.topk_warmup_rounds,
+        powersgd_rank=args.psgd_rank,
         allow_unrobust_topk=args.allow_unrobust_topk,
         overlap=args.overlap,
         max_staleness=args.max_staleness,

@@ -154,6 +154,29 @@ class TestSwarmE2E:
         finally:
             coord.kill()
 
+    def test_two_volunteers_grad_averaging_powersgd_wire(self):
+        """Rank-4 PowerSGD wire end-to-end through the real entrypoints:
+        grads averaged every step as (P, Q) factor pairs with error
+        feedback; both volunteers converge in lockstep (the mnist proxy's
+        gradients are heavily low-rank, so rank 4 tracks the dense run)."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                "--averaging", "sync", "--average-what", "grads",
+                "--wire", "powersgd", "--psgd-rank", "4",
+                "--steps", "8",
+                "--join-timeout", "25", "--gather-timeout", "25",
+            ]
+            v0 = start_volunteer(addr, "pvol0", common + ["--seed", "0"])
+            v1 = start_volunteer(addr, "pvol1", common + ["--seed", "1"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            assert s0["rounds_ok"] >= 2, out0
+            assert s1["rounds_ok"] >= 2, out1
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5, (out0, out1)
+        finally:
+            coord.kill()
+
     def test_two_volunteers_sync_outer_optimizer(self):
         """DiLoCo-style outer Nesterov over sync params rounds, end to end
         through the real entrypoints: rounds complete and losses stay sane

@@ -1,0 +1,298 @@
+"""PowerSGD wire codec: container format, power-iteration math, error
+feedback through the averagers, and robust-method composition.
+
+The reference's GradientAverager compresses WAN gradients (SURVEY.md §2);
+PowerSGD is the low-rank member of this framework's codec family
+(swarm/powersgd.py) — unlike topk it must compose with the byzantine
+estimators, which is asserted here with an actual attacker in the mesh.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm import powersgd
+from distributedvolunteercomputing_tpu.swarm.averager import (
+    ByzantineAverager,
+    GossipAverager,
+    SyncAverager,
+)
+from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer
+
+from tests.test_averaging import run, spawn_volunteers, teardown
+
+
+def specs_of(tree):
+    _, specs, _ = flatten_to_buffer(tree)
+    return specs
+
+
+def psgd_tree(w_value=None, rng=None, n=32, m=16):
+    """A tree with one compressible matrix and one dense vector."""
+    if rng is not None:
+        w = rng.standard_normal((n, m)).astype(np.float32)
+        b = rng.standard_normal((5,)).astype(np.float32)
+    else:
+        w = np.full((n, m), w_value, np.float32)
+        b = np.full((5,), w_value * 2, np.float32)
+    return {"w": w, "b": b}
+
+
+class TestCodec:
+    def test_dense_leaves_exact_lowrank_bounded(self):
+        rng = np.random.default_rng(0)
+        tree = psgd_tree(rng=rng)
+        buf, specs, _ = flatten_to_buffer(tree)
+        codec = powersgd.PowerSGDCodec(specs, rank=4)
+        wire = codec.encode(buf)
+        out = powersgd.decode(wire)
+        assert out.shape == buf.shape
+        # The 1D leaf ships dense: exact. (Dict leaves flatten in key order,
+        # so "b" is the FIRST 5 floats.)
+        np.testing.assert_array_equal(out[:5], buf[:5])
+        # The matrix is a rank-4 projection: bounded error, not exact.
+        w, w_hat = buf[5:].reshape(32, 16), out[5:].reshape(32, 16)
+        rel = np.linalg.norm(w - w_hat) / np.linalg.norm(w)
+        assert 0.0 < rel < 1.0
+
+    def test_exact_for_low_rank_matrices(self):
+        rng = np.random.default_rng(1)
+        # Build an exactly rank-2 matrix; rank-4 compression recovers it.
+        a = rng.standard_normal((32, 2)).astype(np.float32)
+        b = rng.standard_normal((2, 16)).astype(np.float32)
+        tree = {"w": a @ b, "b": np.zeros((5,), np.float32)}
+        buf, specs, _ = flatten_to_buffer(tree)
+        codec = powersgd.PowerSGDCodec(specs, rank=4)
+        out = powersgd.decode(codec.encode(buf))
+        np.testing.assert_allclose(out, buf, rtol=1e-4, atol=1e-5)
+
+    def test_encode_dense_roundtrip_exact(self):
+        rng = np.random.default_rng(2)
+        buf, specs, _ = flatten_to_buffer(psgd_tree(rng=rng))
+        codec = powersgd.PowerSGDCodec(specs, rank=4)
+        out = powersgd.decode(codec.encode_dense(buf))
+        np.testing.assert_array_equal(out, buf)
+
+    def test_wire_smaller_than_dense(self):
+        rng = np.random.default_rng(3)
+        tree = {"w": rng.standard_normal((256, 128)).astype(np.float32)}
+        buf, specs, _ = flatten_to_buffer(tree)
+        codec = powersgd.PowerSGDCodec(specs, rank=4)
+        wire = codec.encode(buf)
+        # (256+128)*4 floats vs 256*128: >20x smaller (+ tiny header).
+        assert len(wire) < buf.nbytes / 20
+
+    def test_small_matrices_ship_dense(self):
+        # (n+m)*r >= n*m for a 4x4 at rank 4 -> dense plan, exact roundtrip.
+        rng = np.random.default_rng(4)
+        tree = {"w": rng.standard_normal((4, 4)).astype(np.float32)}
+        buf, specs, _ = flatten_to_buffer(tree)
+        codec = powersgd.PowerSGDCodec(specs, rank=4)
+        assert codec.plan[0][2] is None
+        np.testing.assert_array_equal(powersgd.decode(codec.encode(buf)), buf)
+
+    def test_warm_start_converges_on_fixed_matrix(self):
+        rng = np.random.default_rng(5)
+        buf, specs, _ = flatten_to_buffer(
+            {"w": rng.standard_normal((64, 32)).astype(np.float32)}
+        )
+        codec = powersgd.PowerSGDCodec(specs, rank=4)
+        errs = []
+        for _ in range(6):
+            out = powersgd.decode(codec.encode(buf))
+            errs.append(float(np.linalg.norm(out - buf)))
+        # Warm-started power iteration converges to the best rank-4
+        # approximation of a FIXED matrix: later rounds beat the first.
+        assert errs[-1] <= errs[0]
+        assert errs[-1] < errs[0] * 0.999  # strictly better, not a no-op
+
+    def test_decode_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            powersgd.decode(b"nope" + b"\x00" * 16)
+        buf, specs, _ = flatten_to_buffer({"w": np.ones((8, 8), np.float32)})
+        codec = powersgd.PowerSGDCodec(specs, rank=2)
+        wire = codec.encode(buf)
+        with pytest.raises(ValueError):
+            powersgd.decode(wire + b"\x00")  # trailing bytes
+
+    def test_truncated_payload_raises_valueerror_not_struct_error(self):
+        # The averagers' round containment catches ValueError; a truncated
+        # container (count says 2, body holds 1) must not escape as
+        # struct.error past that net.
+        rng = np.random.default_rng(6)
+        buf, specs, _ = flatten_to_buffer(psgd_tree(rng=rng))
+        wire = powersgd.PowerSGDCodec(specs, rank=2).encode(buf)
+        for cut in (9, len(wire) // 2, len(wire) - 3):
+            with pytest.raises(ValueError):
+                powersgd.decode(wire[:cut])
+
+    def test_rank_validation(self):
+        with pytest.raises(ValueError):
+            powersgd.PowerSGDCodec([], rank=0)
+
+
+class TestMerge:
+    def test_factored_mean_exact(self):
+        rng = np.random.default_rng(11)
+        buf, specs, _ = flatten_to_buffer(psgd_tree(rng=rng, n=64, m=32))
+        buf2, _, _ = flatten_to_buffer(psgd_tree(rng=rng, n=64, m=32))
+        c1 = powersgd.PowerSGDCodec(specs, rank=3)
+        c2 = powersgd.PowerSGDCodec(specs, rank=3)
+        w1, w2 = c1.encode(buf), c2.encode(buf2)
+        merged = powersgd.merge([(1.0, w1), (3.0, w2)])
+        want = 0.25 * powersgd.decode(w1) + 0.75 * powersgd.decode(w2)
+        np.testing.assert_allclose(powersgd.decode(merged), want, rtol=1e-5, atol=1e-6)
+        # The factored result is smaller than the dense container.
+        assert len(merged) < buf.nbytes
+
+    def test_oversized_concat_goes_dense_but_stays_exact(self):
+        # 8 peers x rank 4 = rank 32 on a 16-col matrix: concat would not
+        # save bytes, so the merge densifies that entry — value unchanged.
+        rng = np.random.default_rng(12)
+        specs = specs_of(psgd_tree(rng=rng))
+        payloads = []
+        for i in range(8):
+            buf, _, _ = flatten_to_buffer(psgd_tree(rng=np.random.default_rng(100 + i)))
+            payloads.append((1.0, powersgd.PowerSGDCodec(specs, rank=4).encode(buf)))
+        merged = powersgd.merge(payloads)
+        want = sum(powersgd.decode(p) for _, p in payloads) / 8.0
+        np.testing.assert_allclose(powersgd.decode(merged), want, rtol=1e-4, atol=1e-5)
+
+    def test_merge_rejects_mismatched_entry_counts(self):
+        rng = np.random.default_rng(13)
+        buf, specs, _ = flatten_to_buffer(psgd_tree(rng=rng))
+        wire = powersgd.PowerSGDCodec(specs, rank=2).encode(buf)
+        dense_single = powersgd.PowerSGDCodec(specs, rank=2).encode_dense(buf)
+        with pytest.raises(ValueError):
+            powersgd.merge([(1.0, wire), (1.0, dense_single)])
+
+
+class TestSyncPowerSGD:
+    def test_mean_of_rank1_trees_near_exact(self):
+        # Constant matrices are rank 1, so rank-4 shipping is ~lossless and
+        # the sync round's weighted mean must match the dense-wire answer.
+        async def main():
+            vols = await spawn_volunteers(
+                3, SyncAverager, min_group=3, wire="powersgd", powersgd_rank=4
+            )
+            try:
+                return await asyncio.gather(
+                    *(
+                        avg.average(psgd_tree(w_value=float(i)), 1)
+                        for i, (_, _, _, avg) in enumerate(vols)
+                    )
+                )
+            finally:
+                await teardown(vols)
+
+        results = run(main())
+        for r in results:
+            assert r is not None
+            np.testing.assert_allclose(r["w"], 1.0, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(r["b"], 2.0, rtol=1e-4, atol=1e-5)
+
+    def test_error_feedback_banks_truncation(self):
+        # A full-rank contribution is truncated; the dropped part must be
+        # staged and committed into the residual after a successful round.
+        async def main():
+            rng = np.random.default_rng(7)
+            vols = await spawn_volunteers(
+                2, SyncAverager, min_group=2, wire="powersgd", powersgd_rank=2
+            )
+            try:
+                trees = [psgd_tree(rng=rng), psgd_tree(rng=rng)]
+                res = await asyncio.gather(
+                    *(avg.average(trees[i], 1) for i, (_, _, _, avg) in enumerate(vols))
+                )
+                residuals = [avg._ef_residual for _, _, _, avg in vols]
+                return res, residuals
+            finally:
+                await teardown(vols)
+
+        res, residuals = run(main())
+        assert all(r is not None for r in res)
+        for resid in residuals:
+            assert resid is not None
+            assert float(np.abs(resid).max()) > 0.0  # truncation was banked
+
+    def test_pairwise_modes_reject_powersgd(self):
+        async def main():
+            t = None
+            from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+            from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+            from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=None)
+            mem = SwarmMembership(dht, "v0", ttl=10.0)
+            with pytest.raises(ValueError, match="powersgd"):
+                GossipAverager(t, dht, mem, wire="powersgd")
+            await t.close()
+
+        run(main())
+
+
+class TestByzantinePowerSGD:
+    def test_robust_method_bounds_attacker_over_powersgd(self):
+        # The headline property topk cannot offer: trimmed-mean byzantine
+        # aggregation OVER the compressed wire still bounds an attacker
+        # (reconstructions are dense vectors, so the estimator sees normal
+        # rows). Honest peers send rank-1 trees (values 0,1,2); the attacker
+        # ships 1e9 everywhere. Trim=1 per side -> mean of middle two.
+        async def main():
+            vols = await spawn_volunteers(
+                4,
+                ByzantineAverager,
+                min_group=4,
+                wire="powersgd",
+                powersgd_rank=4,
+                method="trimmed_mean",
+            )
+            try:
+                return await asyncio.gather(
+                    vols[0][3].average(psgd_tree(w_value=0.0), 1),
+                    vols[1][3].average(psgd_tree(w_value=1.0), 1),
+                    vols[2][3].average(psgd_tree(w_value=2.0), 1),
+                    vols[3][3].average(psgd_tree(w_value=1e9), 1),  # attacker
+                )
+            finally:
+                await teardown(vols)
+
+        results = run(main())
+        for r in results[:3]:
+            assert r is not None
+            # Middle two of [0, 1, 2, 1e9] are 1 and 2 -> 1.5; the attacker
+            # row's 1e9 must NOT leak into the aggregate.
+            np.testing.assert_allclose(r["w"], 1.5, rtol=1e-4)
+            assert float(np.abs(r["w"]).max()) < 10.0
+
+
+class TestConfigValidation:
+    def test_volunteer_config_rejects_powersgd_params_mode(self):
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        with pytest.raises(ValueError, match="powersgd"):
+            VolunteerConfig(
+                coordinator="127.0.0.1:1", wire="powersgd", averaging="sync",
+                average_what="params",
+            )
+
+    def test_volunteer_config_rejects_powersgd_gossip(self):
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        with pytest.raises(ValueError, match="powersgd"):
+            VolunteerConfig(
+                coordinator="127.0.0.1:1", wire="powersgd", averaging="gossip",
+                average_what="grads",
+            )
+
+    def test_volunteer_config_accepts_powersgd_byzantine(self):
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        cfg = VolunteerConfig(
+            coordinator="127.0.0.1:1", wire="powersgd", averaging="byzantine",
+            average_what="grads",
+        )
+        assert cfg.powersgd_rank == 4
