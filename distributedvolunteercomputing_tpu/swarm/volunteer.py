@@ -50,6 +50,11 @@ class VolunteerConfig:
     peer_id: str = ""
     averaging: str = "none"  # none|sync|gossip|butterfly|byzantine
     average_every: int = 10
+    # Wall-clock averaging cadence (params mode; 0 = step cadence above).
+    # Rounds fire when wall time crosses a multiple of the interval, so
+    # NTP-synced heterogeneous volunteers rendezvous within ms regardless
+    # of step speed; contributions weigh by actual window progress.
+    average_interval_s: float = 0.0
     average_what: str = "params"  # params (local-SGD) | grads (GradientAverager)
     # Overlap WAN rounds with local compute (params mode; see Trainer). On by
     # default: blocking the device for a whole WAN round is what sinks
@@ -127,6 +132,18 @@ class VolunteerConfig:
     def __post_init__(self):
         if not self.peer_id:
             self.peer_id = f"vol-{uuid.uuid4().hex[:8]}"
+        if self.average_interval_s < 0:
+            raise ValueError(
+                f"average_interval_s must be >= 0, got {self.average_interval_s}"
+            )
+        if self.average_interval_s > 0:
+            if self.average_what != "params":
+                raise ValueError(
+                    "--average-interval-s requires --average-what params "
+                    "(gradient rounds are per-step by definition)"
+                )
+            if self.averaging == "none":
+                raise ValueError("--average-interval-s requires an averaging mode")
         if self.outer_optimizer != "none":
             if self.average_what != "params":
                 raise ValueError("--outer-optimizer requires --average-what params")
@@ -244,8 +261,18 @@ class Volunteer:
                 lambda x: np.asarray(x, np.float32) * chaos_scale, params
             )
         # Weight = samples behind this contribution: one batch for a
-        # gradient round, average_every batches for a parameter round.
-        per_round = 1 if self.cfg.average_what == "grads" else self.cfg.average_every
+        # gradient round; for a parameter round, the trainer's actual
+        # steps-since-last-merge (== average_every on the happy step-cadence
+        # path, more after failed rounds, and the per-volunteer window
+        # progress under --average-interval-s — heterogeneous peers weigh
+        # by what they really computed).
+        if self.cfg.average_what == "grads":
+            per_round = 1
+        else:
+            per_round = max(
+                1,
+                getattr(self.trainer, "steps_since_merge", self.cfg.average_every),
+            )
         samples_since = self.cfg.batch_size * per_round
         fut = asyncio.run_coroutine_threadsafe(
             self.averager.average(params, round_no=step, weight=float(samples_since)),
@@ -365,6 +392,7 @@ class Volunteer:
             init_seed=self.cfg.init_seed,
             accum_steps=self.cfg.accum_steps,
             average_every=self.cfg.average_every,
+            average_interval_s=self.cfg.average_interval_s,
             averager=self._averager_callback if self.averager else None,
             average_what=self.cfg.average_what,
             overlap=self.cfg.overlap,

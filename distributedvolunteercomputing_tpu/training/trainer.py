@@ -62,6 +62,16 @@ class Trainer:
         # one big batch — only peak activation memory changes.
         accum_steps: int = 1,
         average_every: int = 10,
+        # Wall-clock averaging cadence for HETEROGENEOUS swarms (params mode
+        # only; 0 = off, use the step cadence above). Rounds trigger when
+        # wall time crosses a multiple of the interval — every volunteer
+        # with an NTP-ish clock crosses the same boundary within ms, so a
+        # v4-8 doing 40 steps per window rendezvouses cleanly with a v5e-4
+        # doing 15, where a step-count cadence would leave the fast peer
+        # parked in matchmaking every round (or never aligned at all).
+        # Contribution weights carry samples-since-last-merge, so unequal
+        # local progress is weighted correctly by construction.
+        average_interval_s: float = 0.0,
         averager: Optional[AveragerFn] = None,
         # params: local-SGD, averaged every `average_every` steps.
         # grads: GradientAverager semantics, averaged EVERY step
@@ -114,6 +124,15 @@ class Trainer:
             raise ValueError(f"eval_batches must be >= 1, got {eval_batches}")
         if average_what not in ("params", "grads"):
             raise ValueError(f"unknown average_what {average_what!r}")
+        if average_interval_s < 0:
+            raise ValueError(
+                f"average_interval_s must be >= 0, got {average_interval_s}"
+            )
+        if average_interval_s > 0 and average_what == "grads":
+            # GradientAverager semantics are per-step by definition — a
+            # wall-clock cadence would let optimizer steps run on unmerged
+            # gradients, which is params mode's job.
+            raise ValueError("average_interval_s requires average_what='params'")
         if accum_steps < 1 or batch_size % accum_steps != 0:
             raise ValueError(
                 f"accum_steps={accum_steps} must be >=1 and divide batch_size={batch_size}"
@@ -128,6 +147,18 @@ class Trainer:
         self.batch_size = batch_size
         self.accum_steps = accum_steps
         self.average_every = average_every
+        self.average_interval_s = float(average_interval_s)
+        # Next wall-clock boundary (multiple of the interval) a round is due
+        # at; None until run() arms it.
+        self._next_avg_t: Optional[float] = None
+        # Steps of local progress behind the NEXT params-mode contribution —
+        # read by the volunteer's averager callback to weight it in samples.
+        # Under the step cadence this is average_every except after failed
+        # rounds (progress accumulates); under the interval cadence it is
+        # whatever this volunteer managed in the window, which is exactly
+        # what makes heterogeneous contributions weigh correctly.
+        self.steps_since_merge: int = average_every
+        self._last_merge_step: Optional[int] = None
         self.averager = averager
         self.average_what = average_what
         # ``seed`` is PER-VOLUNTEER: it drives the data order and the step
@@ -401,6 +432,43 @@ class Trainer:
         )
         return self._outer_anchor
 
+    def _avg_due(self, step_no: int) -> bool:
+        """Is a params-mode averaging round due at this step?
+
+        Step cadence (the default): every ``average_every`` steps. Wall-clock
+        cadence (``average_interval_s > 0``): when wall time crosses a
+        multiple of the interval — boundaries are ABSOLUTE (``n * T``), so
+        every volunteer with an NTP-synced clock fires within ms of its
+        peers regardless of join time or step speed, which is what makes
+        heterogeneous swarms rendezvous without parking the fast peer.
+        Advances the armed boundary exactly once per crossing (a slow step
+        that skips past several boundaries still yields one round)."""
+        if self.average_interval_s > 0:
+            now = time.time()
+            if self._next_avg_t is None:
+                # First call arms the NEXT boundary: a joining volunteer's
+                # first round aligns with the swarm's next window instead of
+                # firing solo mid-window.
+                self._arm_next_boundary(now)
+                return False
+            if now >= self._next_avg_t:
+                self._arm_next_boundary(now)
+                return True
+            return False
+        return step_no % self.average_every == 0
+
+    def _arm_next_boundary(self, now: float) -> None:
+        self._next_avg_t = (
+            int(now // self.average_interval_s) + 1
+        ) * self.average_interval_s
+
+    def _note_window_progress(self, step_no: int) -> None:
+        """Record the local steps behind the contribution about to launch —
+        the single source the volunteer's weight callback reads, shared by
+        the blocking and overlap paths so they can't diverge."""
+        if self._last_merge_step is not None:
+            self.steps_since_merge = max(1, step_no - self._last_merge_step)
+
     def _run_average_round(self, tree: Any, step_no: int, what: str) -> Optional[Any]:
         """One WAN round: select payload -> averager -> record -> merge.
         Returns the merged tree, or None when no group formed / round failed.
@@ -409,6 +477,8 @@ class Trainer:
         numpy (the overlap path already guarantees it; for a mesh-sharded
         state this is also the gather from the slice's shards)."""
         payload = jax.tree_util.tree_map(np.asarray, self.bundle.avg_select(tree))
+        if what == "params":
+            self._note_window_progress(step_no)
         t_avg = time.monotonic()
         averaged = self.averager(payload, step_no)
         self.metrics.record_event(
@@ -432,6 +502,7 @@ class Trainer:
         payload0 = jax.tree_util.tree_map(
             np.asarray, self.bundle.avg_select(self.state.params)
         )
+        self._note_window_progress(step_no)
         t0 = time.monotonic()
         fut = self._avg_pool.submit(
             lambda: (self.averager(payload0, step_no), time.monotonic() - t0)
@@ -486,6 +557,9 @@ class Trainer:
             averaged, current, payload0,
         )
         self._swap_params(self.bundle.avg_merge(self.state.params, merged_payload), step_no)
+        # Progress up to the LAUNCH step entered the average (the delta term
+        # above preserved the rest locally).
+        self._last_merge_step = launch_step
 
     def run(
         self,
@@ -526,6 +600,8 @@ class Trainer:
         m = None
         last_loss = float("nan")
         start_step = int(self.state.step)
+        if self._last_merge_step is None:
+            self._last_merge_step = start_step
         t_start = time.monotonic()
         ran_steps = 0
         target_crossed: Optional[Tuple[int, float]] = None  # (step, wall_s)
@@ -580,7 +656,7 @@ class Trainer:
                     # then (at the cadence, with no round in flight) launch
                     # the next one — the device keeps stepping either way.
                     self._finish_overlap_round(step_no)
-                    if step_no % self.average_every == 0:
+                    if self._avg_due(step_no):
                         if self._inflight is None:
                             self._launch_overlap_round(step_no)
                         # Refresh the cross-thread snapshot at the cadence
@@ -589,14 +665,22 @@ class Trainer:
                         # last merge — a rejoiner pulling a stale snapshot
                         # would bootstrap thousands of steps behind.
                         self._take_snapshot(step_no)
-                elif step_no % self.average_every == 0:
+                elif self._avg_due(step_no):
                     merged = self._run_average_round(self.state.params, step_no, "params")
                     if merged is not None:
                         self._swap_params(merged, step_no)
+                        self._last_merge_step = step_no
                     else:
                         # Snapshot at the cadence regardless of round outcome
                         # (see overlap branch).
                         self._take_snapshot(step_no)
+                if self.average_interval_s > 0 and step_no % self.average_every == 0:
+                    # Under the wall-clock cadence, rounds can be a full
+                    # interval apart — far longer than average_every steps.
+                    # Keep the state-sync snapshot fresh on the STEP cadence
+                    # regardless, or a rejoiner pulls a window-old state
+                    # (the hazard the comment above describes).
+                    self._take_snapshot(step_no)
 
             if profiling and i + 1 >= profile_start + profile_steps:
                 jax.block_until_ready(m["loss"])

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Convergence-per-WAN-byte: dense vs top-k vs PowerSGD at transformer scale.
+
+The mnist wire-bytes table (BASELINE.md) measures BYTES well but its loss
+column saturates too fast to rank codecs on convergence. This experiment
+reuses the topk_warmup harness shape — 2-volunteer grads-mode sync swarms on
+the gpt2 proxy, 30 rounds per volunteer — and adds the PowerSGD arms:
+
+  dense   --wire f32
+  topk    --wire topk --topk-frac 0.01
+  psgd4   --wire powersgd --psgd-rank 4
+  psgd8   --wire powersgd --psgd-rank 8
+
+Records final loss AND total WAN bytes per arm. The claim under test
+(BASELINE.md codec table, measured on mnist): PowerSGD sits between q8 and
+topk on bytes while tracking dense convergence far closer than topk.
+
+Run: python experiments/psgd_compare.py
+Results: experiments/results/psgd_compare.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from run_matrix import RESULTS, record, run_swarm  # noqa: E402
+
+MODEL = ["--model", "gpt2_small",
+         "--model-override", "vocab=256", "--model-override", "max_len=32",
+         "--model-override", "d_model=64", "--model-override", "n_heads=2",
+         "--model-override", "n_layers=2", "--model-override", "d_ff=128"]
+STEPS = 30  # grads mode: one round per step
+
+
+def arm(tag: str, extra: list) -> dict:
+    common = MODEL + [
+        "--averaging", "sync", "--average-what", "grads",
+        "--steps", str(STEPS), "--batch-size", "16", "--lr", "0.003",
+        "--join-timeout", "20", "--gather-timeout", "20", *extra,
+    ]
+    rows = run_swarm(
+        f"psgd_compare/{tag}",
+        [(f"{tag}-a", common + ["--seed", "0"]),
+         (f"{tag}-b", common + ["--seed", "1"])],
+        timeout=420,
+    )
+    summaries = [s for _, s, _ in rows if s]
+    agg = record(f"psgd_compare_{tag}", rows)
+    agg["wan_bytes_total"] = sum(s["wan_bytes_sent"] for s in summaries)
+    return agg
+
+
+def main() -> None:
+    results = {
+        "dense": arm("dense", ["--wire", "f32"]),
+        "topk": arm("topk", ["--wire", "topk", "--topk-frac", "0.01"]),
+        "psgd4": arm("psgd4", ["--wire", "powersgd", "--psgd-rank", "4"]),
+        "psgd8": arm("psgd8", ["--wire", "powersgd", "--psgd-rank", "8"]),
+    }
+    out = os.path.join(RESULTS, "psgd_compare.jsonl")
+    with open(out, "w") as fh:
+        for tag, agg in results.items():
+            fh.write(json.dumps({"arm": tag, **agg}) + "\n")
+    for tag, agg in results.items():
+        print(f"psgd_compare: {tag:6s} loss {agg['final_loss_mean']:.4f} "
+              f"bytes {agg['wan_bytes_total'] / 1e6:.2f}MB "
+              f"rounds {agg['rounds_ok_total']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,14 @@ def main() -> None:
     ap.add_argument("--averaging", default="none",
                     choices=["none", "sync", "gossip", "butterfly", "byzantine"])
     ap.add_argument("--average-every", type=int, default=10)
+    ap.add_argument("--average-interval-s", type=float, default=0.0,
+                    help="wall-clock averaging cadence in seconds (params "
+                         "mode; 0 = every --average-every steps). Rounds "
+                         "fire at absolute multiples of the interval, so "
+                         "NTP-synced heterogeneous volunteers rendezvous "
+                         "within ms regardless of per-volunteer step speed; "
+                         "contributions are weighted by actual window "
+                         "progress")
     ap.add_argument("--average-what", default="params", choices=("params", "grads"),
                     help="params = local-SGD periodic averaging; grads = GradientAverager")
     ap.add_argument("--wire", default="f32",
@@ -166,6 +174,7 @@ def main() -> None:
         peer_id=args.peer_id,
         averaging=args.averaging,
         average_every=args.average_every,
+        average_interval_s=args.average_interval_s,
         average_what=args.average_what,
         wire=args.wire,
         topk_frac=args.topk_frac,

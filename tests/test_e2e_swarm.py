@@ -154,6 +154,32 @@ class TestSwarmE2E:
         finally:
             coord.kill()
 
+    def test_heterogeneous_volunteers_interval_cadence(self):
+        """Wall-clock averaging cadence end to end: volunteers with 8x
+        different batch sizes (heterogeneous speed, the config-4 shape)
+        rendezvous on absolute 0.5s boundaries instead of step counts. Both
+        must complete rounds — under a step cadence with these speeds the
+        fast peer would sit parked at every rendezvous."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                # A short interval so even an unloaded machine (tiny-MLP CPU
+                # steps can run in ~1-2ms) crosses several boundaries within
+                # 500 steps; the first boundary only ARMS post-compile.
+                "--averaging", "sync", "--average-interval-s", "0.5",
+                "--steps", "500",
+                "--join-timeout", "25", "--gather-timeout", "25",
+            ]
+            v0 = start_volunteer(addr, "hvol0", common + ["--seed", "0", "--batch-size", "8"])
+            v1 = start_volunteer(addr, "hvol1", common + ["--seed", "1", "--batch-size", "64"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            assert s0["rounds_ok"] >= 1, out0
+            assert s1["rounds_ok"] >= 1, out1
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5, (out0, out1)
+        finally:
+            coord.kill()
+
     def test_two_volunteers_grad_averaging_powersgd_wire(self):
         """Rank-4 PowerSGD wire end-to-end through the real entrypoints:
         grads averaged every step as (P, Q) factor pairs with error

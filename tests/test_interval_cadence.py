@@ -1,0 +1,145 @@
+"""Wall-clock averaging cadence (--average-interval-s) and samples-since-
+merge contribution weighting — the heterogeneous-swarm alignment features.
+
+Step-count cadence parks a fast volunteer at every rendezvous when peers
+step at different speeds (the reference's config 4 is exactly such a swarm).
+The interval cadence fires rounds at absolute wall-clock multiples of T and
+weights each contribution by the steps actually taken since the last merge.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.models import get_model
+from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+
+def make_trainer(**kw):
+    base = dict(batch_size=8, lr=1e-2, optimizer="adam", seed=0)
+    base.update(kw)
+    return Trainer(get_model("mnist_mlp"), **base)
+
+
+class TestAvgDue:
+    def test_step_cadence_unchanged(self):
+        t = make_trainer(average_every=3)
+        assert not t._avg_due(1)
+        assert not t._avg_due(2)
+        assert t._avg_due(3)
+        assert t._avg_due(6)
+
+    def test_interval_first_call_arms_only(self):
+        t = make_trainer(average_interval_s=3600.0)
+        assert not t._avg_due(1)  # arms the next hour boundary
+        assert not t._avg_due(2)  # not due within the test's lifetime
+
+    def test_interval_fires_once_per_boundary(self):
+        t = make_trainer(average_interval_s=0.15)
+        assert not t._avg_due(1)  # arm
+        time.sleep(0.16)
+        assert t._avg_due(2)  # crossed one boundary
+        assert not t._avg_due(3)  # same window: not due again
+        time.sleep(0.16)
+        assert t._avg_due(4)
+
+    def test_interval_boundaries_are_absolute(self):
+        # Two trainers armed at different instants inside the same window
+        # compute the SAME next boundary — the alignment property.
+        t1 = make_trainer(average_interval_s=500.0)
+        t2 = make_trainer(average_interval_s=500.0)
+        t1._avg_due(1)
+        time.sleep(0.05)
+        t2._avg_due(1)
+        assert t1._next_avg_t == t2._next_avg_t
+
+    def test_slow_step_skipping_boundaries_yields_one_round(self):
+        t = make_trainer(average_interval_s=0.05)
+        t._avg_due(1)
+        time.sleep(0.22)  # several boundaries pass
+        assert t._avg_due(2)
+        assert not t._avg_due(3)
+
+
+class TestValidation:
+    def test_grads_mode_rejected(self):
+        with pytest.raises(ValueError, match="average_interval_s"):
+            make_trainer(
+                average_interval_s=5.0,
+                average_what="grads",
+                averager=lambda g, s: g,
+            )
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError, match="average_interval_s"):
+            make_trainer(average_interval_s=-1.0)
+
+    def test_volunteer_config_requires_params_mode(self):
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        with pytest.raises(ValueError, match="average-interval-s"):
+            VolunteerConfig(
+                coordinator="127.0.0.1:1", averaging="sync",
+                average_what="grads", average_interval_s=5.0,
+            )
+        with pytest.raises(ValueError, match="average-interval-s"):
+            VolunteerConfig(
+                coordinator="127.0.0.1:1", averaging="none",
+                average_interval_s=5.0,
+            )
+        cfg = VolunteerConfig(
+            coordinator="127.0.0.1:1", averaging="sync",
+            average_what="params", average_interval_s=5.0,
+        )
+        assert cfg.average_interval_s == 5.0
+
+
+class TestIntervalRounds:
+    def test_rounds_fire_on_wall_clock_not_steps(self):
+        calls = []
+
+        def averager(tree, step):
+            calls.append(step)
+            return tree
+
+        t = make_trainer(
+            average_interval_s=0.1, averager=averager, average_what="params",
+            average_every=1,  # would fire every step under step cadence
+        )
+        # Pin wall time per step so the test is load-independent: 40 steps
+        # x 25ms = ~1s of wall time over 0.1s boundaries.
+        t.on_step = lambda tr, s: time.sleep(0.025)
+        t.run(steps=40, log_every=0)
+        # Rounds track wall boundaries (~10), NOT the 40 the step cadence
+        # would produce. Wide bounds: CI machines stall arbitrarily.
+        assert 3 <= len(calls) < 30
+
+    def test_huge_interval_never_fires(self):
+        calls = []
+        t = make_trainer(
+            average_interval_s=3600.0,
+            averager=lambda tree, step: calls.append(step) or tree,
+            average_what="params", average_every=1,
+        )
+        t.run(steps=6, log_every=0)
+        assert calls == []
+
+
+class TestStepsSinceMerge:
+    def test_weight_accumulates_over_failed_rounds(self):
+        # Round at step 3 fails (None); the next round's steps_since_merge
+        # must cover BOTH windows (6 steps), then reset after success.
+        seen = []
+
+        def flaky(tree, step):
+            seen.append((step, trainer.steps_since_merge))
+            return None if step == 3 else tree
+
+        trainer = make_trainer(
+            averager=flaky, average_what="params", average_every=3,
+        )
+        trainer.run(steps=9, log_every=0)
+        assert seen[0] == (3, 3)  # first round: one window
+        assert seen[1] == (6, 6)  # failed round's progress accumulated
+        assert seen[2] == (9, 3)  # merged at 6: back to one window
