@@ -563,12 +563,36 @@ def _bench_main() -> int:
         # reports fiction.
         float(m["loss"])
 
+        # Host-loop amortization arm (DVC_BENCH_STEPS_PER_CALL=N): scan N
+        # steps per dispatch (training/steps.py make_multi_step — the SAME
+        # traced body, so the metric is unchanged; only dispatch granularity
+        # moves). Measures what the volunteer's --steps-per-call buys on
+        # this runtime.
+        spc = int(os.environ.get("DVC_BENCH_STEPS_PER_CALL", "1"))
+        multi = None
+        if spc > 1:
+            from distributedvolunteercomputing_tpu.training.steps import make_multi_step
+
+            stage = "multi_compile"
+            multi = make_multi_step(bundle.loss_fn, tx)
+            stacked = jax.tree_util.tree_map(
+                lambda x: jnp.stack([x] * spc), batch
+            )
+            state, losses = multi(state, stacked)
+            float(losses[-1])
+            iters = max(iters // spc, 1) * spc  # whole chunks
+
         progress("warmup done; measuring")
         stage = "measure"
         t0 = time.perf_counter()
-        for _ in range(iters):
-            state, m = step(state, batch)
-        final_loss = float(m["loss"])
+        if multi is not None:
+            for _ in range(iters // spc):
+                state, losses = multi(state, stacked)
+            final_loss = float(losses[-1])
+        else:
+            for _ in range(iters):
+                state, m = step(state, batch)
+            final_loss = float(m["loss"])
         dt_s = time.perf_counter() - t0
         if not math.isfinite(final_loss):
             raise RuntimeError(f"non-finite loss during benchmark: {final_loss}")
@@ -625,6 +649,8 @@ def _bench_main() -> int:
         "attn_impl": os.environ.get("DVC_ATTN_IMPL", "auto"),
         "remat": remat_tag,  # which schedule produced this number
     }
+    if spc > 1:
+        payload["steps_per_call"] = spc  # dispatch granularity, not math
     seq_len = getattr(bundle.config, "max_len", None)
     if seq_len:
         tokens_per_sec = samples_per_sec_chip * seq_len

@@ -79,6 +79,9 @@ class VolunteerConfig:
     min_group: int = 2
     max_group: int = 16
     batch_size: int = 32  # samples per optimizer step (across accum microbatches)
+    # Scan up to N steps inside one compiled call between cadence points
+    # (host-loop amortization; params mode, no mesh). 1 = off.
+    steps_per_call: int = 1
     accum_steps: int = 1  # gradient-accumulation microbatches inside the step
     data_path: Optional[str] = None  # .npz real-data file; None = synthetic
     optimizer: str = "adam"
@@ -393,6 +396,14 @@ class Volunteer:
             accum_steps=self.cfg.accum_steps,
             average_every=self.cfg.average_every,
             average_interval_s=self.cfg.average_interval_s,
+            steps_per_call=self.cfg.steps_per_call,
+            # The checkpoint cadence lives inside on_step where chunk
+            # sizing can't see it — declare it so scan chunks end there.
+            chunk_cadences=(
+                (self.cfg.checkpoint_every,)
+                if self.cfg.checkpoint_dir and self.cfg.checkpoint_every > 0
+                else ()
+            ),
             averager=self._averager_callback if self.averager else None,
             average_what=self.cfg.average_what,
             overlap=self.cfg.overlap,

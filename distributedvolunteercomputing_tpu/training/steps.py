@@ -136,6 +136,33 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def make_multi_step(
+    loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
+    tx: optax.GradientTransformation,
+    accum_steps: int = 1,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, jax.Array]]:
+    """N train steps in ONE compiled call: ``(state, stacked_batches) ->
+    (state, per_step_losses)``.
+
+    Host-loop amortization (Trainer ``steps_per_call``): a Python loop
+    dispatches one program per step, so per-dispatch overhead (tens of µs
+    locally; a full HTTP round-trip on a tunneled runtime) sits on the
+    step's critical path. ``lax.scan`` over the SAME traced body
+    (``train_step_body`` — identical math to the single step, by
+    construction) moves the loop on-device: one dispatch per N steps, and
+    XLA can overlap the next step's prologue with the previous epilogue.
+    The leading axis of every batch leaf is the step index."""
+
+    def multi(state: TrainState, batches: Batch) -> Tuple[TrainState, jax.Array]:
+        def body(s: TrainState, b: Batch):
+            s2, metrics = train_step_body(loss_fn, tx, s, b, accum_steps)
+            return s2, metrics["loss"]
+
+        return jax.lax.scan(body, state, batches)
+
+    return jax.jit(multi, donate_argnums=(0,))
+
+
 def make_grad_step(
     loss_fn: Callable[[Any, Batch, jax.Array], Tuple[jax.Array, Metrics]],
     accum_steps: int = 1,

@@ -28,6 +28,7 @@ import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from distributedvolunteercomputing_tpu.models.registry import Batch, ModelBundle
@@ -61,6 +62,18 @@ class Trainer:
         # the compiled step); batch_size must divide evenly. Semantics match
         # one big batch — only peak activation memory changes.
         accum_steps: int = 1,
+        # Host-loop amortization: scan up to N train steps inside ONE
+        # compiled call (steps.make_multi_step), so per-step Python dispatch
+        # leaves the hot path. Chunks end at every metrics/eval/averaging
+        # boundary, so cadence semantics are unchanged; within a chunk,
+        # per-step losses still come back (scan ys) for target detection.
+        # 1 = off. Params mode, single-device/slice-internal trainers only.
+        steps_per_call: int = 1,
+        # Extra step cadences scan chunks must end at (beyond eval/log/
+        # averaging, which are clipped automatically) — e.g. the volunteer
+        # passes its checkpoint_every here, since that cadence lives inside
+        # its on_step closure where _chunk_len can't see it.
+        chunk_cadences: Tuple[int, ...] = (),
         average_every: int = 10,
         # Wall-clock averaging cadence for HETEROGENEOUS swarms (params mode
         # only; 0 = off, use the step cadence above). Rounds trigger when
@@ -133,6 +146,17 @@ class Trainer:
             # wall-clock cadence would let optimizer steps run on unmerged
             # gradients, which is params mode's job.
             raise ValueError("average_interval_s requires average_what='params'")
+        if steps_per_call < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+        if steps_per_call > 1:
+            if averager is not None and average_what == "grads":
+                # Grads cross the WAN between bwd and the optimizer EVERY
+                # step — there is no multi-step run to amortize.
+                raise ValueError("steps_per_call > 1 requires average_what='params'")
+            if mesh is not None:
+                # The sharded step threads explicit in-step constraints; a
+                # scanned variant is future work.
+                raise ValueError("steps_per_call > 1 is unsupported with a mesh")
         if accum_steps < 1 or batch_size % accum_steps != 0:
             raise ValueError(
                 f"accum_steps={accum_steps} must be >=1 and divide batch_size={batch_size}"
@@ -250,6 +274,19 @@ class Trainer:
             )
         else:
             self._step_fn = make_train_step(
+                bundle.loss_fn, self.tx, accum_steps=accum_steps
+            )
+        self.steps_per_call = int(steps_per_call)
+        self.chunk_cadences = tuple(int(c) for c in chunk_cadences if c)
+        # EMA of seconds per step, measured at chunk granularity — only
+        # maintained (and only needed) under the wall-clock averaging
+        # cadence, where chunk sizing must anticipate the next boundary.
+        self._ema_step_s: Optional[float] = None
+        self._multi_fn = None
+        if self.steps_per_call > 1 and self._step_fn is not None and mesh is None:
+            from distributedvolunteercomputing_tpu.training.steps import make_multi_step
+
+            self._multi_fn = make_multi_step(
                 bundle.loss_fn, self.tx, accum_steps=accum_steps
             )
         self._data_rng = data_rng
@@ -462,6 +499,51 @@ class Trainer:
             int(now // self.average_interval_s) + 1
         ) * self.average_interval_s
 
+    def _chunk_len(self, next_step: int, remaining: int, log_every: int) -> int:
+        """Steps the scan prefix + final per-step iteration may cover from
+        ``next_step`` without straddling a cadence boundary — every
+        metrics/eval/averaging/snapshot action happens on the chunk's LAST
+        step, so a chunk must END at the first boundary it meets."""
+        n = min(self.steps_per_call, remaining)
+        cadences = [
+            self.eval_every,
+            self.average_every if self.averager else 0,
+            log_every,
+            *self.chunk_cadences,
+        ]
+        for c in cadences:
+            if c:
+                n = min(n, c - ((next_step - 1) % c))
+        if self.averager is not None and self.average_interval_s > 0:
+            # Wall-clock boundaries can't be mapped to a step count without
+            # a step-time estimate; size the chunk to END just past the next
+            # boundary (EMA maintained by the fast path, which syncs once
+            # per chunk in this mode). Until the EMA exists, tiny chunks
+            # bootstrap it — due-poll latency is then ~one step once
+            # settled, not steps_per_call steps.
+            if self._ema_step_s is None:
+                n = min(n, 2)
+            elif self._next_avg_t is not None:
+                until = max(self._next_avg_t - time.time(), 0.0)
+                n = min(n, max(1, int(until / self._ema_step_s) + 1))
+        return max(1, n)
+
+    def _record_target_crossed(
+        self, cross_step: int, target_loss: float, t_start: float
+    ) -> Tuple[int, float]:
+        """Log + record the first target crossing; shared by the per-step
+        path and the scan-prefix path so the two can't diverge."""
+        wall = time.monotonic() - t_start
+        log.info(
+            "target loss %.4f reached at step %d (%.1fs)",
+            target_loss, cross_step, wall,
+        )
+        self.metrics.record_event(
+            cross_step, "target_crossed",
+            {"target_loss": target_loss, "wall_s": round(wall, 3)},
+        )
+        return (cross_step, wall)
+
     def _note_window_progress(self, step_no: int) -> None:
         """Record the local steps behind the contribution about to launch —
         the single source the volunteer's weight callback reads, shared by
@@ -606,9 +688,65 @@ class Trainer:
         ran_steps = 0
         target_crossed: Optional[Tuple[int, float]] = None  # (step, wall_s)
         for i in range(steps):
+            if ran_steps >= steps:
+                break  # scan prefixes below may consume several steps per iteration
             if stop_flag is not None and stop_flag():
                 log.info("stop flag set; exiting train loop at step %d", int(self.state.step))
                 break
+            # Multi-step fast path (steps_per_call > 1): run the first n-1
+            # steps of this chunk inside ONE compiled scan, then fall
+            # through to the ordinary per-step path for the chunk's final
+            # step — so metrics records, eval, averaging rounds, and
+            # snapshots all keep their exact cadence semantics (chunks end
+            # at every boundary, enforced by _chunk_len). Disabled while
+            # profiling (the trace hooks are per-step).
+            if self._multi_fn is not None and not profile_dir:
+                n = self._chunk_len(start_step + ran_steps + 1, steps - ran_steps, log_every)
+                if n > 1:
+                    prefix = [next(it) for _ in range(n - 1)]
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs), *prefix
+                    )
+                    t_chunk = time.perf_counter()
+                    self.state, losses = self._multi_fn(self.state, stacked)
+                    ran_steps += n - 1
+                    if self.averager is not None and self.average_interval_s > 0:
+                        # One sync per chunk: the real chunk duration feeds
+                        # the EMA that sizes chunks around wall boundaries
+                        # (_chunk_len). Negligible next to the n-1 steps.
+                        float(losses[-1])
+                        per_step = (time.perf_counter() - t_chunk) / (n - 1)
+                        self._ema_step_s = (
+                            per_step
+                            if self._ema_step_s is None
+                            else 0.5 * self._ema_step_s + 0.5 * per_step
+                        )
+                    if sync_every_step:
+                        host_losses = np.asarray(losses)
+                        for k, lv in enumerate(host_losses):
+                            self.metrics.record(
+                                start_step + ran_steps - (n - 1) + k + 1,
+                                {"loss": float(lv)},
+                                n_samples=self.batch_size,
+                            )
+                        last_loss = float(host_losses[-1])
+                        if target_loss is not None and target_crossed is None:
+                            hit = np.nonzero(host_losses <= target_loss)[0]
+                            if hit.size:
+                                cross_step = (
+                                    start_step + ran_steps - (n - 1) + int(hit[0]) + 1
+                                )
+                                target_crossed = self._record_target_crossed(
+                                    cross_step, target_loss, t_start
+                                )
+                                if target_mode == "stop":
+                                    # The end-of-run sync reads m; point it
+                                    # at THIS chunk's last loss, not the
+                                    # previous chunk's stale metrics.
+                                    m = {"loss": host_losses[-1]}
+                                    break
+                    else:
+                        self.metrics.count_samples(self.batch_size * (n - 1))
             batch = next(it)
             if self._put_batch is not None:
                 batch = self._put_batch(batch)
@@ -700,15 +838,8 @@ class Trainer:
                 )
             if target_loss is not None and last_loss <= target_loss:
                 if target_crossed is None:
-                    target_crossed = (step_no, time.monotonic() - t_start)
-                    log.info(
-                        "target loss %.4f reached at step %d (%.1fs)",
-                        target_loss, step_no, target_crossed[1],
-                    )
-                    self.metrics.record_event(
-                        step_no, "target_crossed",
-                        {"target_loss": target_loss,
-                         "wall_s": round(target_crossed[1], 3)},
+                    target_crossed = self._record_target_crossed(
+                        step_no, target_loss, t_start
                     )
                 if target_mode == "stop":
                     break

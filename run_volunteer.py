@@ -49,6 +49,10 @@ def main() -> None:
     ap.add_argument("--averaging", default="none",
                     choices=["none", "sync", "gossip", "butterfly", "byzantine"])
     ap.add_argument("--average-every", type=int, default=10)
+    ap.add_argument("--steps-per-call", type=int, default=1,
+                    help="scan up to N train steps inside one compiled call "
+                         "between cadence points (host-loop amortization; "
+                         "params mode, no --mesh). 1 = off")
     ap.add_argument("--average-interval-s", type=float, default=0.0,
                     help="wall-clock averaging cadence in seconds (params "
                          "mode; 0 = every --average-every steps). Rounds "
@@ -175,6 +179,7 @@ def main() -> None:
         averaging=args.averaging,
         average_every=args.average_every,
         average_interval_s=args.average_interval_s,
+        steps_per_call=args.steps_per_call,
         average_what=args.average_what,
         wire=args.wire,
         topk_frac=args.topk_frac,

@@ -154,6 +154,27 @@ class TestSwarmE2E:
         finally:
             coord.kill()
 
+    def test_two_volunteers_sync_steps_per_call(self):
+        """--steps-per-call end to end: chunked on-device stepping between
+        averaging points, rounds still complete at the step cadence."""
+        coord, addr = start_coordinator()
+        try:
+            common = [
+                "--averaging", "sync", "--average-every", "10",
+                "--steps-per-call", "5", "--steps", "40",
+                "--join-timeout", "25", "--gather-timeout", "25",
+            ]
+            v0 = start_volunteer(addr, "spc0", common + ["--seed", "0"])
+            v1 = start_volunteer(addr, "spc1", common + ["--seed", "1"])
+            s0, out0 = wait_done(v0)
+            s1, out1 = wait_done(v1)
+            assert s0["rounds_ok"] >= 1, out0
+            assert s1["rounds_ok"] >= 1, out1
+            assert s0["steps"] == 40 and s1["steps"] == 40, (out0, out1)
+            assert s0["final_loss"] < 2.5 and s1["final_loss"] < 2.5, (out0, out1)
+        finally:
+            coord.kill()
+
     def test_heterogeneous_volunteers_interval_cadence(self):
         """Wall-clock averaging cadence end to end: volunteers with 8x
         different batch sizes (heterogeneous speed, the config-4 shape)

@@ -1,0 +1,103 @@
+"""steps_per_call (host-loop amortization): N train steps scanned inside one
+compiled call must be bit-compatible with N single-step dispatches — the
+scan runs the SAME traced body (training/steps.py train_step_body), so any
+divergence is a bug, not tolerance.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.models import get_model
+from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+
+def leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def make_trainer(**kw):
+    base = dict(batch_size=8, lr=1e-2, optimizer="adam", seed=0)
+    base.update(kw)
+    return Trainer(get_model("mnist_mlp"), **base)
+
+
+class TestEquivalence:
+    def test_scanned_matches_per_step_exactly(self):
+        t1 = make_trainer()
+        t8 = make_trainer(steps_per_call=8)
+        t1.run(steps=16, log_every=0)
+        t8.run(steps=16, log_every=0)
+        assert int(t8.state.step) == 16
+        for a, b in zip(leaves(t1.state.params), leaves(t8.state.params)):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_chunk_not_dividing_steps(self):
+        # 10 steps at steps_per_call=4: chunks of 4+4+2 — same endpoint.
+        t1 = make_trainer(seed=5)
+        t4 = make_trainer(seed=5, steps_per_call=4)
+        t1.run(steps=10, log_every=0)
+        t4.run(steps=10, log_every=0)
+        assert int(t4.state.step) == 10
+        for a, b in zip(leaves(t1.state.params), leaves(t4.state.params)):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_chunks_end_at_averaging_boundary(self):
+        # average_every=5 with steps_per_call=8: every round must still see
+        # the exact post-step-5k params (chunks clip at the cadence).
+        calls = []
+
+        def averager(tree, step):
+            calls.append(step)
+            return tree
+
+        t = make_trainer(
+            steps_per_call=8, averager=averager, average_what="params",
+            average_every=5,
+        )
+        t.run(steps=20, log_every=0)
+        assert calls == [5, 10, 15, 20]
+
+    def test_target_crossing_detected_inside_scan_prefix(self):
+        # The mnist proxy crosses 0.3 within a few steps; with a 16-step
+        # chunk the crossing happens INSIDE the scanned prefix and must be
+        # attributed to the right step, not the chunk end.
+        t_ref = make_trainer(seed=9)
+        r_ref = t_ref.run(steps=32, target_loss=0.3, target_mode="record", log_every=0)
+        t16 = make_trainer(seed=9, steps_per_call=16)
+        r16 = t16.run(steps=32, target_loss=0.3, target_mode="record", log_every=0)
+        assert r16["target_crossed_step"] == r_ref["target_crossed_step"]
+
+    def test_target_stop_mode_breaks_after_prefix(self):
+        t = make_trainer(seed=9, steps_per_call=16)
+        r = t.run(steps=64, target_loss=0.3, target_mode="stop", log_every=0)
+        # Stops at a chunk boundary at the latest — far short of 64.
+        assert r["steps"] <= 32
+        # final_loss must reflect the stopping chunk, not a stale metric
+        # from the previous chunk (regression: summary said loss > target
+        # after a mid-prefix stop).
+        assert r["final_loss"] <= 0.3
+
+    def test_chunk_cadences_respected(self):
+        # A cadence declared via chunk_cadences (the volunteer's checkpoint
+        # cadence) must end chunks exactly like eval/averaging boundaries.
+        seen = []
+        t = make_trainer(steps_per_call=5, chunk_cadences=(7,))
+        t.on_step = lambda tr, s: seen.append(s)
+        t.run(steps=21, log_every=0)
+        # on_step fires at every chunk-final step; multiples of 7 must all
+        # be present (7, 14, 21), whatever else the chunking produced.
+        assert {7, 14, 21} <= set(seen)
+
+
+class TestValidation:
+    def test_grads_mode_rejected(self):
+        with pytest.raises(ValueError, match="steps_per_call"):
+            make_trainer(
+                steps_per_call=4, average_what="grads",
+                averager=lambda g, s: g,
+            )
+
+    def test_zero_rejected(self):
+        with pytest.raises(ValueError, match="steps_per_call"):
+            make_trainer(steps_per_call=0)
