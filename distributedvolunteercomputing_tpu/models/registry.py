@@ -121,6 +121,24 @@ def _gpt2_moe(**overrides: Any) -> ModelBundle:
     )
 
 
+def _vit(**overrides: Any) -> ModelBundle:
+    from distributedvolunteercomputing_tpu.models import vit
+    from distributedvolunteercomputing_tpu.training import data
+
+    cfg = dataclasses.replace(vit.ViTConfig(), **overrides)
+    return ModelBundle(
+        name="cifar10_vit",
+        config=cfg,
+        init=lambda rng: vit.init(rng, cfg),
+        loss_fn=lambda p, b, rng: vit.loss_fn(p, b, rng, cfg),
+        make_batch=lambda rng, bs: data.synthetic_image_batch(
+            rng, bs,
+            shape=(cfg.image_size, cfg.image_size, cfg.channels),
+            n_classes=cfg.n_classes,
+        ),
+    )
+
+
 def _llama_lora(**overrides: Any) -> ModelBundle:
     from distributedvolunteercomputing_tpu.models import llama
     from distributedvolunteercomputing_tpu.training import data
@@ -143,6 +161,7 @@ def _llama_lora(**overrides: Any) -> ModelBundle:
 _REGISTRY: Dict[str, Callable[..., ModelBundle]] = {
     "mnist_mlp": _mlp,
     "cifar10_resnet18": _resnet18,
+    "cifar10_vit": _vit,
     "bert_mlm": _bert,
     "gpt2_small": _gpt2,
     "gpt2_moe": _gpt2_moe,

@@ -17,6 +17,7 @@ from distributedvolunteercomputing_tpu.training.steps import TrainState, make_tr
 TINY = {
     "mnist_mlp": dict(d_hidden=32),
     "cifar10_resnet18": dict(stage_sizes=(1, 1), widths=(8, 16), stem_width=8, groups=2),
+    "cifar10_vit": dict(d_model=32, n_heads=2, n_layers=2, d_ff=64, patch_size=8),
     "bert_mlm": dict(vocab=256, max_len=32, d_model=32, n_heads=2, n_layers=2, d_ff=64),
     "gpt2_small": dict(vocab=256, max_len=32, d_model=32, n_heads=2, n_layers=2, d_ff=64),
     "llama_lora": dict(vocab=256, max_len=32, d_model=32, n_heads=2, n_kv_heads=2, n_layers=2, d_ff=64, lora_rank=4),
@@ -36,7 +37,7 @@ def test_loss_finite_and_grads_flow(name):
     assert gnorm > 0, "no gradient flow"
 
 
-@pytest.mark.parametrize("name", ["cifar10_resnet18", "gpt2_small"])
+@pytest.mark.parametrize("name", ["cifar10_resnet18", "cifar10_vit", "gpt2_small"])
 def test_few_steps_reduce_loss(name):
     bundle = get_model(name, **TINY[name])
     tx = make_optimizer("adam", lr=3e-3)
@@ -191,6 +192,62 @@ class TestChunkedXent:
         full = common.softmax_xent(jnp.einsum("btd,vd->btv", x, head), labels)
         got = common.lm_xent_chunked(x, head, labels, chunk=5)  # 16 % 5 != 0
         np.testing.assert_allclose(float(got), float(full), rtol=1e-6)
+
+
+class TestViT:
+    def test_patchify_is_invertible_partition(self):
+        # Patchification must PARTITION the image: every pixel appears in
+        # exactly one patch (sum over patches == sum over image, and
+        # un-patchifying restores the array).
+        from distributedvolunteercomputing_tpu.models import vit
+
+        cfg = vit.ViTConfig(image_size=8, patch_size=4, channels=3)
+        x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+        p = vit._patchify(x, cfg)
+        assert p.shape == (2, cfg.n_patches, cfg.patch_dim)
+        np.testing.assert_allclose(float(p.sum()), float(x.sum()))
+        s = 8 // 4
+        back = (
+            p.reshape(2, s, s, 4, 4, 3).transpose(0, 1, 3, 2, 4, 5).reshape(2, 8, 8, 3)
+        )
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+    def test_indivisible_patch_rejected(self):
+        from distributedvolunteercomputing_tpu.models import vit
+
+        with pytest.raises(ValueError, match="patch_size"):
+            vit.init(jax.random.PRNGKey(0), vit.ViTConfig(image_size=30, patch_size=4))
+
+    def test_logits_shape(self):
+        from distributedvolunteercomputing_tpu.models import vit
+
+        cfg = vit.ViTConfig(
+            image_size=16, patch_size=8, d_model=32, n_heads=2, n_layers=2,
+            d_ff=64, remat=False,
+        )
+        params = vit.init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 16, 3))
+        assert vit.forward(params, x, cfg).shape == (3, cfg.n_classes)
+
+    def test_head_reads_cls_position(self):
+        # With ZERO blocks the trunk is the identity, so the head sees only
+        # ln(cls + pos[0]) — logits must be image-INDEPENDENT. Any head that
+        # reads a patch position or pools over patches varies with the
+        # image, so this pins `h[:, 0]` exactly (a bidirectional-attention
+        # perturbation test cannot: with blocks, everything affects
+        # everything).
+        from distributedvolunteercomputing_tpu.models import vit
+
+        cfg = vit.ViTConfig(
+            image_size=16, patch_size=8, d_model=32, n_heads=2, n_layers=0,
+            d_ff=64, remat=False,
+        )
+        params = vit.init(jax.random.PRNGKey(0), cfg)
+        xa = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+        xb = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 16, 3))
+        la = np.asarray(vit.forward(params, xa, cfg))
+        lb = np.asarray(vit.forward(params, xb, cfg))
+        np.testing.assert_array_equal(la, lb)
 
 
 def test_full_size_configs_have_expected_scale():
