@@ -73,13 +73,16 @@ def start_coordinator():
     raise RuntimeError("coordinator did not become ready")
 
 
-def start_volunteer(coord, peer_id, args):
+def start_volunteer(coord, peer_id, args, extra_env=None):
+    env = _env()
+    if extra_env:
+        env.update(extra_env)
     return subprocess.Popen(
         [
             sys.executable, os.path.join(REPO, "run_volunteer.py"),
             "--coordinator", coord, "--peer-id", peer_id, *args,
         ],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
     )
 
 
@@ -91,17 +94,32 @@ def wait_done(proc, timeout):
     return None, out
 
 
-def run_swarm(name, vol_specs, timeout=600, kill_after=None):
+def run_swarm(name, vol_specs, timeout=600, kill_after=None, chaos_peer=None):
     """Launch a swarm; vol_specs = [(peer_id, [cli args]), ...].
 
     ``kill_after``: (seconds, peer_index) — SIGKILL that volunteer mid-run
-    (the config-5 churn). Returns list of (peer_id, summary|None, wall_s).
+    (the config-5 churn). ``chaos_peer``: (peer_id, scale) — that volunteer
+    contributes its tree scaled by ``scale`` (the DVC_CHAOS_CONTRIB_SCALE
+    byzantine fault-injection hook). Returns (peer_id, summary|None, wall_s).
     """
     coord, addr = start_coordinator()
     t0 = time.monotonic()
     rows = []
     try:
-        vols = [(pid, start_volunteer(addr, pid, args)) for pid, args in vol_specs]
+        vols = [
+            (
+                pid,
+                start_volunteer(
+                    addr, pid, args,
+                    extra_env=(
+                        {"DVC_CHAOS_CONTRIB_SCALE": chaos_peer[1]}
+                        if chaos_peer and pid == chaos_peer[0]
+                        else None
+                    ),
+                ),
+            )
+            for pid, args in vol_specs
+        ]
         if kill_after is not None:
             delay, idx = kill_after
             time.sleep(delay)
@@ -327,9 +345,48 @@ def config0_overlap():
     return agg
 
 
+def config8_kitchen_sink_r4():
+    """Round-4 second-session feature composition as ONE swarm: PowerSGD
+    grad wire is grads-mode-only while the outer optimizer and wall-clock
+    cadence are params-mode, so this runs the params-mode trio — byzantine
+    (trimmed-mean) aggregation x DiLoCo outer Nesterov x --average-interval-s
+    x --steps-per-call — on the gpt2 proxy with kill -9 churn, proving the
+    new features compose with each other AND with the robust path under
+    failure. A separate 3-volunteer grads-mode arm (2 honest + 1
+    chaos-scaled — the minimum where trimmed mean can actually reject the
+    byzantine row) runs powersgd under byzantine aggregation."""
+    common = ["--model", "gpt2_small", *TINY_GPT2, "--averaging", "byzantine",
+              "--method", "trimmed_mean", "--average-interval-s", "8",
+              "--steps-per-call", "4", "--outer-optimizer", "nesterov",
+              "--steps", "120", "--batch-size", "8", "--lr", "0.003",
+              "--min-group", "2", *TIMEOUTS, *_target(4.4)]
+    rows = run_swarm(
+        "config8/params_trio",
+        [(f"sink{i}", common + ["--seed", str(i)]) for i in range(4)],
+        timeout=900,  # 4 contending volunteers + wall-clock rounds
+        kill_after=(30.0, 3),  # churn under the new cadence
+    )
+    agg = record("config8_outer_interval_spc_byz_churn", rows)
+
+    gcommon = ["--model", "gpt2_small", *TINY_GPT2, "--averaging", "byzantine",
+               "--method", "trimmed_mean", "--average-what", "grads",
+               "--wire", "powersgd", "--psgd-rank", "4",
+               "--steps", "30", "--batch-size", "8", "--lr", "0.003",
+               "--min-group", "2", *TIMEOUTS]
+    grows = run_swarm(
+        "config8/psgd_byz",
+        [("psgd0", gcommon + ["--seed", "0"]),
+         ("psgd1", gcommon + ["--seed", "1"]),
+         ("psgd2", gcommon + ["--seed", "2"])],
+        chaos_peer=("psgd2", "-3.0"),  # byzantine-valued contributions
+    )
+    agg2 = record("config8_psgd_byzantine_wire", grows)
+    return {"params_trio": agg, "psgd_byz": agg2}
+
+
 CONFIGS = {
     0: config0_overlap, 1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-    6: config6_file_mnist, 7: config7_file_resnet,
+    6: config6_file_mnist, 7: config7_file_resnet, 8: config8_kitchen_sink_r4,
 }
 
 

@@ -132,6 +132,55 @@ class TestCodec:
             powersgd.PowerSGDCodec([], rank=0)
 
 
+class TestOddShapes:
+    @pytest.mark.parametrize(
+        "shape",
+        [
+            (1, 64),     # single-row matrix
+            (64, 1),     # single-column matrix
+            (3, 5),      # tiny, not worth compressing at rank 4
+            (2, 3, 8),   # 3D leaf: leading dims flatten to n=6
+            (7,),        # 1D: always dense
+            (128, 128),  # square, well worth compressing
+        ],
+    )
+    def test_roundtrip_any_shape(self, shape):
+        rng = np.random.default_rng(hash(shape) % 2**32)
+        tree = {"t": rng.standard_normal(shape).astype(np.float32)}
+        buf, specs, _ = flatten_to_buffer(tree)
+        codec = powersgd.PowerSGDCodec(specs, rank=4)
+        out = powersgd.decode(codec.encode(buf))
+        assert out.shape == buf.shape
+        if codec.plan[0][2] is None:
+            np.testing.assert_array_equal(out, buf)  # dense: exact
+        else:
+            # Low-rank: projection shrinks nothing to garbage.
+            assert np.isfinite(out).all()
+            assert np.linalg.norm(out) <= np.linalg.norm(buf) * 1.01
+
+    def test_empty_tree(self):
+        codec = powersgd.PowerSGDCodec([], rank=4)
+        wire = codec.encode(np.zeros((0,), np.float32))
+        assert powersgd.decode(wire).size == 0
+
+    def test_mixed_tree_many_leaves(self):
+        rng = np.random.default_rng(99)
+        tree = {
+            "a": rng.standard_normal((32, 16)).astype(np.float32),
+            "b": rng.standard_normal((5,)).astype(np.float32),
+            "c": rng.standard_normal((2, 8, 24)).astype(np.float32),
+            # All-zero matrix big enough to take the LOW-RANK path at rank 2
+            # ((8+8)*2 < 8*8): QR over a zero matrix must stay finite across
+            # warm-started rounds.
+            "d": np.zeros((8, 8), np.float32),
+        }
+        buf, specs, _ = flatten_to_buffer(tree)
+        codec = powersgd.PowerSGDCodec(specs, rank=2)
+        for _ in range(3):  # warm-start rounds over a zero leaf stay finite
+            out = powersgd.decode(codec.encode(buf))
+            assert np.isfinite(out).all()
+
+
 class TestMerge:
     def test_factored_mean_exact(self):
         rng = np.random.default_rng(11)
