@@ -70,13 +70,24 @@ def _ratchet_path() -> str:
 
 
 def _ratchet_key(
-    model_name: str, metric_suffix: str, batch_size: int, dtype_key: str, remat_tag: str
+    model_name: str,
+    metric_suffix: str,
+    batch_size: int,
+    dtype_key: str,
+    remat_tag: str,
+    spc: str = "1",
 ) -> str:
     """One record PER full configuration — shared by the live path and the
     recorded-probe fallback so the two can never drift apart silently (a
     key mismatch would degrade vs_baseline to 1.0, indistinguishable from
-    'on baseline')."""
-    return f"{model_name}{metric_suffix}|bs{batch_size}|{dtype_key}|remat-{remat_tag}"
+    'on baseline'). steps_per_call joins the key for the same reason remat
+    does: the two dispatch schedules differ by construction, and sharing a
+    record would report phantom deltas when rounds alternate between them
+    (e.g. a tight budget skips the spc bonus arm)."""
+    key = f"{model_name}{metric_suffix}|bs{batch_size}|{dtype_key}|remat-{remat_tag}"
+    if spc != "1":
+        key += f"|spc{spc}"
+    return key
 
 
 def _memory_stats() -> dict | None:
@@ -127,6 +138,59 @@ _LADDER = (
     {"DVC_ATTN_IMPL": "xla", "DVC_BENCH_PARAM_DTYPE": "bfloat16"},
     {"DVC_ATTN_IMPL": "xla", "DVC_BENCH_PARAM_DTYPE": "bfloat16", "DVC_BENCH_ITERS": "10"},
 )
+
+
+def _maybe_spc_arm(
+    env: dict, best_out: str, best: dict, budget: float, t_start: float
+) -> str:
+    """After a live rung succeeds, spend leftover budget on ONE more child
+    with steps_per_call=8 (training/steps.make_multi_step: the SAME traced
+    step scanned on-device — dispatch granularity, not different math) and
+    report whichever measured higher. On the tunneled runtime per-step
+    dispatch is suspected to tax the hot loop (BASELINE.md methodology
+    note); this lets the round-end bench capture the amortization win in
+    whatever window it gets, without risking the base number — the arm is
+    additive and only replaces the result when strictly faster.
+    DVC_BENCH_TRY_SPC=0 disables."""
+    import subprocess
+
+    if os.environ.get("DVC_BENCH_TRY_SPC", "1") != "1":
+        return best_out
+    if "DVC_BENCH_STEPS_PER_CALL" in env:
+        return best_out
+    remaining = budget - (time.monotonic() - t_start)
+    if remaining < 100:
+        return best_out
+    deadline = min(remaining - 5.0, 190.0)
+    env2 = dict(env, DVC_BENCH_STEPS_PER_CALL="8")
+    env2["DVC_BENCH_CHILD_DEADLINE"] = str(max(deadline - 8.0, 30.0))
+    print(f"bench: spc8 bonus arm, deadline={deadline:.0f}s", file=sys.stderr)
+    stdout2 = ""
+    try:
+        p2 = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env2, timeout=deadline, capture_output=True, text=True,
+        )
+        sys.stderr.write(p2.stderr)
+        stdout2 = p2.stdout
+    except subprocess.TimeoutExpired as exc:
+        # The dominant failure mode on this chip is print-then-hang in
+        # libtpu teardown (same salvage as the main ladder): a winning
+        # measurement may already be in the captured stdout.
+        stdout2 = exc.stdout or ""
+        if isinstance(stdout2, bytes):
+            stdout2 = stdout2.decode(errors="replace")
+        print("bench: spc8 arm hung; salvaging its stdout", file=sys.stderr)
+    lines2 = [l for l in stdout2.splitlines() if l.startswith("{")]
+    pay2 = _parse_last(lines2) if lines2 else None
+    if pay2 and pay2.get("value", 0) > best.get("value", 0):
+        print(
+            f"bench: spc8 arm wins ({pay2['value']} vs {best['value']})",
+            file=sys.stderr,
+        )
+        return stdout2
+    print("bench: spc8 arm did not beat base; keeping base", file=sys.stderr)
+    return best_out
 
 
 def main() -> int:
@@ -202,9 +266,10 @@ def main() -> int:
         if json_lines:
             payload = _parse_last(json_lines)
             if payload and payload.get("value", 0) > 0:
-                for line in proc.stdout.splitlines():
+                out = _maybe_spc_arm(env, proc.stdout, payload, budget, t_start)
+                for line in out.splitlines():
                     print(line)
-                return proc.returncode
+                return 0
             # Diagnostic JSON from a failed child: keep it, try next rung.
             if payload:
                 last_diag = payload
@@ -622,7 +687,9 @@ def _bench_main() -> int:
     # remat joins the key: the two schedules differ ~1.3x by construction,
     # so sharing a record would report phantom perf deltas across rungs.
     remat_tag = "off" if model_kw.get("remat") is False else "on"
-    model_key = _ratchet_key(model_name, metric_suffix, batch_size, dtype_key, remat_tag)
+    model_key = _ratchet_key(
+        model_name, metric_suffix, batch_size, dtype_key, remat_tag, str(spc)
+    )
     rec = prior.get(model_key)
     if isinstance(rec, dict) and rec.get("value"):
         vs_baseline = samples_per_sec_chip / float(rec["value"])
