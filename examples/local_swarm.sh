@@ -1,0 +1,36 @@
+#!/bin/bash
+# Smallest end-to-end swarm: one coordinator + two volunteers on localhost,
+# synchronous parameter averaging on the MNIST proxy. Each volunteer prints
+# per-step logs and a final VOLUNTEER_DONE summary line (JSON).
+#
+#   bash examples/local_swarm.sh                 # on the default accelerator
+#   JAX_PLATFORMS=cpu bash examples/local_swarm.sh   # force CPU (demo boxes)
+#
+# Variations to try (see README / docs/MIGRATION.md for the full surface):
+#   --average-what grads --wire powersgd --psgd-rank 4   compressed grad rounds
+#   --averaging byzantine --method trimmed_mean          robust aggregation
+#   --average-interval-s 10                              wall-clock cadence
+#   --steps-per-call 8                                   scan 8 steps/dispatch
+#   --outer-optimizer nesterov                           DiLoCo outer step
+set -e
+cd "$(dirname "$0")/.."
+
+python coordinator.py >/tmp/dvc_coord.log 2>&1 &
+COORD_PID=$!
+trap 'kill $COORD_PID 2>/dev/null' EXIT
+for _ in $(seq 40); do
+    ADDR=$(grep -o "COORDINATOR_READY .*" /tmp/dvc_coord.log 2>/dev/null | awk '{print $2}')
+    [ -n "$ADDR" ] && break
+    sleep 1
+done
+[ -n "$ADDR" ] || { echo "coordinator did not come up (/tmp/dvc_coord.log)"; exit 1; }
+echo "coordinator at $ADDR"
+
+COMMON="--coordinator $ADDR --model mnist_mlp --averaging sync \
+        --average-every 10 --steps 100 --batch-size 32 --lr 0.01"
+python run_volunteer.py $COMMON --peer-id alice --seed 0 &
+V0=$!
+python run_volunteer.py $COMMON --peer-id bob --seed 1 &
+V1=$!
+wait $V0 $V1
+echo "swarm done (coordinator log: /tmp/dvc_coord.log)"

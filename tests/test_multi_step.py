@@ -90,6 +90,22 @@ class TestEquivalence:
         assert {7, 14, 21} <= set(seen)
 
 
+class TestProfilingInterplay:
+    def test_fast_path_disabled_while_profiling(self, tmp_path, monkeypatch):
+        # The profiler hooks are per-step, so steps_per_call must silently
+        # fall back to per-step dispatch when DVC_PROFILE_DIR is set — and
+        # the trace must still be produced.
+        import os
+
+        monkeypatch.setenv("DVC_PROFILE_DIR", str(tmp_path / "trace"))
+        monkeypatch.setenv("DVC_PROFILE_START", "2")
+        monkeypatch.setenv("DVC_PROFILE_STEPS", "2")
+        t = make_trainer(steps_per_call=4)
+        t.run(steps=8, log_every=0)
+        assert int(t.state.step) == 8
+        assert os.path.isdir(tmp_path / "trace")  # trace was written
+
+
 class TestValidation:
     def test_grads_mode_rejected(self):
         with pytest.raises(ValueError, match="steps_per_call"):
