@@ -13,7 +13,7 @@ TPU-first deviations from the torchvision-style reference genre:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

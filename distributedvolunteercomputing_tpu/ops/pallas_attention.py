@@ -21,7 +21,6 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 # Per-row softmax stats (lse, delta) are carried with a broadcast 128-lane

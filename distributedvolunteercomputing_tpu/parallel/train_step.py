@@ -13,7 +13,7 @@ Host code only touches the result every K steps when the WAN averager
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
