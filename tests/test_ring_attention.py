@@ -92,3 +92,44 @@ def test_gpt2_step_with_sequence_parallelism(eight_devices):
             _, m = step(state, b)
         losses[name] = float(m["loss"])
     assert np.isclose(losses["dp"], losses["dp_sp"], atol=1e-5), losses
+
+
+@pytest.mark.slow
+def test_long_context_t4096_sp8_vs_sp4(eight_devices):
+    """LONG-context proof (slow, opt-in): a T=4096 causal train step with the
+    sequence sharded 8 ways vs 4 ways. Ring attention never materializes an
+    O(T^2) score matrix (per-device blocks are [T/sp, T/sp]), and both
+    layouts are exact — so their losses must agree to float tolerance, a
+    self-consistency check that needs no T^2-sized reference."""
+    from distributedvolunteercomputing_tpu.models import get_model
+    from distributedvolunteercomputing_tpu.parallel.mesh import make_mesh
+    from distributedvolunteercomputing_tpu.parallel.train_step import (
+        make_sharded_train_step,
+        put_batch,
+        shard_train_state,
+    )
+    from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+    from distributedvolunteercomputing_tpu.training.steps import TrainState
+
+    bundle = get_model(
+        "gpt2_small", n_layers=2, d_model=32, n_heads=2, d_ff=64,
+        vocab=128, max_len=4096, remat=False,
+    )
+    tx = make_optimizer("adam", lr=1e-3)
+    params = bundle.init(jax.random.PRNGKey(0))
+    batch = bundle.make_batch(jax.random.PRNGKey(2), 1)
+
+    losses = {}
+    for sp in (8, 4):
+        mesh = make_mesh(dp=1, sp=sp, devices=eight_devices[:sp])
+        state = TrainState.create(params, tx, jax.random.PRNGKey(1))
+        state, _ = shard_train_state(state, mesh, tx)
+        step = make_sharded_train_step(
+            bundle.loss_fn, tx, mesh, donate=False, seq_sharded_batch=True
+        )
+        b = put_batch(batch, mesh, seq_sharded=True)
+        with mesh:
+            _, m = step(state, b)
+        losses[sp] = float(m["loss"])
+    assert np.isfinite(losses[8])
+    assert np.isclose(losses[8], losses[4], rtol=1e-4), losses
