@@ -170,7 +170,21 @@ class Transport:
             raise RPCError(f"payload {payload_len} exceeds {MAX_PAYLOAD}")
         if meta_len > MAX_META:
             raise RPCError(f"meta {meta_len} exceeds {MAX_META}")
-        meta = json.loads(await reader.readexactly(meta_len)) if meta_len else {}
+        meta_b = await reader.readexactly(meta_len) if meta_len else b"{}"
+        try:
+            meta = json.loads(meta_b)
+        except (ValueError, RecursionError) as e:
+            # Attacker-controlled bytes: a JSONDecodeError is a ValueError,
+            # not an RPCError — without this wrap it would escape the serve
+            # loop's bad-frame containment and kill the connection task with
+            # an unhandled exception instead of a clean error frame.
+            # RecursionError too: deeply-nested JSON (200 KB of '[' fits
+            # comfortably under MAX_META) blows the parser's stack.
+            raise RPCError(f"malformed frame meta (not JSON: {e})") from e
+        if not isinstance(meta, dict):
+            # json.loads happily returns lists/scalars; meta.get() downstream
+            # would AttributeError outside the containment net.
+            raise RPCError(f"malformed frame meta (not an object: {type(meta).__name__})")
         payload = await reader.readexactly(payload_len) if payload_len else b""
         self.bytes_received += _HEADER.size + meta_len + payload_len
         if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:

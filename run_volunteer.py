@@ -33,6 +33,8 @@ def main() -> None:
     # sandbox sitecustomize) already pinned the platform; no-op elsewhere.
     pin_platform()
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--list-models", action="store_true",
+                    help="print the model zoo and exit")
     ap.add_argument("--model", default="mnist_mlp")
     ap.add_argument("--model-override", action="append", default=[],
                     help="key=value config override (repeatable), e.g. d_model=128")
@@ -159,6 +161,13 @@ def main() -> None:
                          "times (dead peers cost seconds, not the full "
                          "gather budget); --gather-timeout stays the ceiling")
     args = ap.parse_args()
+
+    if args.list_models:
+        from distributedvolunteercomputing_tpu.models import list_models
+
+        for name in list_models():
+            print(name)
+        return
 
     overrides = {}
     for kv in args.model_override:

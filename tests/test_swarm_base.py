@@ -40,6 +40,71 @@ class TestTransport:
         assert ret == {"got": 42}
         assert payload == b"cba"
 
+    def test_survives_garbage_frames(self):
+        """Frame-parser fuzz: raw TCP garbage — bad magic, truncated
+        headers, oversize lengths, invalid JSON meta, non-dict JSON meta —
+        must each produce a clean drop (no task crash), and the server must
+        keep serving legitimate RPCs afterwards."""
+        import json as _json
+        import zlib
+
+        from distributedvolunteercomputing_tpu.swarm.transport import (
+            _HEADER, MAGIC, VERSION,
+        )
+
+        def frame(meta_b: bytes, payload: bytes = b"", magic=MAGIC, version=VERSION):
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
+            return (
+                _HEADER.pack(magic, version, 1, len(meta_b), len(payload), crc)
+                + meta_b + payload
+            )
+
+        garbage = [
+            b"\x00" * 64,                                  # not a frame at all
+            frame(b"{}", magic=b"XX"),                     # bad magic
+            frame(b"{}", version=99),                      # bad version
+            frame(b"not json at all"),                     # invalid JSON meta
+            frame(_json.dumps([1, 2, 3]).encode()),        # JSON, not an object
+            frame(_json.dumps("str").encode()),            # JSON scalar meta
+            _HEADER.pack(MAGIC, VERSION, 1, 10, 0, 0),     # truncated: no meta
+            _HEADER.pack(MAGIC, VERSION, 1, 0, 1 << 62, 0),  # absurd payload len
+            frame(b"[" * 100_000 + b"1" + b"]" * 100_000),  # parser stack bomb
+        ]
+
+        async def main():
+            server = Transport()
+
+            async def echo(args, payload):
+                return {"ok": True}, payload
+
+            server.register("echo", echo)
+            addr = await server.start()
+            for g in garbage:
+                reader, writer = await asyncio.open_connection(*addr)
+                writer.write(g)
+                try:
+                    await writer.drain()
+                    # EOF makes a server blocked on readexactly for bytes
+                    # that will never come fail fast (IncompleteReadError)
+                    # instead of stalling this test for the full timeout.
+                    writer.write_eof()
+                    # Server replies with an error frame or just drops us;
+                    # either way the connection ends without wedging.
+                    await asyncio.wait_for(reader.read(1 << 16), timeout=5)
+                except (ConnectionResetError, BrokenPipeError, asyncio.TimeoutError):
+                    pass
+                finally:
+                    writer.close()
+            # The real client still works after every garbage volley.
+            client = Transport()
+            ret, payload = await client.call(addr, "echo", {"x": 1}, b"ok")
+            await server.close()
+            return ret, payload
+
+        ret, payload = run(main())
+        assert ret == {"ok": True}
+        assert payload == b"ok"
+
     def test_large_binary_payload(self):
         async def main():
             server = Transport()
