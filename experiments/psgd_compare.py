@@ -32,7 +32,7 @@ MODEL = ["--model", "gpt2_small",
          "--model-override", "vocab=256", "--model-override", "max_len=32",
          "--model-override", "d_model=64", "--model-override", "n_heads=2",
          "--model-override", "n_layers=2", "--model-override", "d_ff=128"]
-STEPS = 30  # grads mode: one round per step
+STEPS = int(os.environ.get("DVC_PSGD_STEPS", "30"))  # grads: one round/step
 
 
 def arm(tag: str, extra: list) -> dict:
