@@ -15,7 +15,12 @@ import asyncio
 import numpy as np
 import pytest
 
-from distributedvolunteercomputing_tpu.swarm.averager import ByzantineAverager, SyncAverager
+from distributedvolunteercomputing_tpu.swarm.averager import (
+    ButterflyAverager,
+    ByzantineAverager,
+    GossipAverager,
+    SyncAverager,
+)
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
 from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
@@ -82,6 +87,8 @@ class TestAveragerFuzz:
     @pytest.mark.parametrize("cls,methods", [
         (SyncAverager, ["sync.contribute", "sync.fetch"]),
         (ByzantineAverager, ["byz.contribute"]),
+        (GossipAverager, ["gossip.exchange"]),
+        (ButterflyAverager, ["bfly.exchange"]),
     ])
     def test_averager_survives_junk_then_averages(self, cls, methods):
         async def main():
@@ -100,6 +107,17 @@ class TestAveragerFuzz:
                 await teardown(vols)
 
         results = run(main())
-        for r in results:
-            assert r is not None
-            np.testing.assert_allclose(r["w"], 0.5, rtol=1e-5)
+        if cls in (SyncAverager, ByzantineAverager):
+            # Consensus modes: every member adopts the weighted mean of
+            # {0.0, 1.0} trees.
+            for r in results:
+                assert r is not None
+                np.testing.assert_allclose(r["w"], 0.5, rtol=1e-5)
+        else:
+            # Pairwise modes (gossip mixes against published state;
+            # butterfly may degrade): at least one member completes a round
+            # post-volley, and nothing non-finite leaks out of the mixes.
+            assert any(r is not None for r in results)
+            for r in results:
+                if r is not None:
+                    assert np.isfinite(np.asarray(r["w"])).all()
