@@ -353,6 +353,21 @@ class Volunteer:
                 if step_no % every == 0:
                     save_async(trainer, ckpt_dir)
 
+        # Heterogeneity injection (test/experiment hook, like
+        # DVC_CHAOS_CONTRIB_SCALE below): DVC_STEP_DELAY_MS=<x> slows THIS
+        # volunteer's step rate by x ms/step — on a shared localhost core,
+        # batch-size spreads don't produce real step-rate skew (per-step
+        # overhead dominates), so heterogeneous-cadence experiments need an
+        # explicit clock. Unset in production.
+        delay_ms = float(os.environ.get("DVC_STEP_DELAY_MS", "0") or 0.0)
+        if delay_ms > 0:
+            prev_on_step = on_step
+
+            def on_step(trainer, step_no, _prev=prev_on_step):  # noqa: F811
+                time.sleep(delay_ms / 1e3)
+                if _prev is not None:
+                    _prev(trainer, step_no)
+
         data = None
         eval_data = None
         if self.cfg.data_path:
@@ -399,10 +414,15 @@ class Volunteer:
             steps_per_call=self.cfg.steps_per_call,
             # The checkpoint cadence lives inside on_step where chunk
             # sizing can't see it — declare it so scan chunks end there.
+            # The step-delay injection hook also sleeps inside on_step, so
+            # scan chunks would dilute it N-fold (and hide it from the
+            # interval-cadence step-time EMA): a cadence of 1 forces
+            # per-step chunks whenever the hook is active.
             chunk_cadences=(
-                (self.cfg.checkpoint_every,)
-                if self.cfg.checkpoint_dir and self.cfg.checkpoint_every > 0
-                else ()
+                ((self.cfg.checkpoint_every,)
+                 if self.cfg.checkpoint_dir and self.cfg.checkpoint_every > 0
+                 else ())
+                + ((1,) if delay_ms > 0 else ())
             ),
             averager=self._averager_callback if self.averager else None,
             average_what=self.cfg.average_what,

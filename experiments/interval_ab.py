@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Heterogeneous-swarm cadence A/B: step-count vs wall-clock rounds.
+
+Two volunteers with REAL step-rate skew — the slow peer carries
+DVC_STEP_DELAY_MS=120 (the heterogeneity injection hook; on a shared
+localhost core batch-size spreads don't skew step rates, per-step overhead
+dominates) — run the same params-mode sync workload twice:
+
+  step      --average-every 40          (the classic cadence)
+  interval  --average-interval-s 4     (absolute wall-clock boundaries)
+
+Under the step cadence the fast peer reaches step multiples far earlier
+each window and the skew GROWS cumulatively (fast finishes its 240 steps
+while the slow peer is mid-run), so later rendezvous miss join_timeout and
+rounds skip. Under the interval cadence both peers cross the same absolute
+boundary within milliseconds for the whole overlap of their runs.
+Records per-arm rounds_ok/skipped and per-peer samples/sec to
+experiments/results/interval_ab.jsonl.
+
+Run: python experiments/interval_ab.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from run_matrix import RESULTS, record, run_swarm  # noqa: E402
+
+MODEL = ["--model", "mnist_mlp", "--model-override", "d_hidden=256"]
+BASE = ["--steps", "240", "--batch-size", "16", "--lr", "0.005",
+        "--join-timeout", "6", "--gather-timeout", "15"]
+SLOW_DELAY_MS = "120"  # slow peer: ~8 steps/s vs the fast peer's ~25+
+
+
+def arm(tag: str, cadence: list) -> dict:
+    common = MODEL + BASE + ["--averaging", "sync", *cadence]
+    rows = run_swarm(
+        f"interval_ab/{tag}",
+        [("fast", common + ["--seed", "0"]),
+         ("slow", common + ["--seed", "1"])],
+        timeout=600,
+        slow_peer=("slow", SLOW_DELAY_MS),
+    )
+    agg = record(f"interval_ab_{tag}", rows)
+    agg["per_peer"] = {
+        pid: {"sps": round(s["samples_per_sec"], 2),
+              "rounds_ok": s["rounds_ok"], "rounds_skipped": s["rounds_skipped"]}
+        for pid, s, _ in rows if s
+    }
+    return agg
+
+
+def main() -> None:
+    results = {
+        "step": arm("step", ["--average-every", "40"]),
+        "interval": arm("interval", ["--average-interval-s", "4"]),
+    }
+    out = os.path.join(RESULTS, "interval_ab.jsonl")
+    with open(out, "w") as fh:
+        for tag, agg in results.items():
+            fh.write(json.dumps({"arm": tag, **agg}) + "\n")
+    for tag, agg in results.items():
+        print(f"interval_ab: {tag:8s} ok {agg['rounds_ok_total']} "
+              f"skipped {agg['rounds_skipped_total']} {agg['per_peer']}")
+
+
+if __name__ == "__main__":
+    main()

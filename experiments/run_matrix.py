@@ -94,30 +94,33 @@ def wait_done(proc, timeout):
     return None, out
 
 
-def run_swarm(name, vol_specs, timeout=600, kill_after=None, chaos_peer=None):
+def run_swarm(
+    name, vol_specs, timeout=600, kill_after=None, chaos_peer=None, slow_peer=None
+):
     """Launch a swarm; vol_specs = [(peer_id, [cli args]), ...].
 
     ``kill_after``: (seconds, peer_index) — SIGKILL that volunteer mid-run
     (the config-5 churn). ``chaos_peer``: (peer_id, scale) — that volunteer
     contributes its tree scaled by ``scale`` (the DVC_CHAOS_CONTRIB_SCALE
-    byzantine fault-injection hook). Returns (peer_id, summary|None, wall_s).
+    byzantine fault-injection hook). ``slow_peer``: (peer_id, delay_ms) —
+    that volunteer's steps are slowed by the DVC_STEP_DELAY_MS heterogeneity
+    hook. Returns (peer_id, summary|None, wall_s).
     """
+
+    def _extra_env(pid):
+        env = {}
+        if chaos_peer and pid == chaos_peer[0]:
+            env["DVC_CHAOS_CONTRIB_SCALE"] = chaos_peer[1]
+        if slow_peer and pid == slow_peer[0]:
+            env["DVC_STEP_DELAY_MS"] = slow_peer[1]
+        return env or None
+
     coord, addr = start_coordinator()
     t0 = time.monotonic()
     rows = []
     try:
         vols = [
-            (
-                pid,
-                start_volunteer(
-                    addr, pid, args,
-                    extra_env=(
-                        {"DVC_CHAOS_CONTRIB_SCALE": chaos_peer[1]}
-                        if chaos_peer and pid == chaos_peer[0]
-                        else None
-                    ),
-                ),
-            )
+            (pid, start_volunteer(addr, pid, args, extra_env=_extra_env(pid)))
             for pid, args in vol_specs
         ]
         if kill_after is not None:
