@@ -39,6 +39,18 @@ class GPT2Config:
     # Time-chunk size for the streamed vocab projection + xent.
     xent_chunk: int = 128
 
+    @classmethod
+    def medium(cls) -> "GPT2Config":
+        """GPT-2 medium (~355M params): the next standard rung above the
+        flagship; with ``--fsdp`` over dp it fits comfortably per chip."""
+        return cls(d_model=1024, n_heads=16, n_layers=24, d_ff=4096)
+
+    @classmethod
+    def large(cls) -> "GPT2Config":
+        """GPT-2 large (~774M params): Adam state pushes past one 16 GB
+        chip in f32 — the regime ZeRO-1/FSDP exist for."""
+        return cls(d_model=1280, n_heads=20, n_layers=36, d_ff=5120)
+
 
 def _layer_init(rng: jax.Array, cfg: GPT2Config) -> common.Params:
     k = jax.random.split(rng, 4)

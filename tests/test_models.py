@@ -255,3 +255,23 @@ def test_full_size_configs_have_expected_scale():
     gpt2 = get_model("gpt2_small")
     n = count_params(gpt2.init(jax.random.PRNGKey(0)))
     assert 110e6 < n < 130e6, f"GPT-2 small should be ~124M params, got {n/1e6:.1f}M"
+
+
+def test_gpt2_presets_have_expected_scale():
+    # Abstract shapes only (jax.eval_shape, the pattern the Llama-7B preset
+    # test uses) — no multi-GB init allocation just to count params.
+    import dataclasses as dc
+
+    from distributedvolunteercomputing_tpu.models.gpt2 import GPT2Config
+
+    def abstract_params(cfg_cls):
+        bundle = get_model("gpt2_small", **dc.asdict(cfg_cls()))
+        shapes = jax.eval_shape(lambda: bundle.init(jax.random.PRNGKey(0)))
+        return sum(
+            int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(shapes)
+        )
+
+    n = abstract_params(GPT2Config.medium)
+    assert 330e6 < n < 380e6, f"GPT-2 medium should be ~355M params, got {n/1e6:.1f}M"
+    n = abstract_params(GPT2Config.large)
+    assert 730e6 < n < 810e6, f"GPT-2 large should be ~774M params, got {n/1e6:.1f}M"
