@@ -1,0 +1,34 @@
+"""Unit tests for the small utils that earned their keep the hard way."""
+
+import asyncio
+
+from distributedvolunteercomputing_tpu.utils.jaxenv import enable_compile_cache
+from distributedvolunteercomputing_tpu.utils.logging import errstr
+
+
+class TestErrstr:
+    def test_empty_message_exceptions_show_type(self):
+        # The round-4 hardware overlap run logged 'averaging at step 90
+        # failed: ' — a bare asyncio.TimeoutError whose str() is "".
+        assert errstr(asyncio.TimeoutError()) == "TimeoutError"
+        assert errstr(asyncio.CancelledError()) == "CancelledError"
+
+    def test_message_exceptions_show_both(self):
+        assert errstr(ValueError("boom")) == "ValueError: boom"
+        assert errstr(OSError("plain")) == "OSError: plain"
+
+
+class TestCompileCache:
+    def test_disabled_off_tpu(self, tmp_path):
+        # The XLA:CPU AOT cache failed machine-feature checks at load and
+        # broke a swarm e2e when enabled unconditionally (see
+        # utils/jaxenv.enable_compile_cache) — off TPU it must no-op.
+        # conftest pins the suite to the CPU backend.
+        assert enable_compile_cache(str(tmp_path / "cache")) is None
+        assert not (tmp_path / "cache").exists() or not any(
+            (tmp_path / "cache").iterdir()
+        )
+
+    def test_empty_env_opts_out(self, monkeypatch):
+        monkeypatch.setenv("DVC_COMPILE_CACHE", "")
+        assert enable_compile_cache() is None
