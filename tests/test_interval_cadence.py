@@ -126,6 +126,38 @@ class TestIntervalRounds:
         assert calls == []
 
 
+class TestStepsSinceMergeOverlap:
+    def test_overlap_merge_anchors_at_launch_step(self):
+        """The overlap path must anchor steps_since_merge at the LAUNCH
+        step (progress up to launch entered the average; the delta term
+        keeps the rest locally) — not at the merge step. Drives the real
+        _finish_overlap_round with fabricated completed futures, the same
+        deterministic pattern as the outer-optimizer overlap test."""
+        import concurrent.futures
+
+        import jax
+        t = make_trainer(
+            averager=lambda p, s: p, overlap=True, average_every=5,
+        )
+        t._last_merge_step = 0
+
+        def finish_with(launch_step, step_no):
+            p0 = jax.tree_util.tree_map(
+                np.asarray, t.bundle.avg_select(t.state.params)
+            )
+            fut = concurrent.futures.Future()
+            fut.set_result((p0, 0.01))
+            t._inflight = (launch_step, p0, fut)
+            t._finish_overlap_round(step_no)
+
+        # Round launched at step 5, merged at step 8 (3 steps in flight):
+        # the NEXT contribution covers steps since LAUNCH (5), not merge.
+        finish_with(5, 8)
+        assert t._last_merge_step == 5
+        t._note_window_progress(12)
+        assert t.steps_since_merge == 7  # 12 - 5, not 12 - 8
+
+
 class TestStepsSinceMerge:
     def test_weight_accumulates_over_failed_rounds(self):
         # Round at step 3 fails (None); the next round's steps_since_merge
