@@ -17,6 +17,10 @@ literature):
                     selection bounds WHO aggregates, the trim bounds each
                     COORDINATE — defends the leeway a single Krum pick
                     leaves in high dimensions
+- centered_clip   — iterative L2-clipped averaging (Karimireddy et al.):
+                    bounds each peer's PULL in L2 per iteration, closing
+                    the spread-over-many-coordinates evasion that
+                    coordinate-wise trims leave open
 
 All run in O(n^2 D) worst case (krum/geomedian) with n = volunteers in the
 round (reference scale: 4, BASELINE.json:2) — cheap next to the WAN transfer
@@ -139,6 +143,49 @@ def bulyan(stack: np.ndarray, n_byzantine: int = 1) -> np.ndarray:
     return np.take_along_axis(chosen, keep, axis=0).mean(axis=0).astype(stack.dtype)
 
 
+def centered_clip(
+    stack: np.ndarray,
+    clip_tau: float = 0.0,
+    iters: int = 5,
+) -> np.ndarray:
+    """CenteredClip (Karimireddy, He, Jaggi 2021, "Learning from History
+    for Byzantine Robust Optimization"): iterate
+        v <- v + mean_i( clip(x_i - v, tau) )
+    where clip rescales each peer's deviation to norm <= tau. Honest
+    contributions near the center pass through untouched; a byzantine row's
+    pull is bounded by tau per iteration REGARDLESS of its magnitude — and
+    unlike coordinate-wise trims, the bound is in L2, so a colluding
+    attacker can't hide a large vector behind many small coordinates.
+
+    ``clip_tau=0`` (the default) self-tunes per iteration to the median
+    peer deviation norm — the scale-free variant: honest radii pass,
+    outliers clip. Starts from the coordinate median (a robust seed rather
+    than the mean, which an unbounded row could drag arbitrarily before
+    the first clip)."""
+    if iters < 1:
+        raise ValueError(f"centered_clip iters must be >= 1, got {iters}")
+    if clip_tau < 0:
+        raise ValueError(f"clip_tau must be >= 0, got {clip_tau}")
+    # Drop non-finite rows FIRST: an inf deviation would clip to scale 0 but
+    # inf * 0 = NaN, and the unclipped mean would adopt it — a single
+    # inf-filled byzantine row must cost its sender influence, not poison
+    # the aggregate (the coordinate-wise estimators survive this input; the
+    # L2 form must too).
+    finite = np.isfinite(stack).all(axis=1)
+    if not finite.all():
+        if not finite.any():
+            return np.zeros(stack.shape[1], stack.dtype)
+        stack = stack[finite]
+    v = np.median(stack, axis=0)
+    for _ in range(iters):
+        dev = stack - v[None, :]
+        norms = np.sqrt((dev * dev).sum(axis=1))
+        tau = clip_tau if clip_tau > 0 else max(float(np.median(norms)), 1e-12)
+        scale = np.minimum(1.0, tau / np.maximum(norms, 1e-12))
+        v = v + (dev * scale[:, None]).mean(axis=0)
+    return v.astype(stack.dtype)
+
+
 AGGREGATORS = {
     "mean": mean,
     "median": coordinate_median,
@@ -146,6 +193,7 @@ AGGREGATORS = {
     "krum": krum,
     "geometric_median": geometric_median,
     "bulyan": bulyan,
+    "centered_clip": centered_clip,
 }
 
 

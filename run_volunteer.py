@@ -99,8 +99,8 @@ def main() -> None:
     ap.add_argument("--min-group", type=int, default=2)
     ap.add_argument("--max-group", type=int, default=16)
     ap.add_argument("--method", default="trimmed_mean",
-                    help="byzantine estimator: "
-                         "trimmed_mean|median|krum|geometric_median|bulyan")
+                    help="byzantine estimator: trimmed_mean|median|krum|"
+                         "geometric_median|bulyan|centered_clip")
     ap.add_argument("--batch-size", type=int, default=32,
                     help="samples per optimizer step (split across --accum-steps)")
     ap.add_argument("--accum-steps", type=int, default=1,

@@ -420,6 +420,26 @@ class TestByzantine:
             assert r is not None
             assert 0.9 < float(r["w"].mean()) < 1.1
 
+    def test_centered_clip_method(self):
+        async def main():
+            vols = await spawn_volunteers(
+                4, ByzantineAverager, min_group=4, method="centered_clip"
+            )
+            try:
+                return await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 1),
+                    vols[1][3].average(make_tree(1.01), 1),
+                    vols[2][3].average(make_tree(0.99), 1),
+                    vols[3][3].average(make_tree(1e9), 1),  # unbounded attacker
+                )
+            finally:
+                await teardown(vols)
+
+        results = run(main())
+        for r in results[:3]:
+            assert r is not None
+            assert 0.9 < float(r["w"].mean()) < 1.1
+
     def test_bulyan_method_at_guarantee_scale(self):
         """Bulyan through the full-mesh averager at n=7 (= 4f+3 for f=1):
         six honest peers near 1.0 and one attacker at 500 — every honest
