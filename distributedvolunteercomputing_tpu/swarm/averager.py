@@ -354,7 +354,9 @@ class AveragerBase:
             from distributedvolunteercomputing_tpu.swarm import powersgd
 
             wire = self._psgd().encode(buf)
-            sent = powersgd.decode(wire)
+            # Own round-trip: the exact size is known — don't let the
+            # anti-abuse default cap reject a legitimately huge model.
+            sent = powersgd.decode(wire, max_floats=buf.size)
         else:
             wire = native.topk_encode(buf, frac=self._effective_topk_frac())
             sent = native.topk_decode(wire)
@@ -406,10 +408,16 @@ class AveragerBase:
         if self.wire == "powersgd":
             # Self-describing container (low-rank contributions AND dense
             # results); needs no codec state, so early pushes that arrive
-            # before this node's first pack decode fine.
+            # before this node's first pack decode fine. Once the schema is
+            # known, the decode is capped at EXACTLY the expected size — a
+            # low-rank entry expands (n+m)*r wire floats to n*m, so without
+            # the cap a few-KB container could buy a multi-GB allocation.
             from distributedvolunteercomputing_tpu.swarm import powersgd
 
-            return powersgd.decode(payload)
+            limit = powersgd.MAX_DECODE_FLOATS
+            if self._specs is not None:
+                limit = sum(s.size for s in self._specs)
+            return powersgd.decode(payload, max_floats=limit)
         return np.frombuffer(payload, np.float32).copy()
 
     # -- off-loop wrappers for payload-sized work --------------------------

@@ -176,12 +176,34 @@ def _parse_entries(payload: bytes) -> List[Tuple[int, tuple]]:
     return out
 
 
-def decode(payload: bytes) -> np.ndarray:
+# Absolute reconstruction ceiling when the receiver doesn't yet know its
+# schema (early pushes before the first pack): 2^29 floats = 2 GiB, matching
+# the transport's MAX_PAYLOAD for a dense f32 frame. A low-rank entry
+# RECONSTRUCTS to n*m floats from only (n+m)*r on the wire, so without a cap
+# a 400 KB container declaring n=m=50000 would allocate 10 GB on decode.
+MAX_DECODE_FLOATS = 1 << 29
+
+
+def decode(payload: bytes, max_floats: int = MAX_DECODE_FLOATS) -> np.ndarray:
     """Reconstruct the flat f32 buffer. Self-describing: no specs needed,
     so receivers can decode contributions that arrive before their own
-    first pack (the averager accepts early pushes by design)."""
+    first pack (the averager accepts early pushes by design).
+
+    ``max_floats`` bounds the TOTAL reconstruction size — callers that know
+    their schema pass the exact expected size, so an attacker can't buy a
+    multi-GB allocation with a few-KB container (low-rank entries expand
+    (n+m)*r wire floats into n*m)."""
+    entries = _parse_entries(payload)
+    total = 0
+    for kind, data in entries:
+        total += data[0].size if kind == _DENSE else data[0] * data[1]
+        if total > max_floats:
+            raise ValueError(
+                f"powersgd payload reconstructs to >{max_floats} floats "
+                f"(resource-exhaustion guard)"
+            )
     out: List[np.ndarray] = []
-    for kind, data in _parse_entries(payload):
+    for kind, data in entries:
         if kind == _DENSE:
             out.append(data[0].copy())
         else:

@@ -127,6 +127,28 @@ class TestCodec:
             with pytest.raises(ValueError):
                 powersgd.decode(wire[:cut])
 
+    def test_decode_caps_reconstruction_size(self):
+        # A few-KB container declaring a huge low-rank entry must not buy a
+        # multi-GB allocation: (n+m)*r wire floats expand to n*m on decode.
+        import struct
+
+        n = m = 50_000
+        p = np.zeros((n, 1), np.float32)
+        q = np.zeros((m, 1), np.float32)
+        payload = b"".join([
+            powersgd.MAGIC, struct.pack("<I", 1),
+            struct.pack("<BIIH", 1, n, m, 1), p.tobytes(), q.tobytes(),
+        ])
+        with pytest.raises(ValueError, match="resource-exhaustion"):
+            powersgd.decode(payload, max_floats=1 << 20)
+        # And the schema-exact cap refuses anything bigger than expected.
+        rng = np.random.default_rng(8)
+        buf, specs, _ = flatten_to_buffer(psgd_tree(rng=rng))
+        wire = powersgd.PowerSGDCodec(specs, rank=2).encode(buf)
+        assert powersgd.decode(wire, max_floats=buf.size).size == buf.size
+        with pytest.raises(ValueError, match="resource-exhaustion"):
+            powersgd.decode(wire, max_floats=buf.size - 1)
+
     def test_rank_validation(self):
         with pytest.raises(ValueError):
             powersgd.PowerSGDCodec([], rank=0)
