@@ -1101,11 +1101,25 @@ class ByzantineAverager(AveragerBase):
         if self.method == "mean":
             kw["weights"] = np.array([received[p][0] for p in peers])
         elif self.method == "trimmed_mean":
-            # trim 1/4 of peers per side when the group is big enough;
-            # trim=0 degrades gracefully to the plain mean.
-            trim = kw.setdefault("trim", len(peers) // 4)
-            if trim * 2 >= len(peers):
-                kw["trim"] = 0
+            if "trim" in kw:
+                # EXPLICIT operator setting: never silently zero it (that
+                # would be an unprotected mean wearing byzantine's name) —
+                # clamp to the most robustness this round's group size
+                # allows, and say so.
+                trim = int(kw["trim"])
+                if trim * 2 >= len(peers):
+                    feasible = (len(peers) - 1) // 2
+                    log.warning(
+                        "trimmed_mean trim=%d infeasible for %d peers; "
+                        "clamping to %d this round", trim, len(peers), feasible,
+                    )
+                    kw["trim"] = feasible
+            else:
+                # Derived default: trim 1/4 of peers per side when the group
+                # is big enough; trim=0 degrades gracefully to the mean.
+                trim = kw.setdefault("trim", len(peers) // 4)
+                if trim * 2 >= len(peers):
+                    kw["trim"] = 0
         self.rounds_ok += 1
         if not degraded:
             self._observe_round_time(time.monotonic() - t0)

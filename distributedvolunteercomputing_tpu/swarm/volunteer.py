@@ -102,6 +102,10 @@ class VolunteerConfig:
     join_timeout: float = 10.0
     gather_timeout: float = 20.0
     method: str = "mean"  # robust aggregation estimator for byzantine mode
+    # Estimator keyword overrides (krum/bulyan n_byzantine, trimmed_mean
+    # trim, centered_clip clip_tau/iters, ...) — passed straight through to
+    # ops/robust.aggregate. None = each estimator's defaults.
+    method_kw: Optional[Dict[str, Any]] = None
     # Adaptive round deadlines (EWMA of successful rounds; see AveragerBase):
     # a dead peer costs seconds instead of the full gather budget.
     adaptive_timeout: bool = False
@@ -147,6 +151,24 @@ class VolunteerConfig:
                 )
             if self.averaging == "none":
                 raise ValueError("--average-interval-s requires an averaging mode")
+        if self.method_kw:
+            # Fail at config time, not per round: an unknown kwarg would
+            # raise inside every averaging round, be swallowed by the
+            # round-failure containment, and leave the volunteer training
+            # solo forever with only warnings in the log.
+            import inspect
+
+            from distributedvolunteercomputing_tpu.ops import robust
+
+            fn = robust.AGGREGATORS.get(self.method)
+            if fn is not None:
+                allowed = set(inspect.signature(fn).parameters) - {"stack", "weights"}
+                unknown = set(self.method_kw) - allowed
+                if unknown:
+                    raise ValueError(
+                        f"--method-kw keys {sorted(unknown)} are not accepted "
+                        f"by method {self.method!r} (accepts: {sorted(allowed)})"
+                    )
         if self.outer_optimizer != "none":
             if self.average_what != "params":
                 raise ValueError("--outer-optimizer requires --average-what params")
@@ -330,6 +352,8 @@ class Volunteer:
                 # ByzantineAverager defaults to trimmed_mean, which the topk
                 # wire (validated in __post_init__) must not run under.
                 kw["method"] = self.cfg.method
+            if self.cfg.method_kw:
+                kw["method_kw"] = dict(self.cfg.method_kw)
             # Namespace rounds by model AND by what is averaged: a grads-mode
             # peer must never rendezvous with a params-mode peer on the same
             # model — averaging a gradient tree against a parameter tree

@@ -101,6 +101,11 @@ def main() -> None:
     ap.add_argument("--method", default="trimmed_mean",
                     help="byzantine estimator: trimmed_mean|median|krum|"
                          "geometric_median|bulyan|centered_clip")
+    ap.add_argument("--method-kw", action="append", default=[],
+                    help="estimator keyword override, key=value (repeatable; "
+                         "values JSON-parsed) — e.g. --method-kw n_byzantine=2 "
+                         "for krum/bulyan, --method-kw trim=2, "
+                         "--method-kw clip_tau=0.5")
     ap.add_argument("--batch-size", type=int, default=32,
                     help="samples per optimizer step (split across --accum-steps)")
     ap.add_argument("--accum-steps", type=int, default=1,
@@ -169,6 +174,14 @@ def main() -> None:
             print(name)
         return
 
+    method_kw = {}
+    for kv in args.method_kw:
+        k, v = kv.split("=", 1)
+        try:
+            method_kw[k] = json.loads(v)
+        except json.JSONDecodeError:
+            method_kw[k] = v
+
     overrides = {}
     for kv in args.model_override:
         k, v = kv.split("=", 1)
@@ -200,6 +213,7 @@ def main() -> None:
         min_group=args.min_group,
         max_group=args.max_group,
         method=args.method,
+        method_kw=method_kw or None,
         batch_size=args.batch_size,
         accum_steps=args.accum_steps,
         mesh=args.mesh,

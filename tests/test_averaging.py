@@ -885,3 +885,32 @@ class TestButterflyStageCap:
                 await t.close()
 
         run(main())
+
+
+class TestExplicitTrimClamp:
+    def test_explicit_trim_clamps_instead_of_zeroing(self):
+        """An operator's explicit trim must never be silently replaced by 0
+        (an unprotected mean) when the round's group is small — it clamps to
+        the most robustness the group allows. trim=2 at n=4 -> effective 1:
+        a single attacker is still rejected."""
+
+        async def main():
+            vols = await spawn_volunteers(
+                4, ByzantineAverager, min_group=4,
+                method="trimmed_mean", method_kw={"trim": 2},
+            )
+            try:
+                return await asyncio.gather(
+                    vols[0][3].average(make_tree(0.9), 1),
+                    vols[1][3].average(make_tree(1.0), 1),
+                    vols[2][3].average(make_tree(1.1), 1),
+                    vols[3][3].average(make_tree(1e9), 1),  # attacker
+                )
+            finally:
+                await teardown(vols)
+
+        results = run(main())
+        for r in results[:3]:
+            assert r is not None
+            # With the old silent trim=0, the 1e9 row makes the mean ~2.5e8.
+            assert float(np.abs(r["w"]).max()) < 10.0

@@ -175,3 +175,22 @@ class TestStepsSinceMerge:
         assert seen[0] == (3, 3)  # first round: one window
         assert seen[1] == (6, 6)  # failed round's progress accumulated
         assert seen[2] == (9, 3)  # merged at 6: back to one window
+
+
+class TestMethodKw:
+    def test_unknown_keys_rejected_at_config_time(self):
+        # A typo'd estimator kwarg must fail at startup, not raise inside
+        # every round and get swallowed by the round-failure containment
+        # (volunteer would train solo forever).
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        with pytest.raises(ValueError, match="method-kw"):
+            VolunteerConfig(
+                coordinator="x:1", averaging="byzantine",
+                method="trimmed_mean", method_kw={"n_byzantine": 1},
+            )
+        cfg = VolunteerConfig(
+            coordinator="x:1", averaging="byzantine",
+            method="krum", method_kw={"n_byzantine": 2},
+        )
+        assert cfg.method_kw == {"n_byzantine": 2}
