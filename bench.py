@@ -76,6 +76,7 @@ def _ratchet_key(
     dtype_key: str,
     remat_tag: str,
     spc: str = "1",
+    accum: str = "1",
 ) -> str:
     """One record PER full configuration — shared by the live path and the
     recorded-probe fallback so the two can never drift apart silently (a
@@ -87,6 +88,8 @@ def _ratchet_key(
     key = f"{model_name}{metric_suffix}|bs{batch_size}|{dtype_key}|remat-{remat_tag}"
     if spc != "1":
         key += f"|spc{spc}"
+    if accum != "1":
+        key += f"|accum{accum}"
     return key
 
 
@@ -316,6 +319,9 @@ def _recorded_probe(model_name: str) -> dict | None:
         os.environ.get("DVC_BENCH_MODEL_KW")
         or os.environ.get("DVC_BENCH_PARAM_DTYPE")
         or os.environ.get("DVC_BENCH_REMAT") == "0"
+        or os.environ.get("DVC_BENCH_ACCUM", "1") not in ("", "1")
+        or os.environ.get("DVC_BENCH_STEPS_PER_CALL", "1") not in ("", "1")
+        or os.environ.get("DVC_ATTN_IMPL", "auto") not in ("", "auto")
     ):
         return None
     batch_size = int(os.environ.get("DVC_BENCH_BATCH", "8"))
@@ -470,6 +476,15 @@ def _bench_main() -> int:
     retries = max(int(os.environ.get("DVC_BENCH_INIT_RETRIES", "3")), 1)
     base_delay = float(os.environ.get("DVC_BENCH_INIT_BACKOFF", "5"))
     param_dtype = os.environ.get("DVC_BENCH_PARAM_DTYPE", "")
+    # Gradient accumulation (DVC_BENCH_ACCUM=N): effective batch is
+    # batch_size*N, but every compiled matmul stays at micro-batch size —
+    # the route to larger effective batches on a tunnel that 500s on the
+    # bigger HLO of a direct bs=16/32 compile (BASELINE.md r4 TPU notes).
+    # Same math as a large batch up to summation order, so it is disclosed
+    # in the payload (accum_steps) and joins the ratchet key, but the
+    # metric remains samples/sec at the EFFECTIVE batch.
+    accum = max(int(os.environ.get("DVC_BENCH_ACCUM") or "1"), 1)
+    eff_batch = batch_size * accum
     # Optional model-config overrides ("k=v,k=v", ints auto-cast). Any use is
     # disclosed in the metric name — a shrunken config is a different metric.
     model_kw: dict = {}
@@ -485,7 +500,8 @@ def _bench_main() -> int:
     # schedule (recompute vs store activations), not the model or numerics,
     # so it stays out of the metric name unlike DVC_BENCH_MODEL_KW.
     if os.environ.get("DVC_BENCH_REMAT") == "0" and model_name in (
-        "gpt2_small", "gpt2_moe", "bert_mlm", "llama_lora",
+        "gpt2_small", "gpt2_medium", "gpt2_large", "gpt2_moe", "bert_mlm",
+        "llama_lora",
     ):  # models with a remat knob; others would fail at model_build
         model_kw.setdefault("remat", False)
     metric_suffix = f", {kw_env}" if kw_env else ""
@@ -615,8 +631,8 @@ def _bench_main() -> int:
         stage = "opt_init"
         state = TrainState.create(params, tx, jax.random.PRNGKey(2))
         del params  # donated into state's first step
-        step = make_train_step(bundle.loss_fn, tx)
-        batch = bundle.make_batch(jax.random.PRNGKey(0), batch_size)
+        step = make_train_step(bundle.loss_fn, tx, accum_steps=accum)
+        batch = bundle.make_batch(jax.random.PRNGKey(0), eff_batch)
 
         progress(f"state built ({n_params / 1e6:.1f}M params); compiling")
         stage = "warmup"
@@ -633,13 +649,13 @@ def _bench_main() -> int:
         # traced body, so the metric is unchanged; only dispatch granularity
         # moves). Measures what the volunteer's --steps-per-call buys on
         # this runtime.
-        spc = int(os.environ.get("DVC_BENCH_STEPS_PER_CALL", "1"))
+        spc = int(os.environ.get("DVC_BENCH_STEPS_PER_CALL") or "1")
         multi = None
         if spc > 1:
             from distributedvolunteercomputing_tpu.training.steps import make_multi_step
 
             stage = "multi_compile"
-            multi = make_multi_step(bundle.loss_fn, tx)
+            multi = make_multi_step(bundle.loss_fn, tx, accum_steps=accum)
             stacked = jax.tree_util.tree_map(
                 lambda x: jnp.stack([x] * spc), batch
             )
@@ -671,7 +687,7 @@ def _bench_main() -> int:
     # The single-volunteer step runs on the default device only; divide by the
     # devices the computation actually uses, not everything visible.
     n_chips = len(m["loss"].sharding.device_set)
-    samples_per_sec_chip = batch_size * iters / dt_s / n_chips
+    samples_per_sec_chip = eff_batch * iters / dt_s / n_chips
 
     baseline_path = _ratchet_path()
     vs_baseline = 1.0
@@ -688,7 +704,8 @@ def _bench_main() -> int:
     # so sharing a record would report phantom perf deltas across rungs.
     remat_tag = "off" if model_kw.get("remat") is False else "on"
     model_key = _ratchet_key(
-        model_name, metric_suffix, batch_size, dtype_key, remat_tag, str(spc)
+        model_name, metric_suffix, batch_size, dtype_key, remat_tag, str(spc),
+        str(accum),
     )
     rec = prior.get(model_key)
     if isinstance(rec, dict) and rec.get("value"):
@@ -702,12 +719,12 @@ def _bench_main() -> int:
             pass
 
     payload = {
-        "metric": f"samples/sec/volunteer-chip ({model_name}{metric_suffix}, bs={batch_size})",
+        "metric": f"samples/sec/volunteer-chip ({model_name}{metric_suffix}, bs={eff_batch})",
         "value": round(samples_per_sec_chip, 3),
         "unit": "samples/sec/chip",
         "status": "live",  # vs "recorded" (watcher-probe replay fallback)
         "vs_baseline": round(vs_baseline, 4),
-        "batch_size": batch_size,
+        "batch_size": eff_batch,
         "n_chips": n_chips,
         "device_kind": devs[0].device_kind,
         "loss": round(final_loss, 4),
@@ -718,6 +735,9 @@ def _bench_main() -> int:
     }
     if spc > 1:
         payload["steps_per_call"] = spc  # dispatch granularity, not math
+    if accum > 1:
+        payload["accum_steps"] = accum  # micro-batches per step
+        payload["micro_batch"] = batch_size
     seq_len = getattr(bundle.config, "max_len", None)
     if seq_len:
         tokens_per_sec = samples_per_sec_chip * seq_len

@@ -105,6 +105,27 @@ def _gpt2(**overrides: Any) -> ModelBundle:
     )
 
 
+def _gpt2_preset(preset: str, **overrides: Any) -> ModelBundle:
+    """gpt2_medium / gpt2_large as first-class registry names: the scale
+    rungs above the flagship (GPT2Config.medium/.large presets), nameable
+    from the CLI (--model) and the bench (DVC_BENCH_MODEL) without a
+    config-override incantation. Overrides still apply on top."""
+    from distributedvolunteercomputing_tpu.models import gpt2
+    from distributedvolunteercomputing_tpu.training import data
+
+    base = getattr(gpt2.GPT2Config, preset)()
+    cfg = dataclasses.replace(base, **overrides)
+    return ModelBundle(
+        name=f"gpt2_{preset}",
+        config=cfg,
+        init=lambda rng: gpt2.init(rng, cfg),
+        loss_fn=lambda p, b, rng: gpt2.loss_fn(p, b, rng, cfg),
+        make_batch=lambda rng, bs: data.synthetic_lm_batch(
+            rng, bs, seq_len=cfg.max_len, vocab=cfg.vocab
+        ),
+    )
+
+
 def _gpt2_moe(**overrides: Any) -> ModelBundle:
     from distributedvolunteercomputing_tpu.models import moe
     from distributedvolunteercomputing_tpu.training import data
@@ -164,6 +185,8 @@ _REGISTRY: Dict[str, Callable[..., ModelBundle]] = {
     "cifar10_vit": _vit,
     "bert_mlm": _bert,
     "gpt2_small": _gpt2,
+    "gpt2_medium": lambda **kw: _gpt2_preset("medium", **kw),
+    "gpt2_large": lambda **kw: _gpt2_preset("large", **kw),
     "gpt2_moe": _gpt2_moe,
     "llama_lora": _llama_lora,
 }

@@ -1,0 +1,89 @@
+"""Capture ONE jax.profiler trace of the flagship step on the real chip.
+
+VERDICT r4 weak #3: est_mfu 0.23 has no committed evidence of WHERE the
+remaining time goes — no profiler trace from the chip exists. This script
+runs a handful of gpt2_small steps (bench shapes, remat off — the fastest
+schedule, i.e. the one the headline number uses) inside a
+``jax.profiler.trace`` window and saves the trace to
+``experiments/results/trace/``; a summary JSON with the trace dir listing is
+written to ``results/chip_trace.json`` so the watcher can done-marker it.
+
+The profiler may not work over the tunneled axon runtime (device-side TPU
+profiling needs the libtpu profiler plugin on the far side); this script is
+deliberately cheap and runs LATE in the window agenda so a hang here costs
+nothing that matters. Even a host-only trace still attributes dispatch gaps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+R = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+TRACE_DIR = os.path.join(R, "trace")
+
+
+def main() -> int:
+    t0 = time.time()
+    import jax
+
+    devs = jax.devices()
+    print(f"[{time.time() - t0:5.1f}s] backend up: {devs[0].device_kind}", flush=True)
+
+    from distributedvolunteercomputing_tpu.models import get_model
+    from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+    from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+
+    # Knobs exist so the script's trace plumbing is verifiable on CPU (where
+    # a gpt2_small f32 step takes tens of seconds); the TPU default is the
+    # flagship bench config.
+    model_name = os.environ.get("DVC_TRACE_MODEL", "gpt2_small")
+    n_steps = int(os.environ.get("DVC_TRACE_STEPS", "8"))
+    kw = {"remat": False} if model_name.startswith("gpt2") else {}
+    b = get_model(model_name, **kw)
+    tx = make_optimizer("adamw", lr=1e-4)
+    params = b.init(jax.random.PRNGKey(1))
+    st = TrainState.create(params, tx, jax.random.PRNGKey(2))
+    del params
+    step = make_train_step(b.loss_fn, tx)
+    batch = b.make_batch(jax.random.PRNGKey(0), 8)
+    for _ in range(3):  # compile + settle outside the trace window
+        st, m = step(st, batch)
+    float(m["loss"])
+    print(f"[{time.time() - t0:5.1f}s] warm; tracing {n_steps} steps", flush=True)
+
+    os.makedirs(TRACE_DIR, exist_ok=True)
+    with jax.profiler.trace(TRACE_DIR):
+        for _ in range(n_steps):
+            st, m = step(st, batch)
+        float(m["loss"])  # materialize INSIDE the window: chained scalar
+        # fetch is the only op observed to synchronize this runtime
+        # (experiments/timing_diag.py).
+
+    files = []
+    for root, _dirs, names in os.walk(TRACE_DIR):
+        for n in names:
+            p = os.path.join(root, n)
+            if os.path.getmtime(p) < t0:
+                continue  # stale entry from a previous trace run, not ours
+            files.append({"path": os.path.relpath(p, R), "bytes": os.path.getsize(p)})
+    payload = {
+        "device_kind": devs[0].device_kind,
+        "model": model_name,
+        "traced_steps": n_steps,
+        "files": files,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    with open(os.path.join(R, "chip_trace.json"), "w") as fh:
+        json.dump(payload, fh, indent=1)
+    print(json.dumps(payload)[:400], flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
