@@ -349,12 +349,24 @@ def topk_encode(arr: np.ndarray, frac: float | None = None) -> bytes:
     return header + idx.tobytes() + arr[idx].tobytes()
 
 
-def topk_decode(payload: bytes) -> np.ndarray:
+# Decode-allocation ceiling when the caller has no schema to bound by:
+# 2^29 f32 = the 2 GiB transport MAX_PAYLOAD expressed in floats. A sparse
+# frame's uint64 n is attacker-controlled (a ~100-byte frame can claim any
+# n), so the dense reconstruction must never exceed what a dense payload of
+# the transport's own cap could have shipped.
+TOPK_MAX_DECODE_FLOATS = 1 << 29
+
+
+def topk_decode(
+    payload: bytes, max_floats: int = TOPK_MAX_DECODE_FLOATS
+) -> np.ndarray:
     """Inverse of topk_encode: dense f32 with zeros off-support."""
     if len(payload) < _TOPK_HDR or payload[:3] != _TOPK_MAGIC:
         raise ValueError("topk payload: bad header")
     mode = payload[3]
     n = int(np.frombuffer(payload[4:12], np.uint64)[0])
+    if n > max_floats:
+        raise ValueError(f"topk payload: n={n} exceeds decode cap {max_floats}")
     body = payload[_TOPK_HDR:]
     if mode == _TOPK_DENSE:
         if len(body) != 4 * n:

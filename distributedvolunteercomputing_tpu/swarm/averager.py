@@ -152,6 +152,10 @@ class AveragerBase:
         # failed round (the trainer falls back to its raw local grad).
         self._ef_residual: Optional[np.ndarray] = None
         self._ef_pending: Optional[np.ndarray] = None
+        # Checkpointed compressor state (EF residual + PowerSGD warm Q)
+        # waiting for the first _pack, which fixes the specs it is
+        # validated against. See wire_state()/load_wire_state().
+        self._pending_wire_state: Optional[dict] = None
         # Whether the last round's contribution actually entered the
         # aggregate (sync members learn this from fetch meta; see average()).
         self._contribution_included = True
@@ -288,10 +292,81 @@ class AveragerBase:
                     [(s.shape, s.dtype) for s in specs] + [wire_tag, self.namespace]
                 ).encode()
             ).hexdigest()[:16]
+        self._apply_pending_wire_state()
         return buf
 
     def _unpack(self, buf: np.ndarray) -> Any:
         return unflatten_from_buffer(buf, self._specs, self._treedef)
+
+    # -- checkpointable compressor state -----------------------------------
+    # A preempted volunteer on a lossy wire used to rejoin COLD: the
+    # error-feedback residual (gradient mass owed to the swarm) and
+    # PowerSGD's warm Q factors (which buy the power iteration its accuracy)
+    # both lived only in process memory (r4 VERDICT #7; the outer-state
+    # sidecar in training/checkpoint.py is the same pattern for the same
+    # reason). wire_state() is read on the checkpoint thread while rounds
+    # may be in flight — safe because every array in play is REPLACED
+    # wholesale (new object assignment), never mutated in place, so a copy
+    # taken here is a consistent value from some recent round per tensor.
+
+    def wire_state(self) -> Optional[dict]:
+        """Compressor state worth persisting, as a flat npz-able dict, or
+        None when there is nothing learned yet (dense wires, or no round
+        has run)."""
+        if self.wire not in ("topk", "powersgd"):
+            return None
+        out: dict = {"wire": np.bytes_(self.wire.encode())}
+        ef = self._ef_residual
+        if ef is not None:
+            out["ef"] = ef.copy()
+        codec = self._psgd_codec
+        if codec is not None and codec._warm_q:
+            out["rank"] = np.int64(codec.rank)
+            for idx, q in list(codec._warm_q.items()):
+                out[f"q_{idx}"] = q.copy()
+        return out if len(out) > 1 else None
+
+    def load_wire_state(self, d: dict) -> None:
+        """Adopt checkpointed compressor state. Parked until the first
+        ``_pack``: sizes/shapes can only be validated against the specs,
+        and a mismatch (different model, different rank) silently re-seeds
+        — the documented cold-start semantics, same policy as the
+        outer-state sidecar."""
+        self._pending_wire_state = {k: v for k, v in d.items()}
+        if self._specs is not None:
+            self._apply_pending_wire_state()
+
+    def _apply_pending_wire_state(self) -> None:
+        d, self._pending_wire_state = self._pending_wire_state, None
+        if d is None:
+            return
+        wire = d.get("wire")
+        if wire is not None:
+            wire = np.asarray(wire).item()  # npz round-trips scalars as 0-d
+            if isinstance(wire, bytes):
+                wire = wire.decode()
+        if wire != self.wire:
+            log.warning("wire-state is for wire=%s, not %s; re-seeding", wire, self.wire)
+            return
+        total = sum(s.size for s in self._specs)
+        ef = d.get("ef")
+        if ef is not None:
+            if ef.size == total:
+                self._ef_residual = np.asarray(ef, np.float32).reshape(-1).copy()
+            else:
+                log.warning(
+                    "EF residual size %d != schema %d; re-seeding", ef.size, total
+                )
+        if self.wire == "powersgd" and int(d.get("rank", -1)) == self.powersgd_rank:
+            codec = self._psgd()
+            for k, v in d.items():
+                if not k.startswith("q_"):
+                    continue
+                idx = int(k[2:])
+                if idx < len(codec.plan) and codec.plan[idx][2] is not None:
+                    _, m, r = codec.plan[idx][2]
+                    if v.shape == (m, r):
+                        codec._warm_q[idx] = np.asarray(v, np.float32).copy()
 
     def _check_schema(self, args: dict) -> bool:
         # Before our first pack we don't know the schema yet — accept and let
@@ -359,7 +434,9 @@ class AveragerBase:
             sent = powersgd.decode(wire, max_floats=buf.size)
         else:
             wire = native.topk_encode(buf, frac=self._effective_topk_frac())
-            sent = native.topk_decode(wire)
+            # Own round-trip: exact size known — same anti-abuse-cap
+            # exemption as the powersgd branch above.
+            sent = native.topk_decode(wire, max_floats=buf.size)
         self._ef_pending = buf - sent
         return wire, lambda: sent
 
@@ -392,32 +469,47 @@ class AveragerBase:
         if self.wire == "q8":
             return native.q8_decode(native.q8_encode(buf))
         if self.wire == "topk":
-            return native.topk_decode(native.topk_encode(buf))
+            return native.topk_decode(native.topk_encode(buf), max_floats=buf.size)
         # powersgd: pairwise modes are refused at construction; the only
         # non-contribution sends are dense-container results, an exact
         # round-trip — so the raw buffer IS the as-peers-see-it view.
         return buf
 
-    def _buf_from_payload(self, payload: bytes) -> np.ndarray:
+    def _buf_from_payload(self, payload: bytes) -> Optional[np.ndarray]:
         if self.wire == "bf16":
             return native.bf16_to_f32(np.frombuffer(payload, np.uint16))
         if self.wire == "q8":
             return native.q8_decode(payload)
         if self.wire == "topk":
-            return native.topk_decode(payload)
+            # Same deferral story as powersgd below: the sparse header's n is
+            # sender-controlled, so pre-schema the decode is unbounded —
+            # park raw and resolve at aggregation; post-schema, cap at the
+            # exact expected size.
+            if self._specs is None:
+                return None
+            return native.topk_decode(
+                payload, max_floats=sum(s.size for s in self._specs)
+            )
         if self.wire == "powersgd":
             # Self-describing container (low-rank contributions AND dense
-            # results); needs no codec state, so early pushes that arrive
-            # before this node's first pack decode fine. Once the schema is
-            # known, the decode is capped at EXACTLY the expected size — a
-            # low-rank entry expands (n+m)*r wire floats to n*m, so without
-            # the cap a few-KB container could buy a multi-GB allocation.
+            # results). The decode is capped at EXACTLY the expected size —
+            # a low-rank entry expands (n+m)*r wire floats to n*m, so
+            # without the cap a few-KB container could buy a multi-GB
+            # allocation. Before our first _pack the expected size is
+            # unknown and no generic cap is safe (r4 advisor: 64 parked
+            # contribs x 32 rounds x 2 GiB decodes = multi-TiB amplification
+            # from MBs of attacker bandwidth) — so pre-schema pushes are NOT
+            # decoded here: return the deferred sentinel, park the raw
+            # payload (memory then costs the attacker its own bandwidth,
+            # bounded by transport MAX_PAYLOAD), and decode at aggregation
+            # time when specs exist (see _decode_deferred).
+            if self._specs is None:
+                return None
             from distributedvolunteercomputing_tpu.swarm import powersgd
 
-            limit = powersgd.MAX_DECODE_FLOATS
-            if self._specs is not None:
-                limit = sum(s.size for s in self._specs)
-            return powersgd.decode(payload, max_floats=limit)
+            return powersgd.decode(
+                payload, max_floats=sum(s.size for s in self._specs)
+            )
         return np.frombuffer(payload, np.float32).copy()
 
     # -- off-loop wrappers for payload-sized work --------------------------
@@ -441,8 +533,32 @@ class AveragerBase:
 
         return await asyncio.to_thread(work)
 
-    async def _decode_payload(self, payload: bytes) -> np.ndarray:
+    async def _decode_payload(self, payload: bytes) -> Optional[np.ndarray]:
         return await asyncio.to_thread(self._buf_from_payload, payload)
+
+    async def _decode_deferred(self, st: "_Round") -> None:
+        """Decode contributions parked BEFORE this node's first ``_pack``
+        (powersgd only: ``_buf_from_payload`` defers pre-schema decodes and
+        the contribute handlers park the raw payload instead). Runs on the
+        aggregation path, where specs are guaranteed — the caller just
+        packed its own contribution — so every decode is capped at exactly
+        the expected dense size. Entries whose payload is missing or fails
+        to decode are dropped, the same fate a size-mismatched buffer meets
+        at aggregation."""
+        deferred = [k for k, c in st.contribs.items() if c[1] is None]
+        for k in deferred:
+            pl = st.payloads.get(k)
+            buf = None
+            if pl is not None:
+                try:
+                    buf = await self._decode_payload(pl)
+                except (ValueError, RPCError):
+                    buf = None
+            if buf is None:
+                st.contribs.pop(k, None)
+                st.payloads.pop(k, None)
+            elif k in st.contribs:  # re-check: handlers ran during decode
+                st.contribs[k] = (st.contribs[k][0], buf)
 
     async def _encode_wire(self, buf: np.ndarray) -> bytes:
         return await asyncio.to_thread(self._to_wire, buf)
@@ -501,11 +617,13 @@ class SyncAverager(AveragerBase):
             if len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
                 raise RPCError("round contribution cap reached")
             st.contribs[key] = (float(args["weight"]), buf)
-            if self.wire == "powersgd" and self.method == "mean":
-                # Keep the compressed form too: the leader serves the round
-                # result as the exact factored mean of these (see _Round).
-                # Robust methods never merge factored (nonlinear), so they
-                # don't pay the retention.
+            if (self.wire == "powersgd" and self.method == "mean") or buf is None:
+                # Keep the compressed form too: for powersgd+mean the leader
+                # serves the round result as the exact factored mean of
+                # these (see _Round); for a pre-schema deferred decode
+                # (buf None — powersgd or topk) the raw payload IS the
+                # contribution until _decode_deferred resolves it at
+                # aggregation time.
                 st.payloads[key] = payload
         if st.expected:
             valid = {
@@ -609,6 +727,9 @@ class SyncAverager(AveragerBase):
                 await asyncio.wait_for(st.full.wait(), timeout=self.effective_gather_timeout)
             except asyncio.TimeoutError:
                 self._round_degraded = True  # subset aggregate: not an observation
+            # Resolve pre-schema-parked powersgd payloads now that our own
+            # pack fixed the specs (exact-size-capped decode).
+            await self._decode_deferred(st)
             # Drop contributions whose buffer doesn't match ours (model
             # mismatch that slipped past the early-accept schema check) or
             # whose token isn't the secret WE issued to that member at begin
@@ -616,7 +737,10 @@ class SyncAverager(AveragerBase):
             good = {
                 p: c
                 for (p, t), c in st.contribs.items()
-                if c[1].size == buf.size and tokens.get(p) == t
+                # c[1] None: a pre-schema deferred entry whose payload a
+                # straggler handler parked DURING _decode_deferred's awaits
+                # — unresolved, so it sits this round out.
+                if c[1] is not None and c[1].size == buf.size and tokens.get(p) == t
             }
             if len(good) < self.min_group:
                 self.rounds_skipped += 1
@@ -1038,6 +1162,11 @@ class ByzantineAverager(AveragerBase):
         if not st.expected and len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
             raise RPCError("round contribution cap reached")
         st.contribs[peer] = (float(args["weight"]), buf)
+        if buf is None:
+            # Pre-schema powersgd push: park the raw payload for
+            # _decode_deferred (decode amplification is the attack here;
+            # raw bytes cost the sender its own bandwidth).
+            st.payloads[peer] = payload
         if st.expected and set(st.contribs) >= st.expected:
             st.full.set()
         return {"ok": True}, b""
@@ -1084,10 +1213,14 @@ class ByzantineAverager(AveragerBase):
             await asyncio.wait_for(st.full.wait(), timeout=self.effective_gather_timeout)
         except asyncio.TimeoutError:
             degraded = True  # aggregate the subset, but don't observe the wait
+        # Resolve pre-schema-parked powersgd payloads (exact-size-capped now
+        # that our own pack fixed the specs).
+        await self._decode_deferred(st)
         received = {
             p: c
             for p, c in st.contribs.items()
-            if p in st.expected and c[1].size == buf.size
+            # c[1] None: unresolved deferred entry (see _leader_round note).
+            if p in st.expected and c[1] is not None and c[1].size == buf.size
         }
         self._rounds.pop(group.epoch, None)
         if len(received) < self.min_group:

@@ -151,24 +151,30 @@ class VolunteerConfig:
                 )
             if self.averaging == "none":
                 raise ValueError("--average-interval-s requires an averaging mode")
+        # Fail at config time, not per round: an unknown method (or kwarg)
+        # would raise inside every averaging round, be swallowed by the
+        # round-failure containment, and leave the volunteer training solo
+        # forever with only warnings in the log (r4 advisor: the kwarg
+        # validation below used to silently no-op on a typo'd method name —
+        # the exact failure it existed to prevent).
+        from distributedvolunteercomputing_tpu.ops import robust
+
+        if self.method not in robust.AGGREGATORS:
+            raise ValueError(
+                f"unknown --method {self.method!r}; "
+                f"known: {sorted(robust.AGGREGATORS)}"
+            )
         if self.method_kw:
-            # Fail at config time, not per round: an unknown kwarg would
-            # raise inside every averaging round, be swallowed by the
-            # round-failure containment, and leave the volunteer training
-            # solo forever with only warnings in the log.
             import inspect
 
-            from distributedvolunteercomputing_tpu.ops import robust
-
-            fn = robust.AGGREGATORS.get(self.method)
-            if fn is not None:
-                allowed = set(inspect.signature(fn).parameters) - {"stack", "weights"}
-                unknown = set(self.method_kw) - allowed
-                if unknown:
-                    raise ValueError(
-                        f"--method-kw keys {sorted(unknown)} are not accepted "
-                        f"by method {self.method!r} (accepts: {sorted(allowed)})"
-                    )
+            fn = robust.AGGREGATORS[self.method]
+            allowed = set(inspect.signature(fn).parameters) - {"stack", "weights"}
+            unknown = set(self.method_kw) - allowed
+            if unknown:
+                raise ValueError(
+                    f"--method-kw keys {sorted(unknown)} are not accepted "
+                    f"by method {self.method!r} (accepts: {sorted(allowed)})"
+                )
         if self.outer_optimizer != "none":
             if self.average_what != "params":
                 raise ValueError("--outer-optimizer requires --average-what params")
@@ -463,6 +469,11 @@ class Volunteer:
             outer_lr=self.cfg.outer_lr,
             outer_momentum=self.cfg.outer_momentum,
         )
+        if self.averager is not None:
+            # Checkpoint sidecars persist the averager's compressor state
+            # (EF residual + PowerSGD warm Q) across preemption; the
+            # checkpoint module reaches it through this handle.
+            self.trainer._wire_averager = self.averager
         if self.cfg.checkpoint_dir:
             from distributedvolunteercomputing_tpu.training.checkpoint import maybe_restore
 

@@ -68,9 +68,9 @@ def _gc(ckpt_dir: str, just_saved: int, keep: int = 0) -> None:
         try:
             shutil.rmtree(path)
             # The outer-state sidecar lives BESIDE the snapshot dir.
-            outer = _outer_state_path(path)
-            if os.path.exists(outer):
-                os.remove(outer)
+            for sidecar in (_outer_state_path(path), _wire_state_path(path)):
+                if os.path.exists(sidecar):
+                    os.remove(sidecar)
             log.info("checkpoint GC: removed %s", path)
         except OSError as e:
             log.warning("checkpoint GC failed for %s: %s", path, errstr(e))
@@ -101,6 +101,52 @@ def _save_outer_state(trainer, snapshot_path: str) -> None:
         np.savez(_outer_state_path(snapshot_path), anchor=buf_a, m=buf_m)
     except OSError as e:
         log.warning("outer-state save failed (continuing): %s", errstr(e))
+
+
+def _wire_state_path(snapshot_path: str) -> str:
+    # Same beside-the-snapshot policy as the outer-state sidecar.
+    return snapshot_path + ".wire.npz"
+
+
+def _save_wire_state(trainer, snapshot_path: str) -> None:
+    """Persist the averager's compressor state (EF residual, PowerSGD warm
+    Q) beside the snapshot (r4 VERDICT #7: a preempted volunteer on the
+    powersgd wire rejoined cold for no strong reason — the sidecar
+    mechanism already existed). The volunteer attaches its averager as
+    ``trainer._wire_averager``; library users without a swarm simply have
+    no sidecar."""
+    avg = getattr(trainer, "_wire_averager", None)
+    if avg is None:
+        return
+    try:
+        state = avg.wire_state()
+    except Exception as e:  # noqa: BLE001 — sidecar must never kill a save
+        log.warning("wire-state snapshot failed (continuing): %s", errstr(e))
+        return
+    if not state:
+        return
+    try:
+        np.savez(_wire_state_path(snapshot_path), **state)
+    except OSError as e:
+        log.warning("wire-state save failed (snapshot is intact): %s", errstr(e))
+
+
+def _maybe_restore_wire_state(trainer, snapshot_path: str) -> None:
+    """Hand the sidecar back to the averager, which validates against its
+    schema at first pack and silently re-seeds on mismatch (same cold-start
+    semantics as the outer-state sidecar)."""
+    avg = getattr(trainer, "_wire_averager", None)
+    if avg is None:
+        return
+    path = _wire_state_path(snapshot_path)
+    if not os.path.exists(path):
+        return
+    try:
+        with np.load(path) as d:
+            avg.load_wire_state({k: d[k] for k in d.files})
+        log.info("restored averager wire state from %s", path)
+    except (OSError, ValueError, KeyError) as e:
+        log.warning("wire-state restore failed (re-seeding): %s", errstr(e))
 
 
 def _maybe_restore_outer_state(trainer, snapshot_path: str) -> None:
@@ -146,6 +192,7 @@ def save(trainer, ckpt_dir: str) -> str:
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, _state_to_pytree(trainer), force=True)
     _save_outer_state(trainer, path)
+    _save_wire_state(trainer, path)
     log.info("checkpoint saved: %s", path)
     _gc(ckpt_dir, just_saved=step)
     return path
@@ -191,6 +238,14 @@ def save_async(trainer, ckpt_dir: str) -> bool:
             flatten_to_buffer(trainer._outer_anchor)[0],
             flatten_to_buffer(trainer._outer_m)[0],
         )
+    # Compressor state snapshotted on the caller thread too (wire_state
+    # copies arrays that the averager only ever replaces wholesale).
+    wire_snapshot = None
+    if getattr(trainer, "_wire_averager", None) is not None:
+        try:
+            wire_snapshot = trainer._wire_averager.wire_state()
+        except Exception as e:  # noqa: BLE001
+            log.warning("wire-state snapshot failed (continuing): %s", errstr(e))
 
     def _write():
         import orbax.checkpoint as ocp
@@ -208,6 +263,11 @@ def save_async(trainer, ckpt_dir: str) -> bool:
                 np.savez(_outer_state_path(path), anchor=outer_bufs[0], m=outer_bufs[1])
             except OSError as e:
                 log.warning("outer-state save failed (snapshot is intact): %s", errstr(e))
+        if wire_snapshot:
+            try:
+                np.savez(_wire_state_path(path), **wire_snapshot)
+            except OSError as e:
+                log.warning("wire-state save failed (snapshot is intact): %s", errstr(e))
         log.info("checkpoint saved (async): %s", path)
         _gc(ckpt_dir, just_saved=step)
 
@@ -288,6 +348,7 @@ def maybe_restore(trainer, ckpt_dir: str) -> bool:
     else:
         trainer.state = jax.tree_util.tree_map(jax.device_put, host_state)
     _maybe_restore_outer_state(trainer, path)
+    _maybe_restore_wire_state(trainer, path)
     # Refresh the cross-thread snapshot: the state-sync provider must
     # announce/serve the RESTORED step, not the cold init from __init__.
     trainer._take_snapshot(step)

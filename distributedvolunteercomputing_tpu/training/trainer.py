@@ -98,6 +98,12 @@ class Trainer:
         metrics_path: Optional[str] = None,
         volunteer_id: str = "local",
         total_steps: Optional[int] = None,
+        # Called after each HOST-VISIBLE step. With steps_per_call > 1 the
+        # scan prefix runs whole chunks on-device, so on_step fires only on
+        # chunk-final steps: any per-step or modular cadence inside the
+        # callback MUST be declared in chunk_cadences (chunks then end at
+        # every multiple, making those steps host-visible) — an undeclared
+        # cadence is silently skipped for scan-prefix steps.
         on_step: Optional[Callable[["Trainer", int], None]] = None,
         data: Optional[Iterable[Batch]] = None,  # overrides the synthetic stream
         # In-slice device mesh: when a volunteer owns a multi-chip TPU slice,
@@ -529,11 +535,18 @@ class Trainer:
         return max(1, n)
 
     def _record_target_crossed(
-        self, cross_step: int, target_loss: float, t_start: float
+        self, cross_step: int, target_loss: float, t_start: float,
+        wall_override: Optional[float] = None,
     ) -> Tuple[int, float]:
         """Log + record the first target crossing; shared by the per-step
-        path and the scan-prefix path so the two can't diverge."""
-        wall = time.monotonic() - t_start
+        path and the scan-prefix path so the two can't diverge.
+
+        ``wall_override``: the scan-prefix path detects a crossing only
+        after its whole chunk completes, so it interpolates the crossing
+        time from the chunk's per-step rate instead of charging the metric
+        with up to a chunk of post-crossing steps (r4 advisor) — keeping
+        time-to-target comparable with the per-step path."""
+        wall = wall_override if wall_override is not None else time.monotonic() - t_start
         log.info(
             "target loss %.4f reached at step %d (%.1fs)",
             target_loss, cross_step, wall,
@@ -736,8 +749,16 @@ class Trainer:
                                 cross_step = (
                                     start_step + ran_steps - (n - 1) + int(hit[0]) + 1
                                 )
+                                # Back out the steps that ran AFTER the
+                                # crossing at this chunk's per-step rate.
+                                per_step = (time.perf_counter() - t_chunk) / (n - 1)
+                                wall_est = (
+                                    time.monotonic() - t_start
+                                    - (n - 2 - int(hit[0])) * per_step
+                                )
                                 target_crossed = self._record_target_crossed(
-                                    cross_step, target_loss, t_start
+                                    cross_step, target_loss, t_start,
+                                    wall_override=wall_est,
                                 )
                                 if target_mode == "stop":
                                     # The end-of-run sync reads m; point it

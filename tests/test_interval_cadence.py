@@ -194,3 +194,18 @@ class TestMethodKw:
             method="krum", method_kw={"n_byzantine": 2},
         )
         assert cfg.method_kw == {"n_byzantine": 2}
+
+    def test_unknown_method_name_rejected_at_config_time(self):
+        # r4 advisor: the kwarg validation above silently no-op'd when the
+        # METHOD name itself was a typo — robust.aggregate would then raise
+        # KeyError inside every round's containment, the exact solo-forever
+        # failure this validation exists to prevent.
+        from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+        with pytest.raises(ValueError, match="unknown --method"):
+            VolunteerConfig(
+                coordinator="x:1", averaging="byzantine", method="trimed_mean",
+            )
+        # ...and regardless of averaging mode (fail fast beats dead config).
+        with pytest.raises(ValueError, match="unknown --method"):
+            VolunteerConfig(coordinator="x:1", averaging="gossip", method="nope")

@@ -292,6 +292,24 @@ class TestTopkCodec:
         with pytest.raises(ValueError):
             native.topk_decode(bytes(bad))
 
+    def test_decode_allocation_capped(self):
+        """A ~100-byte sparse frame claiming a multi-TB n must be refused,
+        not allocated (r4 advisor: the same resource-exhaustion class the
+        powersgd decode cap blocks). The schema-size cap is exact; the
+        default cap is the transport MAX_PAYLOAD expressed in floats."""
+        # Hand-build a sparse frame claiming n = 2^40 with one entry.
+        hdr = b"TK1" + bytes([0]) + np.uint64(1 << 40).tobytes()
+        body = np.uint32(7).tobytes() + np.float32(1.0).tobytes()
+        with pytest.raises(ValueError, match="decode cap"):
+            native.topk_decode(hdr + body)
+        # Caller with a known schema bounds tighter still.
+        good = native.topk_encode(np.ones(64, np.float32), frac=0.1)
+        with pytest.raises(ValueError, match="decode cap"):
+            native.topk_decode(good, max_floats=8)
+        np.testing.assert_array_equal(
+            native.topk_decode(good, max_floats=64).shape, (64,)
+        )
+
     def test_topk_wire_end_to_end_with_error_feedback(self):
         """Sync round over the topk wire, then a second round: entries
         dropped by round 1's truncation ship in round 2 via the EF residual."""
