@@ -267,6 +267,7 @@ class Volunteer:
         )
         self.dht = DHTNode(self.transport)
         self.membership: Optional[SwarmMembership] = None
+        self.clocksync = None
         self.averager = None
         self.state_sync: Optional[StateSyncService] = None
         self.trainer: Optional[Trainer] = None
@@ -339,6 +340,21 @@ class Volunteer:
             },
         )
         await self.membership.join()
+        if self.cfg.average_interval_s > 0:
+            # Wall-cadence rendezvous no longer assumes NTP: peer-to-peer
+            # clock-offset estimation corrects this volunteer's boundary
+            # clock onto swarm-consensus time (swarm/clocksync.py).
+            # DVC_CLOCK_SKEW_S injects artificial skew so the e2e suite can
+            # prove rendezvous under multi-second skew.
+            from distributedvolunteercomputing_tpu.swarm.clocksync import ClockSync
+
+            skew = float(os.environ.get("DVC_CLOCK_SKEW_S", "0") or "0")
+            clock = (lambda: time.time() + skew) if skew else time.time
+            self.clocksync = ClockSync(self.transport, self.membership, clock=clock)
+            # First estimate immediately: the first boundary this volunteer
+            # arms must already be on swarm time.
+            await self.clocksync.estimate()
+            self.clocksync.start(interval_s=max(self.cfg.heartbeat_ttl, 15.0))
         if self.cfg.averaging != "none":
             kw = dict(
                 min_group=self.cfg.min_group,
@@ -441,6 +457,7 @@ class Volunteer:
             accum_steps=self.cfg.accum_steps,
             average_every=self.cfg.average_every,
             average_interval_s=self.cfg.average_interval_s,
+            wall_clock=self.clocksync.now if self.clocksync is not None else None,
             steps_per_call=self.cfg.steps_per_call,
             # The checkpoint cadence lives inside on_step where chunk
             # sizing can't see it — declare it so scan chunks end there.
@@ -634,6 +651,8 @@ class Volunteer:
         finally:
             self._stop.set()
             report_task.cancel()
+            if self.clocksync is not None:
+                self.clocksync.stop()
             try:
                 await self.membership.leave()
             except Exception:

@@ -85,6 +85,11 @@ class Trainer:
         # Contribution weights carry samples-since-last-merge, so unequal
         # local progress is weighted correctly by construction.
         average_interval_s: float = 0.0,
+        # Clock the wall-cadence boundaries are computed on. The volunteer
+        # passes its ClockSync's corrected clock (swarm/clocksync.py) so
+        # boundaries rendezvous even under multi-second clock skew;
+        # defaults to time.time for library users.
+        wall_clock: Optional[Callable[[], float]] = None,
         averager: Optional[AveragerFn] = None,
         # params: local-SGD, averaged every `average_every` steps.
         # grads: GradientAverager semantics, averaged EVERY step
@@ -178,6 +183,7 @@ class Trainer:
         self.accum_steps = accum_steps
         self.average_every = average_every
         self.average_interval_s = float(average_interval_s)
+        self._wall_clock = wall_clock or time.time
         # Next wall-clock boundary (multiple of the interval) a round is due
         # at; None until run() arms it.
         self._next_avg_t: Optional[float] = None
@@ -480,14 +486,15 @@ class Trainer:
 
         Step cadence (the default): every ``average_every`` steps. Wall-clock
         cadence (``average_interval_s > 0``): when wall time crosses a
-        multiple of the interval — boundaries are ABSOLUTE (``n * T``), so
-        every volunteer with an NTP-synced clock fires within ms of its
-        peers regardless of join time or step speed, which is what makes
-        heterogeneous swarms rendezvous without parking the fast peer.
+        multiple of the interval — boundaries are ABSOLUTE (``n * T``) on
+        the swarm-consensus clock (``wall_clock``; the volunteer supplies
+        ClockSync's corrected clock, so skewed volunteers still fire within
+        ms of their peers), which is what makes heterogeneous swarms
+        rendezvous without parking the fast peer.
         Advances the armed boundary exactly once per crossing (a slow step
         that skips past several boundaries still yields one round)."""
         if self.average_interval_s > 0:
-            now = time.time()
+            now = self._wall_clock()
             if self._next_avg_t is None:
                 # First call arms the NEXT boundary: a joining volunteer's
                 # first round aligns with the swarm's next window instead of
@@ -530,7 +537,7 @@ class Trainer:
             if self._ema_step_s is None:
                 n = min(n, 2)
             elif self._next_avg_t is not None:
-                until = max(self._next_avg_t - time.time(), 0.0)
+                until = max(self._next_avg_t - self._wall_clock(), 0.0)
                 n = min(n, max(1, int(until / self._ema_step_s) + 1))
         return max(1, n)
 

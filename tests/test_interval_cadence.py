@@ -7,6 +7,7 @@ The interval cadence fires rounds at absolute wall-clock multiples of T and
 weights each contribution by the steps actually taken since the last merge.
 """
 
+import asyncio
 import time
 
 import numpy as np
@@ -209,3 +210,115 @@ class TestMethodKw:
         # ...and regardless of averaging mode (fail fast beats dead config).
         with pytest.raises(ValueError, match="unknown --method"):
             VolunteerConfig(coordinator="x:1", averaging="gossip", method="nope")
+
+
+class TestClockSync:
+    """r4 VERDICT #9: --average-interval-s assumed NTP sync. ClockSync
+    (swarm/clocksync.py) estimates per-peer offsets over the transport and
+    corrects the boundary clock; these tests inject multi-second skew."""
+
+    def _stack(self, peer_id, clock):
+        async def make():
+            from tests.test_averaging import _solo_stack
+            from distributedvolunteercomputing_tpu.swarm.clocksync import ClockSync
+
+            t, dht, mem = await _solo_stack(peer_id)
+            return t, dht, mem, ClockSync(t, mem, clock=clock, samples_per_peer=2)
+
+        return make()
+
+    def test_two_nodes_meet_in_the_middle(self):
+        import time as _t
+        from tests.test_averaging import run
+        from distributedvolunteercomputing_tpu.swarm.clocksync import ClockSync
+        from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+        from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+        from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+        async def main():
+            stacks = []
+            boot = None
+            skews = {"a": +6.0, "b": 0.0}
+            for pid, skew in skews.items():
+                t = Transport()
+                dht = DHTNode(t)
+                await dht.start(bootstrap=[boot] if boot else None)
+                boot = boot or t.addr
+                mem = SwarmMembership(dht, pid, ttl=10.0)
+                await mem.join()
+                cs = ClockSync(t, mem, clock=(lambda s=skew: _t.time() + s),
+                               samples_per_peer=2)
+                stacks.append((t, mem, cs))
+            try:
+                # A few simultaneous rounds: corrected clocks converge.
+                for _ in range(4):
+                    await asyncio.gather(*(cs.estimate() for _, _, cs in stacks))
+                times = [cs.now() for _, _, cs in stacks]
+                assert abs(times[0] - times[1]) < 0.5, times
+                # ...and onto the midpoint, not one node's clock.
+                mid = _t.time() + 3.0
+                assert abs(times[0] - mid) < 1.5
+            finally:
+                for t, mem, _ in stacks:
+                    try:
+                        await mem.leave()
+                    except Exception:
+                        pass
+                    await t.close()
+
+        run(main())
+
+    def test_skewed_minority_pinned_to_majority(self):
+        import time as _t
+        from tests.test_averaging import run
+        from distributedvolunteercomputing_tpu.swarm.clocksync import ClockSync
+        from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+        from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+        from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+        async def main():
+            stacks = []
+            boot = None
+            for pid, skew in (("a", -7.0), ("b", 0.0), ("c", 0.0), ("d", 0.0)):
+                t = Transport()
+                dht = DHTNode(t)
+                await dht.start(bootstrap=[boot] if boot else None)
+                boot = boot or t.addr
+                mem = SwarmMembership(dht, pid, ttl=10.0)
+                await mem.join()
+                cs = ClockSync(t, mem, clock=(lambda s=skew: _t.time() + s),
+                               samples_per_peer=2)
+                stacks.append((t, mem, cs))
+            try:
+                for _ in range(3):
+                    await asyncio.gather(*(cs.estimate() for _, _, cs in stacks))
+                times = [cs.now() for _, _, cs in stacks]
+                true_now = _t.time()
+                # Honest majority barely moves; the skewed node joins them.
+                for ct in times:
+                    assert abs(ct - true_now) < 1.0, times
+            finally:
+                for t, mem, _ in stacks:
+                    try:
+                        await mem.leave()
+                    except Exception:
+                        pass
+                    await t.close()
+
+        run(main())
+
+    def test_trainer_boundary_uses_corrected_clock(self):
+        from distributedvolunteercomputing_tpu.models import get_model
+        from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+        offset = {"v": 100.0}
+        tr = Trainer(
+            get_model("mnist_mlp"), batch_size=4, lr=1e-2,
+            average_interval_s=10.0,
+            wall_clock=lambda: 1000.0 + offset["v"],
+            averager=lambda tree, step: None,
+        )
+        assert tr._avg_due(1) is False  # first call arms
+        assert tr._next_avg_t == 1110.0  # armed on the CORRECTED clock
+        offset["v"] = 111.0  # corrected clock crosses the boundary
+        assert tr._avg_due(2) is True
