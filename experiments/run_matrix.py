@@ -95,7 +95,8 @@ def wait_done(proc, timeout):
 
 
 def run_swarm(
-    name, vol_specs, timeout=600, kill_after=None, chaos_peer=None, slow_peer=None
+    name, vol_specs, timeout=600, kill_after=None, chaos_peer=None, slow_peer=None,
+    tolerate_missing=False,
 ):
     """Launch a swarm; vol_specs = [(peer_id, [cli args]), ...].
 
@@ -136,7 +137,13 @@ def run_swarm(
                 summary, out = None, "(timeout)"
             if summary is None and (kill_after is None or pid != vols[kill_after[1]][0]):
                 tail = "\n".join(out.splitlines()[-15:])
-                raise RuntimeError(f"[{name}] volunteer {pid} produced no summary:\n{tail}")
+                if not tolerate_missing:
+                    raise RuntimeError(
+                        f"[{name}] volunteer {pid} produced no summary:\n{tail}"
+                    )
+                # Straggler-tolerant mode (scale16's 16-contended-process
+                # regime): record the survivor data, mark this one dead.
+                print(f"[{name}] volunteer {pid} produced no summary (recorded as dead):\n{tail}", flush=True)
             rows.append((pid, summary, time.monotonic() - t0))
     finally:
         coord.kill()
@@ -237,20 +244,39 @@ def config3():
     return record("config3_bert_gossip", rows)
 
 
-def config4():
-    # Heterogeneous volunteers: same data budget per optimizer step is not
-    # required by butterfly — each volunteer contributes its own weight. The
-    # speed spread comes from different per-volunteer batch sizes (a v4-8 vs
-    # v5e-4 swarm in miniature, BASELINE.json:10).
+def _config4_swarm(name: str, cadence: list) -> list:
+    """Config 4's swarm — heterogeneous volunteers: same data budget per
+    optimizer step is not required by butterfly, each contributes its own
+    weight. The speed spread comes from per-volunteer batch sizes (a v4-8
+    vs v5e-4 swarm in miniature, BASELINE.json:10). ONE roster shared by
+    the step-cadence and wall-clock-cadence arms, so 'same swarm, only the
+    cadence differs' holds by construction."""
     base = ["--model", "gpt2_small", *TINY_GPT2, "--averaging", "butterfly",
-            "--average-every", "10", "--lr", "0.003", *TIMEOUTS, *_target(4.4)]
-    rows = run_swarm("config4", [
+            *cadence, "--lr", "0.003", *TIMEOUTS, *_target(4.4)]
+    return run_swarm(name, [
         ("fast0", base + ["--steps", "60", "--batch-size", "8", "--seed", "0"]),
         ("fast1", base + ["--steps", "60", "--batch-size", "8", "--seed", "1"]),
         ("slow0", base + ["--steps", "60", "--batch-size", "32", "--seed", "2"]),
         ("slow1", base + ["--steps", "60", "--batch-size", "32", "--seed", "3"]),
     ])
+
+
+def config4():
+    rows = _config4_swarm("config4", ["--average-every", "10"])
     return record("config4_gpt2_butterfly_hetero", rows)
+
+
+def config4b():
+    """Config 4 on the WALL-CLOCK cadence (r4 VERDICT #6). The step cadence
+    parks fast volunteers at every rendezvous once speeds diverge —
+    interval_ab measured it completing ZERO rounds under an 8x speed
+    spread while the interval cadence ran at full speed. Identical swarm
+    (shared roster, _config4_swarm); only the cadence flag differs
+    (boundaries at absolute 20s multiples of swarm-consensus time, rounds
+    weighted by steps-since-merge). Measured 2026-07-31: crossed 3/4 ->
+    4/4, rounds 18/6 -> 56/0, time-to-target 299 -> 232 s."""
+    rows = _config4_swarm("config4b", ["--average-interval-s", "20"])
+    return record("config4b_gpt2_butterfly_hetero_interval", rows)
 
 
 def config5():
@@ -390,6 +416,7 @@ def config8_kitchen_sink_r4():
 CONFIGS = {
     0: config0_overlap, 1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
     6: config6_file_mnist, 7: config7_file_resnet, 8: config8_kitchen_sink_r4,
+    9: config4b,  # config 4's wall-clock-cadence arm (r4 VERDICT #6)
 }
 
 

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""The averaging tier at 16 volunteers — max_group's upper bound, run for
+real (r4 VERDICT #8: the DHT was tested at 16 nodes, the averaging tier
+only at 4-8; "supports up to 16" was an untested claim).
+
+Two arms through the real CLI entrypoints on localhost:
+  butterfly16 — a full 16-member hypercube round is 4 pairwise-exchange
+                stages; 16 is the largest group the default max_group
+                admits and the shape where stage bookkeeping is busiest.
+  gossip16    — 16-peer partner selection pressure: every round each
+                volunteer picks a partner from 15 candidates; overlap and
+                xid-dedup machinery see their densest traffic.
+
+Tiny-MLP proxy (the matrix's standard scaling-down; SURVEY.md §7 step 6 —
+the averaging tier is host-side and model-size-independent except payload
+bytes, which the soaks cover at scale separately). Everything shares one
+CPU core in the sandbox, so steps are deliberately few and timeouts wide;
+the assertion of interest is rounds_ok > 0 on (nearly) every volunteer
+with a converging mean loss, recorded to
+experiments/results/scale16_{butterfly,gossip}.jsonl + summary.json.
+
+Run: python experiments/scale16.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from experiments.run_matrix import (  # noqa: E402
+    RESULTS, record, run_swarm,
+)
+
+TINY_MLP = ["--model", "mnist_mlp", "--model-override", "d_hidden=32"]
+# 16 processes share one core: joins straggle, so rendezvous windows are
+# wide and the step budget small (the tier under test is averaging, not
+# throughput).
+TIMEOUTS = ["--join-timeout", "40", "--gather-timeout", "40"]
+
+
+def arm(tag: str, averaging: str, extra: list, cadence: list = None) -> dict:
+    base = [
+        *TINY_MLP, "--averaging", averaging,
+        *(cadence or ["--average-every", "15"]),
+        # 16 cold jax processes on one sandbox core: the first ~60 steps
+        # race process startup, so a 60-step budget left late joiners with
+        # zero rounds. 240 steps gives every volunteer several windows
+        # AFTER the whole swarm is up.
+        "--steps", "240", "--batch-size", "16", "--lr", "0.01",
+        "--max-group", "16", *TIMEOUTS, *extra,
+    ]
+    rows = run_swarm(
+        f"scale16/{tag}",
+        [(f"v{i:02d}", base + ["--seed", str(i)]) for i in range(16)],
+        timeout=900,
+        # One straggler among 16 contended processes must cost one row,
+        # not the whole two-arm run.
+        tolerate_missing=True,
+    )
+    agg = record(f"scale16_{tag}", rows)
+    agg["n_rounds_ok_min"] = min(
+        (s["rounds_ok"] for _, s, _ in rows if s), default=0
+    )
+    return agg
+
+
+def main() -> int:
+    out = {}
+    # Butterfly on the step cadence at n=16 reproduced the config-4 disease
+    # at scale (14 ok / 16 skipped, min 0 per volunteer — committed in the
+    # first scale16_butterfly.jsonl): 16 one-core processes step at wildly
+    # different instantaneous rates, so step-count boundaries never line
+    # up. The wall-clock cadence is the cure config 4b proved; butterfly
+    # runs on it here.
+    out["butterfly"] = arm(
+        "butterfly", "butterfly", ["--min-group", "4"],
+        cadence=["--average-interval-s", "20"],
+    )
+    out["gossip"] = arm("gossip", "gossip", [])
+    path = os.path.join(RESULTS, "scale16.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    for tag, agg in out.items():
+        print(
+            f"scale16 {tag:10s}: finished {agg['finished']}/16, "
+            f"rounds_ok_total {agg['rounds_ok_total']}, "
+            f"min per-volunteer {agg['n_rounds_ok_min']}, "
+            f"loss {agg['final_loss_mean']}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,13 @@ while [ "$LOOPS" -lt 80 ]; do
             timeout 600 python experiments/step_scan_probe.py >>"$LOG" 2>&1
             echo "$(date +%T) scan_probe rc=$?" >>"$LOG"
         fi
+        if ! fresh "$R/attn_sweep.json"; then
+            # r5 kernel redesign (grid-streamed K/V, native-dtype MXU):
+            # re-measure the per-op sweep — bf16 short-T and the long-T
+            # compiles are the two things the redesign targets.
+            timeout 1200 python experiments/attn_sweep.py >>"$LOG" 2>&1
+            echo "$(date +%T) attn_sweep rc=$?" >>"$LOG"
+        fi
         if ! fresh "$R/chip_trace.json"; then
             timeout 400 python experiments/chip_trace.py >>"$LOG" 2>&1
             echo "$(date +%T) chip_trace rc=$?" >>"$LOG"
@@ -89,7 +96,8 @@ while [ "$LOOPS" -lt 80 ]; do
             echo "$(date +%T) chip_overlap rc=$? tags=$(done_tags)" >>"$LOG"
         fi
         if [ "$(done_tags)" -ge 3 ] && fresh "$R/bench_accum4.json" \
-            && fresh "$R/bench_ab_flash.json" && fresh "$R/bench_ab_xla.json"; then
+            && fresh "$R/bench_ab_flash.json" && fresh "$R/bench_ab_xla.json" \
+            && fresh "$R/attn_sweep.json"; then
             echo "$(date +%T) full agenda banked; watcher exiting" >>"$LOG"
             break
         fi
