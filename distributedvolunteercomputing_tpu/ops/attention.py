@@ -139,7 +139,8 @@ def attention_core_local(
     if _route_to_flash(q, k, causal, mask):
         from distributedvolunteercomputing_tpu.ops.pallas_attention import flash_attention
 
-        return flash_attention(q, k, v, causal)
+        bq, bk = _flash_blocks()
+        return flash_attention(q, k, v, causal, bq, bk)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
@@ -150,6 +151,29 @@ def attention_core_local(
         logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _flash_blocks() -> tuple:
+    """Flash block-size tuning knobs for chip-window sweeps.
+
+    Read at TRACE time and captured into the compiled program: changing the
+    env after a function has compiled does not retrace it, so block A/Bs
+    must use fresh processes or freshly-defined jitted closures (attn_sweep
+    builds a new closure per arm — cache can't alias across arms).
+    Validated here so a bad value names the knob instead of failing deep
+    inside Mosaic with a zero-sized grid."""
+    try:
+        bq = int(os.environ.get("DVC_FLASH_BLOCK_Q") or "128")
+        bk = int(os.environ.get("DVC_FLASH_BLOCK_K") or "128")
+    except ValueError:
+        raise ValueError(
+            "DVC_FLASH_BLOCK_Q / DVC_FLASH_BLOCK_K must be integers; got "
+            f"{os.environ.get('DVC_FLASH_BLOCK_Q')!r} / "
+            f"{os.environ.get('DVC_FLASH_BLOCK_K')!r}"
+        ) from None
+    if bq < 8 or bk < 8:
+        raise ValueError(f"DVC_FLASH_BLOCK_Q/K must be >= 8, got {bq}/{bk}")
+    return bq, bk
 
 
 def split_heads(x: jax.Array, n_heads: int) -> jax.Array:

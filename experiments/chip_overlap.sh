@@ -32,7 +32,7 @@ AVG="--averaging sync --average-every 10 --join-timeout 25 --gather-timeout 60"
 
 run_tpu() { # $1=tag  $2...=extra args for the TPU volunteer
     local tag=$1; shift
-    if grep -q "\"tag\": \"$tag\", \"summary\"" "$OUT"; then
+    if grep -q "\"tag\": \"$tag\",.*\"summary\"" "$OUT"; then
         echo "tag $tag already recorded; skipping"
         return
     fi
@@ -58,8 +58,13 @@ run_tpu() { # $1=tag  $2...=extra args for the TPU volunteer
         $MODEL $STEPS --seed 0 "$@" >"/tmp/va_$tag.log" 2>&1
     local sps
     sps=$(grep -o 'VOLUNTEER_DONE .*' "/tmp/va_$tag.log" | sed 's/VOLUNTEER_DONE //')
+    # Machine-state context per row (r4 VERDICT weak #6: two committed
+    # baseline rows differed 4x with nothing recording WHY — without load
+    # context the file is useless as a comparison anchor).
+    local ctx
+    ctx="\"loadavg\": \"$(cut -d' ' -f1-3 /proc/loadavg)\", \"recorded_at\": \"$(date -u +%FT%TZ)\""
     if [ -n "$sps" ]; then
-        echo "{\"tag\": \"$tag\", \"summary\": $sps}" >>"$OUT"
+        echo "{\"tag\": \"$tag\", $ctx, \"summary\": $sps}" >>"$OUT"
     else
         # JSON-escape the log tail properly (backslashes/control chars in a
         # traceback would otherwise produce an unparseable jsonl line).
