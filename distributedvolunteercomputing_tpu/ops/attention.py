@@ -171,8 +171,11 @@ def _flash_blocks() -> tuple:
             f"{os.environ.get('DVC_FLASH_BLOCK_Q')!r} / "
             f"{os.environ.get('DVC_FLASH_BLOCK_K')!r}"
         ) from None
-    if bq < 8 or bk < 8:
-        raise ValueError(f"DVC_FLASH_BLOCK_Q/K must be >= 8, got {bq}/{bk}")
+    if bq < 8 or bk < 8 or bq % 8 or bk % 8:
+        raise ValueError(
+            f"DVC_FLASH_BLOCK_Q/K must be multiples of 8 and >= 8 (TPU "
+            f"sublane tiling), got {bq}/{bk}"
+        )
     return bq, bk
 
 

@@ -69,7 +69,7 @@ run_tpu() { # $1=tag  $2...=extra args for the TPU volunteer
         # JSON-escape the log tail properly (backslashes/control chars in a
         # traceback would otherwise produce an unparseable jsonl line).
         tail -c 200 "/tmp/va_$tag.log" \
-            | python -c "import json,sys; print(json.dumps({\"tag\": \"$tag\", \"error\": sys.stdin.read()}))" \
+            | python -c "import json,sys,os; print(json.dumps({\"tag\": \"$tag\", \"loadavg\": \"%.2f %.2f %.2f\" % os.getloadavg(), \"error\": sys.stdin.read()}))" \
             >>"$OUT"
     fi
     # Scoped cleanup: kill only THIS run's processes (a blanket pkill would

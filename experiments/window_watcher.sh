@@ -84,7 +84,7 @@ while [ "$LOOPS" -lt 80 ]; do
             # r5 kernel redesign (grid-streamed K/V, native-dtype MXU):
             # re-measure the per-op sweep — bf16 short-T and the long-T
             # compiles are the two things the redesign targets.
-            timeout 1200 python experiments/attn_sweep.py >>"$LOG" 2>&1
+            timeout 1800 python experiments/attn_sweep.py >>"$LOG" 2>&1
             echo "$(date +%T) attn_sweep rc=$?" >>"$LOG"
         fi
         if ! fresh "$R/chip_trace.json"; then
