@@ -293,6 +293,80 @@ def q8_decode(payload: bytes, chunk: int = Q8_CHUNK) -> np.ndarray:
     return out
 
 
+# Decode-allocation ceiling when the caller has no schema to bound by:
+# 2^29 f32 = the 2 GiB transport MAX_PAYLOAD expressed in floats. A sparse
+# frame's uint64 n is attacker-controlled (a ~100-byte frame can claim any
+# n), so the dense reconstruction must never exceed what a dense payload of
+# the transport's own cap could have shipped.
+TOPK_MAX_DECODE_FLOATS = 1 << 29
+
+
+# 1-bit sign wire codec (EF-signSGD, Karimireddy et al.'s error-fixed
+# signSGD lineage): ship sign(x) packed 1 bit/coord plus a per-chunk f32
+# scale = mean(|x|) over the chunk, so the reconstruction ±scale carries the
+# chunk's average magnitude (plain ±1 signs would need a global lr rescale;
+# mean-|x| scaling is what makes EF residuals drain). ~32x fewer bytes than
+# f32 — the extreme rung of the codec family (f32 -> bf16 2x -> q8 4x ->
+# powersgd ~7x -> topk ~14-50x -> sign 32x on the contribution leg).
+# Self-describing magic so the averager can tell a sign contribution from
+# its q8-coded round RESULT on the same wire (see averager._buf_from_payload).
+SIGN_MAGIC = b"SG1"
+_SIGN_HDR = 3 + 8  # magic, n u64
+
+
+def sign_coded_size(n: int, chunk: int = Q8_CHUNK) -> int:
+    n_chunks = -(-n // chunk) if n else 0
+    return _SIGN_HDR + 4 * n_chunks + (n + 7) // 8
+
+
+def sign_encode(arr: np.ndarray, chunk: int = Q8_CHUNK) -> bytes:
+    """f32 -> sign wire bytes: [SG1][u64 n][f32 mean-|x| per chunk][packed
+    sign bits, 1 = negative]. Non-finite values encode as +scale with the
+    non-finites excluded from the chunk mean (matching q8's zero-poison
+    policy: one NaN must not wipe a 1024-float chunk's information)."""
+    arr = np.ascontiguousarray(arr, np.float32).ravel()
+    n = arr.size
+    n_chunks = -(-n // chunk) if n else 0
+    finite = np.isfinite(arr)
+    clean = np.where(finite, arr, np.float32(0))
+    pad = n_chunks * chunk - n
+    padded = np.pad(clean, (0, pad)).reshape(n_chunks, chunk) if n else clean.reshape(0, 1)
+    counts = np.pad(finite.astype(np.float64), (0, pad)).reshape(n_chunks, chunk).sum(axis=1) if n else np.zeros(0)
+    sums = np.abs(padded).sum(axis=1, dtype=np.float64)  # f64: ulp-stable chunk means
+    scales = np.where(counts > 0, sums / np.maximum(counts, 1), 0.0).astype(np.float32)
+    bits = np.packbits((clean < 0).astype(np.uint8))
+    return SIGN_MAGIC + np.uint64(n).tobytes() + scales.tobytes() + bits.tobytes()
+
+
+def sign_decode(
+    payload: bytes, chunk: int = Q8_CHUNK,
+    max_floats: int = TOPK_MAX_DECODE_FLOATS,
+) -> np.ndarray:
+    """Inverse of sign_encode: dense f32 of ±chunk-scale. ``max_floats``
+    bounds the allocation (the u64 n is sender-controlled — same
+    resource-exhaustion guard as topk/powersgd decodes)."""
+    if len(payload) < _SIGN_HDR or payload[:3] != SIGN_MAGIC:
+        raise ValueError("sign payload: bad header")
+    n = int(np.frombuffer(payload[3:11], np.uint64)[0])
+    if n > max_floats:
+        raise ValueError(f"sign payload: n={n} exceeds decode cap {max_floats}")
+    if len(payload) != sign_coded_size(n, chunk):
+        raise ValueError(
+            f"sign payload {len(payload)}B != expected {sign_coded_size(n, chunk)}B for n={n}"
+        )
+    n_chunks = -(-n // chunk) if n else 0
+    scales = np.frombuffer(payload[_SIGN_HDR : _SIGN_HDR + 4 * n_chunks], np.float32)
+    bits = np.unpackbits(
+        np.frombuffer(payload[_SIGN_HDR + 4 * n_chunks :], np.uint8), count=n
+    )
+    signs = np.where(bits == 1, np.float32(-1.0), np.float32(1.0))
+    pad = n_chunks * chunk - n
+    out = (
+        np.pad(signs, (0, pad)).reshape(n_chunks, chunk) * scales[:, None]
+    ).reshape(-1)[:n].astype(np.float32)
+    return np.ascontiguousarray(out)
+
+
 # Top-k sparse wire codec (Deep-Gradient-Compression style): ship only the
 # largest-magnitude entries. Self-describing header so the decoder needs no
 # out-of-band state; falls back to dense when sparsity wouldn't pay.
@@ -349,12 +423,6 @@ def topk_encode(arr: np.ndarray, frac: float | None = None) -> bytes:
     return header + idx.tobytes() + arr[idx].tobytes()
 
 
-# Decode-allocation ceiling when the caller has no schema to bound by:
-# 2^29 f32 = the 2 GiB transport MAX_PAYLOAD expressed in floats. A sparse
-# frame's uint64 n is attacker-controlled (a ~100-byte frame can claim any
-# n), so the dense reconstruction must never exceed what a dense payload of
-# the transport's own cap could have shipped.
-TOPK_MAX_DECODE_FLOATS = 1 << 29
 
 
 def topk_decode(

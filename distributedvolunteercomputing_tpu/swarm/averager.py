@@ -41,6 +41,12 @@ from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer, un
 
 log = get_logger(__name__)
 
+# Sign-wire result-leg tag: a round result over the sign wire is q8 bytes
+# behind this magic, so the receive path can tell it from a 1-bit
+# contribution (SG1) by construction (raw q8's leading u64 count could
+# collide with SG1 for unlucky model sizes).
+_SIGN_RESULT_MAGIC = b"SQ8"
+
 
 class _Round:
     """Leader-side state for one gather round."""
@@ -93,8 +99,20 @@ class AveragerBase:
         powersgd_rank: int = 4,
         adaptive_timeout: bool = False,
     ):
-        if wire not in ("f32", "bf16", "q8", "topk", "powersgd"):
+        if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
+        if wire == "sign":
+            # 1-bit EF-signSGD is a GRADIENT compressor for gather-style
+            # protocols (the topk reasoning: pairwise mixing compounds the
+            # quantization per hop with no error feedback; sign of a
+            # parameter tree is meaningless). Unlike topk it composes with
+            # the robust estimators — reconstructions are DENSE ±scale
+            # vectors, ordinary rows to krum/trimmed/bulyan.
+            if self.mode not in ("sync", "byzantine"):
+                raise ValueError(
+                    f"wire='sign' is not supported for {self.mode} averaging "
+                    "(gather-style sync/byzantine only)"
+                )
         if wire == "powersgd":
             # Low-rank is a GRADIENT compressor for gather-style protocols,
             # same reasoning as topk below — but unlike topk it composes
@@ -313,7 +331,7 @@ class AveragerBase:
         """Compressor state worth persisting, as a flat npz-able dict, or
         None when there is nothing learned yet (dense wires, or no round
         has run)."""
-        if self.wire not in ("topk", "powersgd"):
+        if self.wire not in ("topk", "powersgd", "sign"):
             return None
         out: dict = {"wire": np.bytes_(self.wire.encode())}
         ef = self._ef_residual
@@ -401,6 +419,18 @@ class AveragerBase:
             # truncation there would be silent, uncorrected error — the
             # same dense-results policy as topk above.
             return self._psgd().encode_dense(buf)
+        if self.wire == "sign":
+            # Results ship q8, NOT 1-bit: the result path has no error
+            # feedback, and a sign-quantized aggregate would hand every
+            # member an uncorrected ±scale caricature of the mean. q8 is
+            # the same near-exact result fidelity the q8 wire itself runs on
+            # (per-chunk scales, idempotent round-trip), at 1/4 the f32
+            # bytes — so the sign wire's fetch leg matches the q8 wire and
+            # its push leg is 32x. Tagged with its own magic: raw q8 starts
+            # with a u64 count whose low bytes CAN collide with SIGN_MAGIC
+            # for unlucky model sizes (n % 2^24 == 0x314753), so the two
+            # legs must be distinguishable by construction, not probability.
+            return _SIGN_RESULT_MAGIC + native.q8_encode(buf)
         return buf.tobytes()
 
     def _compress_contribution(
@@ -415,7 +445,7 @@ class AveragerBase:
         codec this is (_to_wire, lazy decode of the same bytes); the dense
         view is lazy because sync members never need it — only the leader
         and the byzantine path stack their own contribution."""
-        if self.wire not in ("topk", "powersgd"):
+        if self.wire not in ("topk", "powersgd", "sign"):
             wire = self._to_wire(buf)
             if self.wire == "f32":
                 return wire, lambda: buf
@@ -432,6 +462,9 @@ class AveragerBase:
             # Own round-trip: the exact size is known — don't let the
             # anti-abuse default cap reject a legitimately huge model.
             sent = powersgd.decode(wire, max_floats=buf.size)
+        elif self.wire == "sign":
+            wire = native.sign_encode(buf)
+            sent = native.sign_decode(wire, max_floats=buf.size)
         else:
             wire = native.topk_encode(buf, frac=self._effective_topk_frac())
             # Own round-trip: exact size known — same anti-abuse-cap
@@ -490,6 +523,20 @@ class AveragerBase:
             return native.topk_decode(
                 payload, max_floats=sum(s.size for s in self._specs)
             )
+        if self.wire == "sign":
+            if payload[:3] == native.SIGN_MAGIC:
+                # A 1-bit contribution: n is sender-controlled and expands
+                # 32x on decode — same pre-schema deferral as topk below.
+                if self._specs is None:
+                    return None
+                return native.sign_decode(
+                    payload, max_floats=sum(s.size for s in self._specs)
+                )
+            if payload[:3] == _SIGN_RESULT_MAGIC:
+                # Round RESULT leg: tagged q8 (see _to_wire) — linear 4x
+                # expansion, bounded by the payload's own size, no deferral.
+                return native.q8_decode(payload[3:])
+            raise ValueError("sign-wire payload with unknown leg tag")
         if self.wire == "powersgd":
             # Self-describing container (low-rank contributions AND dense
             # results). The decode is capped at EXACTLY the expected size —

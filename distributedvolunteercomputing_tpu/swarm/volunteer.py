@@ -209,6 +209,16 @@ class VolunteerConfig:
                 raise ValueError(
                     f"powersgd_rank must be >= 1, got {self.powersgd_rank}"
                 )
+        if self.wire == "sign":
+            # Same config-time policy as topk/powersgd: 1-bit EF-signSGD is
+            # a gradient compressor for gather-style protocols. Robust
+            # estimators ARE allowed (dense ±scale reconstructions).
+            if self.average_what != "grads":
+                raise ValueError("wire='sign' requires --average-what grads")
+            if self.averaging not in ("sync", "byzantine"):
+                raise ValueError(
+                    "wire='sign' requires --averaging sync or byzantine"
+                )
         if self.wire == "topk":
             # Fail at config time, before the transport binds or membership
             # announces anything. Top-k of a parameter tree would zero most

@@ -31,7 +31,7 @@ def main() -> None:
     args = ap.parse_args()
 
     rows_by_wire = {}
-    for wire in ("f32", "bf16", "q8", "topk", "powersgd"):
+    for wire in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
         common = MODEL + [
             "--averaging", "sync", "--average-what", "grads", "--wire", wire,
             "--steps", str(args.steps), "--batch-size", "8",

@@ -66,7 +66,7 @@ def main() -> None:
     ap.add_argument("--average-what", default="params", choices=("params", "grads"),
                     help="params = local-SGD periodic averaging; grads = GradientAverager")
     ap.add_argument("--wire", default="f32",
-                    choices=("f32", "bf16", "q8", "topk", "powersgd"),
+                    choices=("f32", "bf16", "q8", "topk", "powersgd", "sign"),
                     help="WAN payload codec; bf16 halves DCN traffic, q8 "
                          "quarters it (chunked int8, <=0.4%% element error), "
                          "topk ships only the largest-magnitude gradient "
@@ -74,7 +74,10 @@ def main() -> None:
                          "sync/byzantine; ~50x fewer bytes at default frac), "
                          "powersgd ships rank-r factor pairs per tensor "
                          "(grads mode, sync/byzantine; composes with robust "
-                         "methods, unlike topk)")
+                         "methods, unlike topk), sign ships 1-bit EF-signSGD "
+                         "gradients (~32x fewer push bytes; q8 results; "
+                         "grads mode, sync/byzantine; composes with robust "
+                         "methods)")
     ap.add_argument("--topk-frac", type=float, default=0.01,
                     help="fraction of gradient entries kept per round by "
                          "--wire topk")

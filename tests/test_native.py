@@ -371,3 +371,109 @@ class TestTopkCodec:
         np.testing.assert_allclose(
             np.sort(np.abs(dense[dense != 0])), np.sort(np.abs(dense_np[dense_np != 0]))
         )
+
+
+class TestSignCodec:
+    """1-bit EF-signSGD wire (native.sign_encode/decode): format, scales,
+    resource caps, and the gather-path integration with error feedback."""
+
+    def test_roundtrip_signs_and_chunk_scale(self):
+        rng = np.random.default_rng(0)
+        arr = rng.standard_normal(3000).astype(np.float32)
+        enc = native.sign_encode(arr)
+        # ~1 bit/coord + one f32 scale per 1024-chunk + 11B header
+        assert len(enc) <= 11 + 4 * 3 + (3000 + 7) // 8
+        dec = native.sign_decode(enc)
+        assert dec.shape == arr.shape
+        nz = arr != 0
+        np.testing.assert_array_equal(np.sign(dec[nz]), np.sign(arr[nz]))
+        # per-chunk magnitude = mean |x| over the chunk
+        np.testing.assert_allclose(
+            np.abs(dec[:1024]), np.abs(arr[:1024]).mean(), rtol=1e-6
+        )
+
+    def test_nonfinite_excluded_from_scale(self):
+        arr = np.ones(100, np.float32)
+        arr[3] = np.inf
+        arr[4] = np.nan
+        dec = native.sign_decode(native.sign_encode(arr))
+        assert np.isfinite(dec).all()
+        # the 98 finite ones still carry scale ~1.0 (NaN/inf excluded from
+        # the mean rather than poisoning/zeroing the chunk)
+        np.testing.assert_allclose(np.abs(dec[5:]), 1.0, rtol=1e-6)
+
+    def test_decode_allocation_capped_and_malformed_rejected(self):
+        evil = b"SG1" + np.uint64(1 << 40).tobytes() + b"\x00" * 4
+        with pytest.raises(ValueError, match="decode cap"):
+            native.sign_decode(evil)
+        good = native.sign_encode(np.ones(64, np.float32))
+        with pytest.raises(ValueError, match="decode cap"):
+            native.sign_decode(good, max_floats=8)
+        with pytest.raises(ValueError):
+            native.sign_decode(good[:-1])  # truncated
+        with pytest.raises(ValueError):
+            native.sign_decode(b"XX" + good[2:])  # bad magic
+
+    def test_sign_wire_end_to_end_with_error_feedback(self):
+        """Sync rounds over the sign wire: round 1 ships sign*mean-|x|; the
+        quantization error banks in the EF residual and round 2's
+        contribution (zeros + residual) still moves mass."""
+        from tests.test_averaging import make_tree, spawn_volunteers, teardown
+        from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+
+        async def main():
+            vols = await spawn_volunteers(2, SyncAverager, wire="sign")
+            try:
+                r1 = await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 1),
+                    vols[1][3].average(make_tree(3.0), 1),
+                )
+                resid = [v[3]._ef_residual for v in vols]
+                r2 = await asyncio.gather(
+                    vols[0][3].average(make_tree(0.0), 2),
+                    vols[1][3].average(make_tree(0.0), 2),
+                )
+                return r1, resid, r2
+            finally:
+                await teardown(vols)
+
+        (ra, rb), resid, (ra2, rb2) = asyncio.run(asyncio.wait_for(main(), timeout=60))
+        assert ra is not None and rb is not None
+        # make_tree values are constant per leaf, so sign*mean-|chunk| is
+        # nearly exact for uniform trees — but the w leaf (1.0) and b leaf
+        # (2.0) share a 1024-chunk, so the shared scale leaves residual.
+        assert all(r is not None for r in resid)
+        assert ra2 is not None and rb2 is not None
+        # round-1 result: mean of the two contributions' reconstructions,
+        # sign-correct and near the true mean (2.0 for w, 4.0 for b)
+        assert 1.0 < float(np.mean(ra["w"])) < 3.2
+
+    def test_sign_composes_with_robust_estimator(self):
+        """Byzantine averaging over the sign wire: reconstructions are
+        dense, so trimmed-mean bounds an attacker's ±huge-scale rows."""
+        from tests.test_averaging import make_tree, spawn_volunteers, teardown
+        from distributedvolunteercomputing_tpu.swarm.averager import (
+            ByzantineAverager,
+        )
+
+        async def main():
+            vols = await spawn_volunteers(
+                4, ByzantineAverager, wire="sign", method="trimmed_mean",
+                min_group=4,
+            )
+            try:
+                trees = [make_tree(1.0), make_tree(1.2), make_tree(0.8),
+                         make_tree(1000.0)]  # one wild contributor
+                rs = await asyncio.gather(
+                    *(vols[i][3].average(trees[i], 1) for i in range(4))
+                )
+                return rs
+            finally:
+                await teardown(vols)
+
+        rs = asyncio.run(asyncio.wait_for(main(), timeout=60))
+        done = [r for r in rs if r is not None]
+        assert len(done) >= 3
+        for r in done[:3]:
+            # trimmed mean drops the 1000-scale row: result stays ~1
+            assert float(np.abs(r["w"]).max()) < 10.0
