@@ -88,6 +88,7 @@ class VolunteerConfig:
     lr: float = 1e-3
     seed: int = 0  # per-volunteer: data order + step rng
     init_seed: int = 0  # TASK-constant: shared initial params (see Trainer)
+    param_dtype: Optional[str] = None  # e.g. "bfloat16" for bf16 training
     steps: int = 1000
     target_loss: Optional[float] = None
     # "stop" ends the run at the target; "record" trains the full --steps
@@ -151,6 +152,21 @@ class VolunteerConfig:
                 )
             if self.averaging == "none":
                 raise ValueError("--average-interval-s requires an averaging mode")
+        if self.param_dtype:
+            import jax.numpy as jnp
+
+            try:
+                dt = jnp.dtype(self.param_dtype)
+            except TypeError:
+                raise ValueError(
+                    f"unknown --param-dtype {self.param_dtype!r}"
+                ) from None
+            if not jnp.issubdtype(dt, jnp.floating):
+                # int8 would truncate weights at the cast and TypeError in
+                # jax.grad at step 1 — fail here, not after transport binds.
+                raise ValueError(
+                    f"--param-dtype must be a floating dtype, got {dt}"
+                )
         # Fail at config time, not per round: an unknown method (or kwarg)
         # would raise inside every averaging round, be swallowed by the
         # round-failure containment, and leave the volunteer training solo
@@ -464,6 +480,7 @@ class Volunteer:
             lr=self.cfg.lr,
             seed=self.cfg.seed,
             init_seed=self.cfg.init_seed,
+            param_dtype=self.cfg.param_dtype,
             accum_steps=self.cfg.accum_steps,
             average_every=self.cfg.average_every,
             average_interval_s=self.cfg.average_interval_s,

@@ -58,6 +58,10 @@ class Trainer:
         lr: float = 1e-3,
         seed: int = 0,
         init_seed: int = 0,
+        # Cast floating params to this dtype after init ("bfloat16" for
+        # bf16 training — the bench's DVC_BENCH_PARAM_DTYPE arm, now a
+        # first-class trainer/CLI option); None keeps the model's dtype.
+        param_dtype: Optional[str] = None,
         # Microbatch count per optimizer step (gradient accumulation inside
         # the compiled step); batch_size must divide evenly. Semantics match
         # one big batch — only peak activation memory changes.
@@ -206,6 +210,21 @@ class Trainer:
         _, data_rng, state_rng = jax.random.split(rng, 3)
         self.tx = make_optimizer(optimizer, lr=lr, total_steps=total_steps)
         params = bundle.init(jax.random.PRNGKey(init_seed))
+        if param_dtype:
+            # bf16 training (params + optimizer moments + every matmul in
+            # the dtype): halves param/optimizer HBM and runs the MXU at
+            # native rate. Floating leaves only — integer tables and the
+            # step counter keep their dtypes. The swarm tier is
+            # dtype-agnostic by construction (flatten_to_buffer ships f32
+            # and restores per-leaf dtypes), and init stays bit-identical
+            # across volunteers BEFORE the cast, so the task-constant
+            # init_seed contract above still holds.
+            dt = jnp.dtype(param_dtype)
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(dt)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params,
+            )
         self.state = TrainState.create(params, self.tx, state_rng)
         # Gradient-averaging mode splits the step so grads can cross the WAN
         # between bwd and the optimizer (reference GradientAverager

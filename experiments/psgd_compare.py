@@ -59,6 +59,9 @@ def main() -> None:
         "topk": arm("topk", ["--wire", "topk", "--topk-frac", "0.01"]),
         "psgd4": arm("psgd4", ["--wire", "powersgd", "--psgd-rank", "4"]),
         "psgd8": arm("psgd8", ["--wire", "powersgd", "--psgd-rank", "8"]),
+        # r5: the 1-bit EF-signSGD rung at transformer scale (the mnist
+        # table saturates; this is where codec convergence actually ranks).
+        "sign": arm("sign", ["--wire", "sign"]),
     }
     out = os.path.join(RESULTS, "psgd_compare.jsonl")
     with open(out, "w") as fh:

@@ -139,6 +139,12 @@ def main() -> None:
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--seed", type=int, default=0,
                     help="per-volunteer seed (data order + step rng)")
+    ap.add_argument("--param-dtype", default=None,
+                    help="cast floating params to this dtype after init "
+                         "(e.g. bfloat16: halves param/optimizer HBM, native "
+                         "MXU rate). Part of the averaging schema, so every "
+                         "volunteer on a task must use the same dtype — a "
+                         "mismatch refuses rounds rather than corrupting them")
     ap.add_argument("--init-seed", type=int, default=0,
                     help="TASK-constant seed for the initial params; must match "
                          "across the swarm (for LoRA it pins the shared frozen base)")
@@ -229,6 +235,7 @@ def main() -> None:
         lr=args.lr,
         seed=args.seed,
         init_seed=args.init_seed,
+        param_dtype=args.param_dtype,
         steps=args.steps,
         target_loss=args.target_loss,
         target_mode=args.target_mode,

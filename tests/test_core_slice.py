@@ -435,3 +435,35 @@ class TestTrainerLocalSGD:
         # params adopted from averager at step 10... then no further steps ran
         leaf = jax.tree_util.tree_leaves(t.state.params)[0]
         assert float(jnp.abs(leaf).sum()) == 0.0
+
+
+def test_trainer_param_dtype_bf16():
+    """--param-dtype bfloat16: params AND optimizer moments run in bf16
+    (the bench's DVC_BENCH_PARAM_DTYPE arm as a first-class option);
+    training stays finite and integer leaves keep their dtypes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedvolunteercomputing_tpu.models import get_model
+    from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+    t = Trainer(
+        get_model("mnist_mlp"), batch_size=16, lr=1e-2, optimizer="adam",
+        param_dtype="bfloat16",
+    )
+    s = t.run(steps=5, log_every=0)
+    assert np.isfinite(s["final_loss"])
+    leaves = jax.tree_util.tree_leaves(t.state.params)
+    assert all(
+        l.dtype == jnp.bfloat16
+        for l in leaves if jnp.issubdtype(l.dtype, jnp.floating)
+    )
+    # config-time validation of the dtype name
+    import pytest
+
+    from distributedvolunteercomputing_tpu.swarm.volunteer import VolunteerConfig
+
+    with pytest.raises(ValueError, match="param-dtype"):
+        VolunteerConfig(coordinator="x:1", param_dtype="float17")
+    assert VolunteerConfig(coordinator="x:1", param_dtype="bfloat16").param_dtype
