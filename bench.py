@@ -622,11 +622,9 @@ def _bench_main() -> int:
         stage = "init"
         params = bundle.init(jax.random.PRNGKey(1))
         if param_dtype:
-            dt = jnp.dtype(param_dtype)
-            params = jax.tree_util.tree_map(
-                lambda x: x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                params,
-            )
+            from distributedvolunteercomputing_tpu.utils.pytree import cast_floating
+
+            params = cast_floating(params, param_dtype)
         n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
         stage = "opt_init"
         state = TrainState.create(params, tx, jax.random.PRNGKey(2))

@@ -331,6 +331,20 @@ def maybe_restore(trainer, ckpt_dir: str) -> bool:
         step=restored["step"],
         rng=restored["rng"],
     )
+    if getattr(trainer, "param_dtype", None):
+        # Re-apply the CONFIGURED dtype over whatever the snapshot holds:
+        # a snapshot taken before a fleet-wide --param-dtype change would
+        # otherwise silently restore the old dtype, flip this volunteer's
+        # averaging schema hash away from its peers', and strand it
+        # training solo (every round refused by _check_schema).
+        from distributedvolunteercomputing_tpu.utils.pytree import cast_floating
+
+        host_state = TrainState(
+            params=cast_floating(host_state.params, trainer.param_dtype),
+            opt_state=cast_floating(host_state.opt_state, trainer.param_dtype),
+            step=host_state.step,
+            rng=host_state.rng,
+        )
     if trainer.mesh is not None:
         # A mesh trainer's state lives SHARDED (tp/pp rules; 1/dp per chip
         # under fsdp). Place the restored HOST trees directly with the

@@ -210,6 +210,7 @@ class Trainer:
         _, data_rng, state_rng = jax.random.split(rng, 3)
         self.tx = make_optimizer(optimizer, lr=lr, total_steps=total_steps)
         params = bundle.init(jax.random.PRNGKey(init_seed))
+        self.param_dtype = param_dtype
         if param_dtype:
             # bf16 training (params + optimizer moments + every matmul in
             # the dtype): halves param/optimizer HBM and runs the MXU at
@@ -219,12 +220,9 @@ class Trainer:
             # and restores per-leaf dtypes), and init stays bit-identical
             # across volunteers BEFORE the cast, so the task-constant
             # init_seed contract above still holds.
-            dt = jnp.dtype(param_dtype)
-            params = jax.tree_util.tree_map(
-                lambda x: x.astype(dt)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                params,
-            )
+            from distributedvolunteercomputing_tpu.utils.pytree import cast_floating
+
+            params = cast_floating(params, param_dtype)
         self.state = TrainState.create(params, self.tx, state_rng)
         # Gradient-averaging mode splits the step so grads can cross the WAN
         # between bwd and the optimizer (reference GradientAverager

@@ -85,3 +85,18 @@ def tree_size_bytes(tree: Any) -> int:
 
 def tree_zeros_like(tree: Any) -> Any:
     return jax.tree_util.tree_map(lambda x: np.zeros_like(np.asarray(x)), tree)
+
+
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast every FLOATING leaf to ``dtype``, leaving integer tables, bools,
+    and step counters untouched — the one bf16-training cast shared by the
+    Trainer's param_dtype, the bench's DVC_BENCH_PARAM_DTYPE arm, and
+    checkpoint restore (which must re-apply a configured dtype over a
+    snapshot taken under another one)."""
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree,
+    )

@@ -73,6 +73,7 @@ while [ "$LOOPS" -lt 80 ]; do
         bench_arm spc8 400 DVC_BENCH_REMAT=0 DVC_BENCH_STEPS_PER_CALL=8 DVC_BENCH_CHILD_DEADLINE=380
         bench_arm accum2 360 DVC_BENCH_REMAT=0 DVC_BENCH_ACCUM=2 DVC_BENCH_CHILD_DEADLINE=340
         bench_arm bf16 300 DVC_BENCH_REMAT=0 DVC_BENCH_PARAM_DTYPE=bfloat16 DVC_BENCH_CHILD_DEADLINE=280
+        bench_arm bf16_flash 300 DVC_BENCH_REMAT=0 DVC_BENCH_PARAM_DTYPE=bfloat16 DVC_ATTN_IMPL=flash DVC_BENCH_CHILD_DEADLINE=280
         bench_arm remat_on 300 DVC_BENCH_CHILD_DEADLINE=280
         bench_arm medium 500 DVC_BENCH_MODEL=gpt2_medium DVC_BENCH_REMAT=0 DVC_BENCH_CHILD_DEADLINE=480
         bench_arm medium_accum2 500 DVC_BENCH_MODEL=gpt2_medium DVC_BENCH_REMAT=0 DVC_BENCH_ACCUM=2 DVC_BENCH_CHILD_DEADLINE=480

@@ -459,6 +459,13 @@ def test_trainer_param_dtype_bf16():
         l.dtype == jnp.bfloat16
         for l in leaves if jnp.issubdtype(l.dtype, jnp.floating)
     )
+    # ...and the optimizer moments followed (the "halves param/optimizer
+    # HBM" claim): every floating leaf of the opt state is bf16 too.
+    opt_leaves = [
+        l for l in jax.tree_util.tree_leaves(t.state.opt_state)
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)
+    ]
+    assert opt_leaves and all(l.dtype == jnp.bfloat16 for l in opt_leaves)
     # config-time validation of the dtype name
     import pytest
 
@@ -467,3 +474,34 @@ def test_trainer_param_dtype_bf16():
     with pytest.raises(ValueError, match="param-dtype"):
         VolunteerConfig(coordinator="x:1", param_dtype="float17")
     assert VolunteerConfig(coordinator="x:1", param_dtype="bfloat16").param_dtype
+
+
+def test_param_dtype_reapplied_on_restore(tmp_path):
+    """A snapshot taken at f32 restored into a --param-dtype bfloat16
+    trainer must come back CAST: restoring the old dtype verbatim would
+    flip the averaging schema hash away from same-config peers and strand
+    the volunteer solo (round-5 review finding)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedvolunteercomputing_tpu.models import get_model
+    from distributedvolunteercomputing_tpu.training import checkpoint
+    from distributedvolunteercomputing_tpu.training.trainer import Trainer
+
+    t1 = Trainer(get_model("mnist_mlp"), batch_size=8, lr=1e-2)
+    t1.run(steps=2, log_every=0)
+    checkpoint.save(t1, str(tmp_path))
+
+    t2 = Trainer(
+        get_model("mnist_mlp"), batch_size=8, lr=1e-2, param_dtype="bfloat16"
+    )
+    assert checkpoint.maybe_restore(t2, str(tmp_path))
+    assert int(t2.state.step) == 2
+    leaves = jax.tree_util.tree_leaves(t2.state.params)
+    assert all(
+        l.dtype == jnp.bfloat16
+        for l in leaves if jnp.issubdtype(l.dtype, jnp.floating)
+    )
+    s = t2.run(steps=2, log_every=0)
+    assert np.isfinite(s["final_loss"])
