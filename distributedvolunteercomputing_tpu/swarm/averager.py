@@ -1295,9 +1295,17 @@ class ByzantineAverager(AveragerBase):
                     )
                     kw["trim"] = feasible
             else:
-                # Derived default: trim 1/4 of peers per side when the group
-                # is big enough; trim=0 degrades gracefully to the mean.
-                trim = kw.setdefault("trim", len(peers) // 4)
+                # Derived default: trim 1/4 of peers per side, but NEVER
+                # zero once a group is big enough to afford any trimming —
+                # byzantine mode with trim=0 is a plain mean that includes
+                # an attacker at full weight, exactly the silent
+                # no-protection state this mode exists to rule out (r5
+                # review: len//4 alone is 0 for the 3..7-peer groups real
+                # churn produces; at n=3 trim=1 degenerates to the
+                # coordinate median — strictly more robust than the mean).
+                trim = kw.setdefault(
+                    "trim", max(1, len(peers) // 4) if len(peers) >= 3 else 0
+                )
                 if trim * 2 >= len(peers):
                     kw["trim"] = 0
         self.rounds_ok += 1

@@ -397,11 +397,18 @@ def config8_kitchen_sink_r4():
     )
     agg = record("config8_outer_interval_spc_byz_churn", rows)
 
-    gcommon = ["--model", "gpt2_small", *TINY_GPT2, "--averaging", "byzantine",
-               "--method", "trimmed_mean", "--average-what", "grads",
-               "--wire", "powersgd", "--psgd-rank", "4",
-               "--steps", "30", "--batch-size", "8", "--lr", "0.003",
-               "--min-group", "2", *TIMEOUTS]
+    # Shared base for the grads-mode byzantine-with-attacker arms. The
+    # EXPLICIT trim=1 is load-bearing (r5 review finding): the derived
+    # default trim = n_peers//4 is ZERO for the 3-peer groups these arms
+    # spend most rounds in (3 starters, or 4 minus a kill), i.e. a plain
+    # mean that would include the attacker at full weight — the explicit
+    # value is clamped per-round to what the group size admits.
+    byz_grads = ["--model", "gpt2_small", *TINY_GPT2, "--averaging", "byzantine",
+                 "--method", "trimmed_mean", "--method-kw", "trim=1",
+                 "--average-what", "grads",
+                 "--steps", "30", "--batch-size", "8", "--lr", "0.003",
+                 "--min-group", "2", *TIMEOUTS]
+    gcommon = byz_grads + ["--wire", "powersgd", "--psgd-rank", "4"]
     grows = run_swarm(
         "config8/psgd_byz",
         [("psgd0", gcommon + ["--seed", "0"]),
@@ -410,7 +417,19 @@ def config8_kitchen_sink_r4():
         chaos_peer=("psgd2", "-3.0"),  # byzantine-valued contributions
     )
     agg2 = record("config8_psgd_byzantine_wire", grows)
-    return {"params_trio": agg, "psgd_byz": agg2}
+
+    # r5: the 1-bit sign wire under the same byzantine-with-attacker shape
+    # AND kill -9 churn — grads mode, a x-3 chaos peer plus an un-graceful
+    # death among four volunteers; honest survivors must converge.
+    scommon = byz_grads + ["--wire", "sign"]
+    srows = run_swarm(
+        "config8/sign_byz",
+        [(f"sgn{i}", scommon + ["--seed", str(i)]) for i in range(4)],
+        chaos_peer=("sgn3", "-3.0"),
+        kill_after=(25.0, 2),
+    )
+    agg3 = record("config8_sign_byzantine_churn", srows)
+    return {"params_trio": agg, "psgd_byz": agg2, "sign_byz": agg3}
 
 
 CONFIGS = {

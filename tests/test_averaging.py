@@ -914,3 +914,31 @@ class TestExplicitTrimClamp:
             assert r is not None
             # With the old silent trim=0, the 1e9 row makes the mean ~2.5e8.
             assert float(np.abs(r["w"]).max()) < 10.0
+
+
+class TestDerivedTrimFloor:
+    def test_three_peer_group_still_trims(self):
+        """Derived trimmed-mean trim must never be 0 once the group can
+        afford trimming (r5 review: len//4 alone was 0 for 3..7-peer
+        groups — byzantine mode silently ran a plain mean through exactly
+        the churned group sizes it exists for). At n=3 the derived trim=1
+        degenerates to the coordinate median: the attacker's row cannot
+        move the result past the honest values."""
+        async def main():
+            vols = await spawn_volunteers(
+                3, ByzantineAverager, min_group=3, method="trimmed_mean"
+            )
+            try:
+                return await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 1),
+                    vols[1][3].average(make_tree(1.2), 1),
+                    vols[2][3].average(make_tree(-900.0), 1),
+                )
+            finally:
+                await teardown(vols)
+
+        results = run(main())
+        for r in results[:2]:
+            assert r is not None
+            # median of {1.0, 1.2, -900} is an honest value
+            assert 0.9 < float(np.asarray(r["w"]).mean()) < 1.3, "attacker leaked"
