@@ -473,6 +473,37 @@ class AveragerBase:
         self._ef_pending = buf - sent
         return wire, lambda: sent
 
+    def _robust_kw(self, n_peers: int) -> dict:
+        """Estimator kwargs adjusted to THIS round's group size — shared by
+        the sync and byzantine aggregation paths so neither can regress to
+        an unprotected (or crashing) state the other guards against:
+
+        - explicit trim is clamped (with a warning) to the most robustness
+          the group admits — never silently zeroed;
+        - the DERIVED trim is len//4 floored at 1 once n >= 3: trim=0 under
+          a robust method's name is a plain mean that includes an attacker
+          at full weight (r5 review — len//4 alone was 0 for the 3..7-peer
+          groups real churn produces; n=3 with trim=1 degenerates to the
+          coordinate median, strictly more robust);
+        - n=2 can't trim at all: trim=0 beats a ValueError killing every
+          round (the sync path used to pass the function default trim=1
+          straight through — a 2-peer trimmed_mean swarm failed forever)."""
+        kw = dict(self.method_kw)
+        if self.method != "trimmed_mean":
+            return kw
+        if "trim" in kw:
+            trim = int(kw["trim"])
+            if trim * 2 >= n_peers:
+                feasible = (n_peers - 1) // 2
+                log.warning(
+                    "trimmed_mean trim=%d infeasible for %d peers; "
+                    "clamping to %d this round", trim, n_peers, feasible,
+                )
+                kw["trim"] = feasible
+        else:
+            kw["trim"] = max(1, n_peers // 4) if n_peers >= 3 else 0
+        return kw
+
     def _effective_topk_frac(self) -> float:
         """Current kept fraction under the warmup schedule (see __init__);
         the configured topk_frac once warmup completes or when disabled."""
@@ -811,7 +842,9 @@ class SyncAverager(AveragerBase):
                         native.weighted_sum_inplace(acc, buf_p, w_p / total_w)
                     return acc
                 stack = np.stack([good[p][1] for p in peers])
-                return robust.aggregate(stack, self.method, **dict(self.method_kw))
+                return robust.aggregate(
+                    stack, self.method, **self._robust_kw(len(peers))
+                )
 
             # Seconds of array math at param scale — off the loop (members'
             # fetches park on result_ready; heartbeats must keep flowing).
@@ -1277,37 +1310,9 @@ class ByzantineAverager(AveragerBase):
             return None
         self._commit_ef(True)
         peers = sorted(received)
-        kw = dict(self.method_kw)
+        kw = self._robust_kw(len(peers))
         if self.method == "mean":
             kw["weights"] = np.array([received[p][0] for p in peers])
-        elif self.method == "trimmed_mean":
-            if "trim" in kw:
-                # EXPLICIT operator setting: never silently zero it (that
-                # would be an unprotected mean wearing byzantine's name) —
-                # clamp to the most robustness this round's group size
-                # allows, and say so.
-                trim = int(kw["trim"])
-                if trim * 2 >= len(peers):
-                    feasible = (len(peers) - 1) // 2
-                    log.warning(
-                        "trimmed_mean trim=%d infeasible for %d peers; "
-                        "clamping to %d this round", trim, len(peers), feasible,
-                    )
-                    kw["trim"] = feasible
-            else:
-                # Derived default: trim 1/4 of peers per side, but NEVER
-                # zero once a group is big enough to afford any trimming —
-                # byzantine mode with trim=0 is a plain mean that includes
-                # an attacker at full weight, exactly the silent
-                # no-protection state this mode exists to rule out (r5
-                # review: len//4 alone is 0 for the 3..7-peer groups real
-                # churn produces; at n=3 trim=1 degenerates to the
-                # coordinate median — strictly more robust than the mean).
-                trim = kw.setdefault(
-                    "trim", max(1, len(peers) // 4) if len(peers) >= 3 else 0
-                )
-                if trim * 2 >= len(peers):
-                    kw["trim"] = 0
         self.rounds_ok += 1
         if not degraded:
             self._observe_round_time(time.monotonic() - t0)

@@ -942,3 +942,44 @@ class TestDerivedTrimFloor:
             assert r is not None
             # median of {1.0, 1.2, -900} is an honest value
             assert 0.9 < float(np.asarray(r["w"]).mean()) < 1.3, "attacker leaked"
+
+    def test_sync_robust_small_group_does_not_crash(self):
+        """Sync + trimmed_mean at n=2 used to pass the function default
+        trim=1 straight through -> ValueError inside every round (solo
+        forever); the shared _robust_kw derives trim=0 for n=2 and the
+        round completes as a plain 2-party mean."""
+        async def main():
+            vols = await spawn_volunteers(2, SyncAverager, method="trimmed_mean")
+            try:
+                return await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 1),
+                    vols[1][3].average(make_tree(3.0), 1),
+                )
+            finally:
+                await teardown(vols)
+
+        r0, r1 = run(main())
+        assert r0 is not None and r1 is not None
+        np.testing.assert_allclose(np.asarray(r0["w"]), 2.0, rtol=1e-5)
+
+    def test_sync_robust_derived_trim_bounds_attacker(self):
+        """Sync mode's robust branch derives the same floored trim as
+        byzantine: a 3-peer sync trimmed_mean group rejects a -900 row."""
+        async def main():
+            vols = await spawn_volunteers(
+                3, SyncAverager, min_group=3, method="trimmed_mean"
+            )
+            try:
+                return await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 1),
+                    vols[1][3].average(make_tree(1.2), 1),
+                    vols[2][3].average(make_tree(-900.0), 1),
+                )
+            finally:
+                await teardown(vols)
+
+        results = run(main())
+        done = [r for r in results if r is not None]
+        assert done
+        for r in done[:2]:
+            assert 0.9 < float(np.asarray(r["w"]).mean()) < 1.3
