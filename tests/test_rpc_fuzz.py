@@ -121,3 +121,50 @@ class TestAveragerFuzz:
             for r in results:
                 if r is not None:
                     assert np.isfinite(np.asarray(r["w"])).all()
+
+
+class TestClockSyncFuzz:
+    def test_clock_probe_survives_junk_then_estimates(self):
+        """clock.probe (swarm/clocksync.py) joins the fuzzed surface: junk
+        args/payloads must not wedge the responder, and a peer's estimate()
+        against it still lands after the volley. Also adversarial REPLIES:
+        a peer returning junk 't' shrinks the sample, never crashes."""
+        async def main():
+            from tests.test_averaging import _solo_stack
+            from distributedvolunteercomputing_tpu.swarm.clocksync import ClockSync
+
+            t1, dht1, mem1 = await _solo_stack("cs1")
+            cs1 = ClockSync(t1, mem1)
+            # Second node bootstrapped into the same swarm.
+            t2 = Transport()
+            dht2 = DHTNode(t2)
+            await dht2.start(bootstrap=[t1.addr])
+            mem2 = SwarmMembership(dht2, "cs2", ttl=10.0)
+            await mem2.join()
+            cs2 = ClockSync(t2, mem2)
+            try:
+                client = Transport()
+                await volley(client, t1.addr, ["clock.probe"])
+                # Responder still sane; estimation across the pair works.
+                off = await cs2.estimate()
+                assert cs2.last_estimate_t is not None, "no peer was sampled"
+                assert abs(off) < 2.0  # same host: near-zero offset
+                # Adversarial reply: junk 't' values shrink the sample.
+                async def evil_probe(args, payload):
+                    return {"t": "not-a-float"}, b""
+
+                t1.register("clock.probe", evil_probe)
+                before = cs2.offset
+                await cs2.estimate()
+                # A non-coercible 't' drops the sample entirely: the
+                # offset must be EXACTLY unchanged, not merely close.
+                assert cs2.offset == before
+            finally:
+                for t, mem in ((t1, mem1), (t2, mem2)):
+                    try:
+                        await mem.leave()
+                    except Exception:
+                        pass
+                    await t.close()
+
+        run(main())
