@@ -275,3 +275,29 @@ def test_gpt2_presets_have_expected_scale():
     assert 330e6 < n < 380e6, f"GPT-2 medium should be ~355M params, got {n/1e6:.1f}M"
     n = abstract_params(GPT2Config.large)
     assert 730e6 < n < 810e6, f"GPT-2 large should be ~774M params, got {n/1e6:.1f}M"
+
+
+def test_gpt2_scale_presets_are_registry_names():
+    """gpt2_medium / gpt2_large are first-class registry names (r5: the
+    bench's DVC_BENCH_MODEL and the CLI's --model can name the scale rungs
+    directly), overrides still apply on top, and a tiny-config step runs."""
+    import jax
+    import numpy as np
+
+    from distributedvolunteercomputing_tpu.models import get_model, list_models
+    from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+    from distributedvolunteercomputing_tpu.training.steps import (
+        TrainState, make_train_step,
+    )
+
+    assert "gpt2_medium" in list_models() and "gpt2_large" in list_models()
+    b = get_model("gpt2_medium", n_layers=2, vocab=256, max_len=32)
+    assert b.name == "gpt2_medium"
+    assert b.config.d_model == 1024 and b.config.n_heads == 16  # preset kept
+    assert b.config.n_layers == 2  # override applied on top
+    tx = make_optimizer("adamw", lr=1e-4)
+    st = TrainState.create(b.init(jax.random.PRNGKey(0)), tx, jax.random.PRNGKey(1))
+    step = make_train_step(b.loss_fn, tx)
+    _, m = step(st, b.make_batch(jax.random.PRNGKey(2), 2))
+    assert np.isfinite(float(m["loss"]))
+    assert get_model("gpt2_large", n_layers=1, vocab=64).config.d_model == 1280
