@@ -17,6 +17,18 @@ Churn rules (SURVEY.md §7 hard part a): every tensor message carries the
 round EPOCH from matchmaking; stale/foreign messages are dropped; any
 timeout degrades the round (skip stage / aggregate the subset / return None)
 instead of wedging — a dead peer costs one timeout, never a hang.
+
+Deadline-bounded rounds (OptiReduce genre, PAPERS.md): every gather-style
+round carries an absolute wall-clock DEADLINE on the consensus clock
+(stamped by the leader at begin, from swarm/clocksync.py time). The round
+COMMITS at the deadline with whatever contributions arrived — the weighted
+mean re-normalizes over the subset, excluded peers are recorded and served
+back in the fetch meta — instead of blocking on the slowest participant.
+A straggler therefore costs the round its contribution, never the round
+its deadline. Paired with the phi-accrual failure detector
+(swarm/failure_detector.py) and the adaptive resilience policy
+(swarm/resilience.py), which set the budget and pre-exclude likely
+stragglers from formation in the first place.
 """
 
 from __future__ import annotations
@@ -72,6 +84,10 @@ class _Round:
         # error-feedback residual knows whether its shipped mass landed
         # (a degraded round may have dropped its late push).
         self.included: List[str] = []
+        # Expected peers whose contributions did NOT make the deadline —
+        # recorded at commit, served in fetch meta, and fed to the
+        # resilience policy as this round's absent set.
+        self.excluded: List[str] = []
         self.t0 = time.monotonic()
 
 
@@ -98,6 +114,10 @@ class AveragerBase:
         topk_warmup_rounds: int = 0,
         powersgd_rank: int = 4,
         adaptive_timeout: bool = False,
+        clock: Optional[Callable[[], float]] = None,
+        round_deadline_s: Optional[float] = None,
+        resilience=None,
+        failure_detector=None,
     ):
         if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -181,7 +201,32 @@ class AveragerBase:
         self.dht = dht
         self.membership = membership
         self.peer_id = membership.peer_id
-        self.matchmaker = Matchmaker(transport, dht, self.peer_id)
+        # Consensus wall clock (ClockSync.now from the volunteer): round
+        # deadlines are ABSOLUTE times on this clock, so every member of a
+        # group closes the round at the same instant regardless of skew.
+        # Without one (step-cadence swarms) deadlines fall back to raw wall
+        # time, which volunteer hardware can skew by more than a whole
+        # budget — _deadline_wait then prefers the skew-free local bound.
+        self._clock_synced = clock is not None
+        self.clock = clock or time.time
+        # Static per-round wall budget (seconds); None = the adaptive/
+        # configured gather timeout. The resilience policy, when attached,
+        # supersedes both with its learned deadline.
+        self.round_deadline_s = round_deadline_s
+        self.resilience = resilience
+        self.failure_detector = failure_detector
+        # Straggler pre-exclusion predicate consulted when WE lead group
+        # formation: policy (phi + outcome history) when present, raw phi
+        # suspicion otherwise.
+        if resilience is not None:
+            exclude = resilience.should_preexclude
+        elif failure_detector is not None:
+            exclude = failure_detector.suspect
+        else:
+            exclude = None
+        self.matchmaker = Matchmaker(
+            transport, dht, self.peer_id, clock=self.clock, exclude=exclude
+        )
         self.min_group = min_group
         self.max_group = max_group
         self.gather_timeout = gather_timeout
@@ -210,6 +255,17 @@ class AveragerBase:
         self._rt_ewma: Optional[float] = None
         self._rt_ewdev = 0.0
         self._round_degraded = False
+        # Rounds that COMMITTED at the deadline with a partial group (vs
+        # blocking on the slowest peer) — the deadline-bounded commit path.
+        self.rounds_degraded = 0
+        # Per-peer outcome detail for the round in flight, filled by the
+        # paths that know it (leader gather, byzantine mesh) and flushed to
+        # the resilience policy once per average() call. The epoch tags
+        # which round those outcomes (and the policy's absent/late
+        # reconciliation) belong to — late pushes for OLDER epochs are not
+        # re-reported (their miss was already counted at their own flush).
+        self._last_outcomes: Optional[dict] = None
+        self._last_outcomes_epoch: Optional[str] = None
 
     @property
     def round_key(self) -> str:
@@ -262,6 +318,82 @@ class AveragerBase:
             return self.gather_timeout
         est = self._rt_ewma + 4.0 * self._rt_ewdev + 1.0
         return float(min(self.gather_timeout, max(est, 2.0)))
+
+    # -- deadline-bounded rounds -------------------------------------------
+
+    def _round_budget(self) -> float:
+        """Wall-clock budget (seconds) for the NEXT round: the resilience
+        policy's learned deadline when attached, else the static
+        ``round_deadline_s``, else the (possibly EWMA-adapted) gather
+        timeout. The leader stamps ``clock() + budget`` into the begin."""
+        if self.resilience is not None:
+            return float(self.resilience.round_budget())
+        if self.round_deadline_s:
+            return float(self.round_deadline_s)
+        return self.effective_gather_timeout
+
+    def _deadline_remaining(self, group) -> Optional[float]:
+        """Seconds until the group's commit deadline, or None when the
+        begin carried none. Skew guard: without a ClockSync the deadline is
+        raw wall time, and clocks on volunteer hardware can disagree by
+        more than the whole budget — a member running ahead of the leader
+        would see every round as already expired and collapse every wait to
+        the floor (timing out its own pushes round after round, straight
+        into pre-exclusion). The budget counted from when WE learned the
+        round is skew-free; we learned it after the stamp, so it errs only
+        toward waiting a little longer (the begin fan-out time)."""
+        if group is None or group.deadline is None:
+            return None
+        if group.budget is not None and not self._clock_synced:
+            return group.budget - (time.monotonic() - group.formed_mono)
+        return group.deadline - self.clock()
+
+    def _deadline_wait(self, group, floor: float = 0.5) -> float:
+        """Seconds this node may still wait before the group's deadline.
+
+        Clamped: the floor keeps a round that formed slowly (fan-out spent
+        the budget) from committing with nothing at all, and the ceiling
+        bounds a crafted/skewed deadline from a foreign leader to what this
+        node would have waited anyway."""
+        ceiling = max(self.gather_timeout, self._round_budget())
+        remaining = self._deadline_remaining(group)
+        if remaining is None:
+            return min(self._round_budget(), ceiling)
+        return float(min(max(remaining, floor), ceiling))
+
+    async def _maybe_backoff(self) -> None:
+        """Honor the policy's retry backoff after consecutive failed rounds
+        (a partitioned volunteer stops paying full matchmaking cadence)."""
+        if self.resilience is not None:
+            delay = self.resilience.backoff_s()
+            if delay > 0:
+                log.info("%s round backoff %.1fs after failures", self.mode, delay)
+                await asyncio.sleep(delay)
+
+    def _flush_round_outcome(self, duration_s: float, ok: bool) -> None:
+        """Report the finished round to the resilience policy (once per
+        average() call; per-peer detail only where this node observed it)."""
+        if self.resilience is None:
+            self._last_outcomes = None
+            return
+        detail = self._last_outcomes or {}
+        self._last_outcomes = None
+        self.resilience.record_round(
+            duration_s=duration_s,
+            ok=ok,
+            degraded=self._round_degraded,
+            **detail,
+        )
+
+    def _effective_method(self, n_peers: int) -> Tuple[str, dict]:
+        """(method, kwargs) to aggregate with THIS round. Consults the
+        policy's runtime estimator escalation — except on the topk wire,
+        where robust statistics over sparse supports are unsound and mean
+        is forced at construction time."""
+        method = self.method
+        if self.resilience is not None and self.wire != "topk":
+            method = self.resilience.recommend_method(self.method)
+        return method, self._robust_kw(n_peers, method=method)
 
     def _sweep_rounds(self, rounds: Dict[str, "_Round"], max_age: Optional[float] = None) -> None:
         """Evict stale round state (parked contributions hold param-sized
@@ -347,9 +479,9 @@ class AveragerBase:
     def load_wire_state(self, d: dict) -> None:
         """Adopt checkpointed compressor state. Parked until the first
         ``_pack``: sizes/shapes can only be validated against the specs,
-        and a mismatch (different model, different rank) silently re-seeds
-        — the documented cold-start semantics, same policy as the
-        outer-state sidecar."""
+        and a mismatch (different model, different wire, different rank)
+        re-seeds LOUDLY — one warning naming the old/new wire+rank+size —
+        with the same cold-start semantics as the outer-state sidecar."""
         self._pending_wire_state = {k: v for k, v in d.items()}
         if self._specs is not None:
             self._apply_pending_wire_state()
@@ -363,28 +495,52 @@ class AveragerBase:
             wire = np.asarray(wire).item()  # npz round-trips scalars as 0-d
             if isinstance(wire, bytes):
                 wire = wire.decode()
-        if wire != self.wire:
-            log.warning("wire-state is for wire=%s, not %s; re-seeding", wire, self.wire)
-            return
         total = sum(s.size for s in self._specs)
+        if wire != self.wire:
+            # LOUD re-seed (VERDICT r5 #6): name exactly what mismatched so
+            # a fleet-wide wire/rank change is diagnosable from one line —
+            # the silent version cost the EF residual (gradient mass owed
+            # to the swarm) with nothing in the logs.
+            ef = d.get("ef")
+            log.warning(
+                "wire-state sidecar mismatch: checkpointed wire=%s rank=%s "
+                "ef_size=%s vs configured wire=%s rank=%d schema_size=%d; "
+                "re-seeding compressor state (EF residual and warm factors "
+                "start cold)",
+                wire, int(d.get("rank", -1)) if "rank" in d else None,
+                getattr(ef, "size", None), self.wire, self.powersgd_rank, total,
+            )
+            return
         ef = d.get("ef")
         if ef is not None:
             if ef.size == total:
                 self._ef_residual = np.asarray(ef, np.float32).reshape(-1).copy()
             else:
                 log.warning(
-                    "EF residual size %d != schema %d; re-seeding", ef.size, total
+                    "wire-state sidecar mismatch: checkpointed wire=%s EF "
+                    "residual size %d vs configured wire=%s schema size %d; "
+                    "re-seeding EF residual", wire, ef.size, self.wire, total,
                 )
-        if self.wire == "powersgd" and int(d.get("rank", -1)) == self.powersgd_rank:
-            codec = self._psgd()
-            for k, v in d.items():
-                if not k.startswith("q_"):
-                    continue
-                idx = int(k[2:])
-                if idx < len(codec.plan) and codec.plan[idx][2] is not None:
-                    _, m, r = codec.plan[idx][2]
-                    if v.shape == (m, r):
-                        codec._warm_q[idx] = np.asarray(v, np.float32).copy()
+        if self.wire == "powersgd":
+            ckpt_rank = int(d.get("rank", -1))
+            if ckpt_rank == self.powersgd_rank:
+                codec = self._psgd()
+                for k, v in d.items():
+                    if not k.startswith("q_"):
+                        continue
+                    idx = int(k[2:])
+                    if idx < len(codec.plan) and codec.plan[idx][2] is not None:
+                        _, m, r = codec.plan[idx][2]
+                        if v.shape == (m, r):
+                            codec._warm_q[idx] = np.asarray(v, np.float32).copy()
+            elif ckpt_rank != -1:
+                log.warning(
+                    "wire-state sidecar mismatch: checkpointed wire=%s "
+                    "rank=%d vs configured wire=%s rank=%d (schema size %d); "
+                    "re-seeding PowerSGD warm factors (power iteration "
+                    "restarts cold)",
+                    wire, ckpt_rank, self.wire, self.powersgd_rank, total,
+                )
 
     def _check_schema(self, args: dict) -> bool:
         # Before our first pack we don't know the schema yet — accept and let
@@ -473,7 +629,7 @@ class AveragerBase:
         self._ef_pending = buf - sent
         return wire, lambda: sent
 
-    def _robust_kw(self, n_peers: int) -> dict:
+    def _robust_kw(self, n_peers: int, method: Optional[str] = None) -> dict:
         """Estimator kwargs adjusted to THIS round's group size — shared by
         the sync and byzantine aggregation paths so neither can regress to
         an unprotected (or crashing) state the other guards against:
@@ -488,8 +644,9 @@ class AveragerBase:
         - n=2 can't trim at all: trim=0 beats a ValueError killing every
           round (the sync path used to pass the function default trim=1
           straight through — a 2-peer trimmed_mean swarm failed forever)."""
-        kw = dict(self.method_kw)
-        if self.method != "trimmed_mean":
+        method = self.method if method is None else method
+        kw = dict(self.method_kw) if method == self.method else {}
+        if method != "trimmed_mean":
             return kw
         if "trim" in kw:
             trim = int(kw["trim"])
@@ -647,7 +804,15 @@ class AveragerBase:
         raise NotImplementedError
 
     def stats(self) -> dict:
-        return {"mode": self.mode, "rounds_ok": self.rounds_ok, "rounds_skipped": self.rounds_skipped}
+        out = {
+            "mode": self.mode,
+            "rounds_ok": self.rounds_ok,
+            "rounds_skipped": self.rounds_skipped,
+            "rounds_degraded": self.rounds_degraded,
+        }
+        if self.resilience is not None:
+            out["resilience"] = self.resilience.stats()
+        return out
 
 
 class SyncAverager(AveragerBase):
@@ -679,6 +844,23 @@ class SyncAverager(AveragerBase):
         # push landing under its correct token). At aggregation the leader
         # keeps only the entry whose token it actually issued to that peer.
         key = (args["peer"], args.get("token", ""))
+        if (
+            st.result_ready.is_set()
+            and self.resilience is not None
+            and st.tokens is not None
+            and st.tokens.get(key[0]) == key[1]
+            # Only for the MOST RECENT round this leader scored: round state
+            # outlives its commit by the fetch window, and a push for an
+            # older epoch already had its miss counted (absent) at that
+            # round's own flush — reporting it late now would double-count
+            # one slow round against whatever the peer did since.
+            and args.get("epoch") == self._last_outcomes_epoch
+        ):
+            # Authentic contribution from an expected member, landing AFTER
+            # the deadline committed the round: the definition of LATE (the
+            # absent set at commit only proves non-arrival; this proves the
+            # peer was alive but slow — exactly what the policy tracks).
+            self.resilience.record_late_arrival(key[0])
         if st.tokens is not None and st.tokens.get(key[0]) != key[1]:
             # Leader has entered the round, so the issued-token table is
             # known: reject forgeries OUTRIGHT rather than parking them —
@@ -732,15 +914,25 @@ class SyncAverager(AveragerBase):
             raise RPCError("round skipped by leader (too few contributions)")
         # result_wire is encoded ONCE when the result lands (n members
         # fetching must not cost n identical codec passes).
-        return {"ok": True, "included": st.included}, st.result_wire
+        return (
+            {"ok": True, "included": st.included, "excluded": st.excluded},
+            st.result_wire,
+        )
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
+        await self._maybe_backoff()
         group = await self.matchmaker.form_group(
-            self.round_key, self.min_group, self.max_group, self.join_timeout
+            self.round_key, self.min_group, self.max_group, self.join_timeout,
+            round_budget_s=self._round_budget(),
         )
         if group is None:
+            # No group formed (too few peers / no begin): a matchmaking
+            # skip, not a round — the policy only learns from rounds that
+            # actually ran, so a solo volunteer never ratchets its deadline
+            # or backs itself off.
             self.rounds_skipped += 1
+            self._last_outcomes = None
             return None
         # One compression per round, leader or member: the leader's own
         # contribution enters the aggregate exactly as a peer would see it.
@@ -765,12 +957,16 @@ class SyncAverager(AveragerBase):
             self.rounds_skipped += 1
             self._observe_round_failure()
             self._commit_ef(False)
+            self._flush_round_outcome(time.monotonic() - t0, ok=False)
             return None
         self._commit_ef(result is not None and self._contribution_included)
         if result is None:
             self._observe_round_failure()
-        elif not self._round_degraded:
+        elif self._round_degraded:
+            self.rounds_degraded += 1
+        else:
             self._observe_round_time(time.monotonic() - t0)
+        self._flush_round_outcome(time.monotonic() - t0, ok=result is not None)
         return result
 
     async def _lead_round(
@@ -802,9 +998,14 @@ class SyncAverager(AveragerBase):
             st.full.set()
         try:
             try:
-                await asyncio.wait_for(st.full.wait(), timeout=self.effective_gather_timeout)
+                # The group DEADLINE bounds the gather: begin fan-out time
+                # already spent the budget, so a slow formation shrinks the
+                # wait instead of extending the round past its commit time.
+                await asyncio.wait_for(
+                    st.full.wait(), timeout=self._deadline_wait(group)
+                )
             except asyncio.TimeoutError:
-                self._round_degraded = True  # subset aggregate: not an observation
+                self._round_degraded = True  # deadline commit: not an observation
             # Resolve pre-schema-parked powersgd payloads now that our own
             # pack fixed the specs (exact-size-capped decode).
             await self._decode_deferred(st)
@@ -820,6 +1021,25 @@ class SyncAverager(AveragerBase):
                 # — unresolved, so it sits this round out.
                 if c[1] is not None and c[1].size == buf.size and tokens.get(p) == t
             }
+            # Per-peer outcomes for the resilience policy: an expected
+            # member missing from ``good`` either never arrived (absent) or
+            # arrived malformed under a valid token (rejected).
+            rejected = sorted(
+                p
+                for (p, t), c in st.contribs.items()
+                if tokens.get(p) == t
+                and p != self.peer_id
+                and (c[1] is None or c[1].size != buf.size)
+            )
+            st.excluded = sorted(
+                p for p in st.expected if p not in good and p != self.peer_id
+            )
+            self._last_outcomes = {
+                "on_time": [p for p in sorted(good) if p != self.peer_id],
+                "absent": [p for p in st.excluded if p not in rejected],
+                "rejected": rejected,
+            }
+            self._last_outcomes_epoch = group.epoch
             if len(good) < self.min_group:
                 self.rounds_skipped += 1
                 # Fail members' pending fetches fast, then free the buffers.
@@ -828,13 +1048,22 @@ class SyncAverager(AveragerBase):
                     5.0, self._rounds.pop, group.epoch, None
                 )
                 return None
+            if st.excluded:
+                log.info(
+                    "sync round committed at deadline without %s "
+                    "(%d/%d contributions)",
+                    st.excluded, len(good), len(st.expected),
+                )
             peers = sorted(good)
             st.included = peers
+            method, method_kw = self._effective_method(len(peers))
 
             def _aggregate() -> np.ndarray:
-                if self.method == "mean":
+                if method == "mean":
                     # Streaming weighted accumulation (native axpy when
                     # built): no [n_peers, D] stack copy for the common path.
+                    # A deadline-committed subset re-normalizes here by
+                    # construction: total_w is the weight that ARRIVED.
                     total_w = float(sum(good[p][0] for p in peers))
                     acc = np.zeros(buf.size, np.float32)
                     for p in peers:
@@ -842,15 +1071,13 @@ class SyncAverager(AveragerBase):
                         native.weighted_sum_inplace(acc, buf_p, w_p / total_w)
                     return acc
                 stack = np.stack([good[p][1] for p in peers])
-                return robust.aggregate(
-                    stack, self.method, **self._robust_kw(len(peers))
-                )
+                return robust.aggregate(stack, method, **method_kw)
 
             # Seconds of array math at param scale — off the loop (members'
             # fetches park on result_ready; heartbeats must keep flowing).
             st.result = await asyncio.to_thread(_aggregate)
             # Encode the wire form ONCE before releasing the fetch waiters.
-            if self.wire == "powersgd" and self.method == "mean":
+            if self.wire == "powersgd" and method == "mean":
                 # Serve the EXACT factored mean (concatenated weighted
                 # factor pairs): same value members would get densely, at a
                 # fraction of the result-fetch bytes. Falls back to the
@@ -896,20 +1123,33 @@ class SyncAverager(AveragerBase):
             "schema": self._schema,
             "token": group.token,
         }
+        # The push must land BEFORE the group deadline or the leader commits
+        # without it — spending more than the remaining budget on it would
+        # only produce a late arrival the policy then counts against us.
         await self.transport.call(
-            leader_addr, "sync.contribute", args, wire_bytes, timeout=self.effective_gather_timeout
+            leader_addr, "sync.contribute", args, wire_bytes,
+            timeout=self._deadline_wait(group, floor=1.0),
         )
         ret, payload = await self.transport.call(
             leader_addr, "sync.fetch", {"epoch": group.epoch},
-            # Outwait the leader-side fetch wait (gather + aggregation
-            # headroom) plus transfer margin.
-            timeout=self.gather_timeout + self.AGGREGATION_HEADROOM + 6.0,
+            # Outwait the leader's own commit point (the deadline) plus its
+            # off-loop aggregation headroom plus transfer margin.
+            timeout=self._deadline_wait(group, floor=1.0)
+            + self.AGGREGATION_HEADROOM + 6.0,
         )
         # Older leaders don't report the included set; treat absence as
         # included (the pre-existing behavior) rather than stalling EF.
         included = ret.get("included")
         if included is not None:
             self._contribution_included = self.peer_id in included
+        if self.peer_id in (ret.get("excluded") or ()):
+            # Say WHY our update didn't land from this side too — one line a
+            # volunteer operator can read without the leader's logs. (On EF
+            # wires the un-landed mass re-stages via _commit_ef above.)
+            log.info(
+                "sync round committed at its deadline without our "
+                "contribution (push arrived late or was dropped)"
+            )
         self.rounds_ok += 1
         return await asyncio.to_thread(
             lambda: self._unpack(self._buf_from_payload(payload))
@@ -1015,14 +1255,27 @@ class GossipAverager(AveragerBase):
         # so a params-mode peer never mixes with a grads-mode one. A record's
         # model field alone is NOT enough (it can't distinguish params from
         # grads trees, which flatten to identical schemas).
-        peers = await self.membership.alive_peers(include_self=False)
+        # Gossip has no leader to pre-exclude stragglers for us, so partner
+        # SELECTION is where the suspicion signal lands: suspected peers
+        # (phi over threshold / policy miss streak) are filtered out of the
+        # candidate set — they keep receiving our published params via their
+        # own pulls, we just never block a round on them.
+        peers = await self.membership.alive_peers(
+            include_self=False,
+            exclude_suspected=self.failure_detector is not None,
+        )
         targets = [
             (pid, tuple(rec["addr"]))
             for pid, rec in peers.items()
             if "addr" in rec
             and (not self.namespace or rec.get("avg_ns") == self.namespace)
+            and not (
+                self.resilience is not None
+                and self.resilience.should_preexclude(pid)
+            )
         ]
         mixed = bool(inbox)
+        await self._maybe_backoff()
         if targets:
             pid, addr = self._rng.choice(targets)
             try:
@@ -1033,7 +1286,10 @@ class GossipAverager(AveragerBase):
                     {"peer": self.peer_id, "weight": w, "schema": self._schema,
                      "xid": uuid.uuid4().hex},
                     await self._encode_wire(buf),
-                    timeout=self.effective_gather_timeout,
+                    # The round budget (policy-learned when attached) bounds
+                    # the exchange: a stalled partner costs seconds, and the
+                    # inbox fold above already banked everyone else's pushes.
+                    timeout=min(self._round_budget(), self.effective_gather_timeout),
                 )
                 self._observe_round_time(time.monotonic() - t0)
                 rbuf = await self._decode_payload(payload)
@@ -1044,9 +1300,13 @@ class GossipAverager(AveragerBase):
                 )
                 self._current = (w, buf)
                 mixed = True
+                self._last_outcomes = {"on_time": [pid]}
+                self._flush_round_outcome(time.monotonic() - t0, ok=True)
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info("gossip with %s failed (%s)", pid, errstr(e))
                 self._observe_round_failure()
+                self._last_outcomes = {"absent": [pid]}
+                self._flush_round_outcome(time.monotonic() - t0, ok=False)
         if not mixed:
             self.rounds_skipped += 1
             return None
@@ -1130,19 +1390,39 @@ class ButterflyAverager(AveragerBase):
         # (quantized-mine, quantized-theirs) pair.
         return total, (b1 * (w1 / total) + b2 * (w2 / total))
 
+    def _stage_wait(self, group: Group, stage: int, n_stages: int) -> float:
+        """Per-stage wait under the round deadline: the remaining budget is
+        split evenly over the stages still to run (a straggler at stage 0
+        must not eat the whole round's budget and starve stages 1..k), and
+        ``stage_timeout`` stays the per-stage ceiling."""
+        remaining = self._deadline_remaining(group)  # skew-guarded
+        if remaining is None:
+            return self.stage_timeout
+        stages_left = max(n_stages - stage, 1)
+        return float(min(self.stage_timeout, max(remaining / stages_left, 0.5)))
+
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_stages()
+        await self._maybe_backoff()
         group = await self.matchmaker.form_group(
-            self.round_key, self.min_group, self.max_group, self.join_timeout
+            self.round_key, self.min_group, self.max_group, self.join_timeout,
+            round_budget_s=self._round_budget(),
         )
         if group is None:
             self.rounds_skipped += 1
+            self._last_outcomes = None
             return None
+        # Round proper starts AFTER formation (same vantage as sync/byz):
+        # the policy's deadline estimate must learn exchange time, not
+        # matchmaking settle/join time.
+        t0 = time.monotonic()
         buf = self._pack(tree)
         w = float(weight)
         n = group.size
         n_stages = max((n - 1).bit_length(), 1)
         mixed_any = False
+        missed_partners: List[str] = []
+        on_time_partners: List[str] = []
         for s in range(n_stages):
             partner_idx = group.my_index ^ (1 << s)
             if partner_idx >= n:
@@ -1152,6 +1432,7 @@ class ButterflyAverager(AveragerBase):
             st = self._stage_state(group.epoch, s)
             st["buf"], st["w"] = buf, w
             st["ready"].set()
+            stage_wait = self._stage_wait(group, s, n_stages)
             try:
                 if group.my_index < partner_idx:
                     ret, payload = await self.transport.call(
@@ -1165,27 +1446,38 @@ class ButterflyAverager(AveragerBase):
                             "schema": self._schema,
                         },
                         await self._encode_wire(buf),
-                        timeout=self.stage_timeout,
+                        timeout=stage_wait,
                     )
                     pw, pbuf = float(ret["weight"]), await self._decode_payload(payload)
                 else:
-                    await asyncio.wait_for(st["done"].wait(), timeout=self.stage_timeout)
+                    await asyncio.wait_for(st["done"].wait(), timeout=stage_wait)
                     pw, pbuf = st["in"]
                 if pbuf.size != buf.size:
                     raise RPCError(f"partner buffer size {pbuf.size} != local {buf.size}")
                 w, buf = await asyncio.to_thread(self._mix, w, buf, pw, pbuf)
                 mixed_any = True
+                on_time_partners.append(partner_id)
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info(
                     "butterfly round %d stage %d with %s failed (%s); skipping stage",
                     round_no, s, partner_id, errstr(e),
                 )
+                missed_partners.append(partner_id)
             finally:
                 self._stages.pop((group.epoch, s), None)
+        self._round_degraded = bool(missed_partners) and mixed_any
+        self._last_outcomes = {
+            "on_time": on_time_partners,
+            "absent": missed_partners,
+        }
         if not mixed_any:
             self.rounds_skipped += 1
+            self._flush_round_outcome(time.monotonic() - t0, ok=False)
             return None
         self.rounds_ok += 1
+        if self._round_degraded:
+            self.rounds_degraded += 1
+        self._flush_round_outcome(time.monotonic() - t0, ok=True)
         return await asyncio.to_thread(self._unpack, buf)
 
 
@@ -1253,11 +1545,14 @@ class ByzantineAverager(AveragerBase):
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
+        await self._maybe_backoff()
         group = await self.matchmaker.form_group(
-            self.round_key, self.min_group, self.max_group, self.join_timeout
+            self.round_key, self.min_group, self.max_group, self.join_timeout,
+            round_budget_s=self._round_budget(),
         )
         if group is None:
             self.rounds_skipped += 1
+            self._last_outcomes = None
             return None
         buf, wire_bytes, sent = await self._pack_and_compress(tree)
         st = self._rounds.get(group.epoch)
@@ -1279,7 +1574,7 @@ class ByzantineAverager(AveragerBase):
             try:
                 await self.transport.call(
                     addr, "byz.contribute", args, wire_bytes,
-                    timeout=self.effective_gather_timeout,
+                    timeout=self._deadline_wait(group, floor=1.0),
                 )
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info("byz push to %s failed: %s", addr, errstr(e))
@@ -1290,9 +1585,13 @@ class ByzantineAverager(AveragerBase):
             *(push(addr) for pid, addr in group.members if pid != self.peer_id)
         )
         try:
-            await asyncio.wait_for(st.full.wait(), timeout=self.effective_gather_timeout)
+            # Every member closes its gather at the SAME consensus-clock
+            # deadline (the full-mesh twin of the sync leader's commit).
+            await asyncio.wait_for(
+                st.full.wait(), timeout=self._deadline_wait(group)
+            )
         except asyncio.TimeoutError:
-            degraded = True  # aggregate the subset, but don't observe the wait
+            degraded = True  # deadline commit: aggregate the arrived subset
         # Resolve pre-schema-parked powersgd payloads (exact-size-capped now
         # that our own pack fixed the specs).
         await self._decode_deferred(st)
@@ -1303,27 +1602,59 @@ class ByzantineAverager(AveragerBase):
             if p in st.expected and c[1] is not None and c[1].size == buf.size
         }
         self._rounds.pop(group.epoch, None)
+        excluded = sorted(
+            p for p in st.expected if p not in received and p != self.peer_id
+        )
+        self._round_degraded = degraded
+        self._last_outcomes = {
+            "on_time": [p for p in sorted(received) if p != self.peer_id],
+            "absent": excluded,
+        }
         if len(received) < self.min_group:
             self.rounds_skipped += 1
             self._observe_round_failure()
             self._commit_ef(False)
+            self._flush_round_outcome(time.monotonic() - t0, ok=False)
             return None
         self._commit_ef(True)
+        if excluded:
+            log.info(
+                "byzantine round committed at deadline without %s (%d/%d)",
+                excluded, len(received), len(st.expected),
+            )
         peers = sorted(received)
-        kw = self._robust_kw(len(peers))
-        if self.method == "mean":
+        method, kw = self._effective_method(len(peers))
+        if method == "mean":
             kw["weights"] = np.array([received[p][0] for p in peers])
         self.rounds_ok += 1
-        if not degraded:
+        if degraded:
+            self.rounds_degraded += 1
+        else:
             self._observe_round_time(time.monotonic() - t0)
-        # [n_peers, D] stack + robust estimator at param scale: off the loop.
-        return await asyncio.to_thread(
-            lambda: self._unpack(
-                robust.aggregate(
-                    np.stack([received[p][1] for p in peers]), self.method, **kw
-                )
-            )
-        )
+        stack = np.stack([received[p][1] for p in peers])
+
+        def _aggregate_and_flag():
+            out = robust.aggregate(stack, method, **kw)
+            if method != "mean" and len(peers) >= 3:
+                # Estimator-rejection feedback for the policy: rows far from
+                # the robust aggregate (>3x the median row distance) were
+                # effectively voted out — Chameleon's observed-failure
+                # signal for escalating/keeping the estimator.
+                d = np.linalg.norm(stack - out[None, :], axis=1)
+                med = float(np.median(d))
+                if med > 0:
+                    return out, [
+                        peers[i] for i in np.nonzero(d > 3.0 * med)[0]
+                        if peers[i] != self.peer_id
+                    ]
+            return out, []
+
+        agg, outliers = await asyncio.to_thread(_aggregate_and_flag)
+        if outliers and self.resilience is not None:
+            for p in outliers:
+                self.resilience.record_rejection(p)
+        self._flush_round_outcome(time.monotonic() - t0, ok=True)
+        return await asyncio.to_thread(lambda: self._unpack(agg))
 
 
 AVERAGERS = {

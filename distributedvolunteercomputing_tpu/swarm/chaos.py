@@ -15,14 +15,27 @@ the OUTBOUND path:
 
 Rates are attributes, so a test can flip a node from lossy to healthy
 mid-scenario deterministically. Production code never imports this module.
+
+Chaos CAMPAIGNS (the resilience layer's proving ground) want more than
+constant rates: a scripted, reproducible SEQUENCE of faults — a latency
+spike from t=10..20, a partition from t=30..40, a peer that is 10x slow for
+the whole run. ``FaultSchedule`` is that script: a list of ``FaultEvent``
+windows (relative to ``start()``), optionally scoped to destination
+addresses, combined deterministically (same seed + same schedule = same
+fault decisions) and attached to a ChaosTransport via ``schedule=``.
+Window-scoped effects COMBINE with the constant attribute rates: delays
+add, drop/corrupt probabilities take the max.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextvars
+import dataclasses
 import json
 import random
-from typing import Optional
+import time
+from typing import Iterable, Optional, Sequence, Tuple
 
 from distributedvolunteercomputing_tpu.swarm.transport import (
     _HEADER,
@@ -36,6 +49,122 @@ from distributedvolunteercomputing_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scripted fault window, relative to the schedule's start.
+
+    ``kind``:
+      - "delay"     — add ``magnitude`` seconds before every matching call
+                      (latency spike / slow peer);
+      - "drop"      — fail matching calls with probability ``magnitude``
+                      (flaky link; 1.0 = hard partition);
+      - "partition" — alias for drop at rate 1.0 (magnitude ignored);
+      - "corrupt"   — flip one payload byte with probability ``magnitude``.
+
+    ``targets``: destination addresses the event applies to (None = every
+    destination) — a partition event scoped to two addrs cuts exactly that
+    edge of the mesh.
+    """
+
+    t0: float
+    t1: float
+    kind: str
+    magnitude: float = 0.0
+    targets: Optional[frozenset] = None
+
+    _KINDS = ("delay", "drop", "partition", "corrupt")
+
+    def __post_init__(self):
+        if self.kind not in self._KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; known: {self._KINDS}")
+        if self.t1 < self.t0:
+            raise ValueError(f"fault window ends before it starts: {self.t0}..{self.t1}")
+
+    def applies(self, rel_t: float, addr: Addr) -> bool:
+        if not (self.t0 <= rel_t < self.t1):
+            return False
+        return self.targets is None or tuple(addr) in self.targets
+
+
+def fault_event(
+    t0: float,
+    t1: float,
+    kind: str,
+    magnitude: float = 0.0,
+    targets: Optional[Iterable[Addr]] = None,
+) -> FaultEvent:
+    """Convenience constructor normalizing ``targets`` into a frozenset of
+    addr tuples (the dataclass itself wants hashable, comparable state)."""
+    return FaultEvent(
+        t0=float(t0),
+        t1=float(t1) if t1 is not None else float("inf"),
+        kind=kind,
+        magnitude=float(magnitude),
+        targets=frozenset(tuple(a) for a in targets) if targets is not None else None,
+    )
+
+
+class FaultSchedule:
+    """A deterministic, seedable script of fault windows.
+
+    The schedule is inert until ``start()`` anchors its clock; every
+    ChaosTransport sharing one schedule then sees the same timeline, and
+    the drop/corrupt coin flips come from the schedule's OWN seeded rng —
+    replaying the same schedule with the same traffic order reproduces the
+    same faults (the property the chaos-campaign artifact rests on)."""
+
+    def __init__(self, events: Sequence[FaultEvent], seed: int = 0):
+        self.events = list(events)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._t_start: Optional[float] = None
+
+    def start(self, now: Optional[float] = None) -> None:
+        self._t_start = time.monotonic() if now is None else float(now)
+        self._rng = random.Random(self.seed)  # restart = same coin flips
+
+    @property
+    def started(self) -> bool:
+        return self._t_start is not None
+
+    def rel_time(self, now: Optional[float] = None) -> float:
+        if self._t_start is None:
+            return float("-inf")  # not started: no event matches
+        return (time.monotonic() if now is None else float(now)) - self._t_start
+
+    def effects(self, addr: Addr, now: Optional[float] = None) -> Tuple[float, float, float]:
+        """(delay_s, drop_rate, corrupt_rate) active for a call to ``addr``
+        right now: delays ADD across overlapping windows, probabilities
+        take the max (two half-broken links don't make a mended one)."""
+        rel = self.rel_time(now)
+        delay, drop, corrupt = 0.0, 0.0, 0.0
+        for ev in self.events:
+            if not ev.applies(rel, addr):
+                continue
+            if ev.kind == "delay":
+                delay += ev.magnitude
+            elif ev.kind == "drop":
+                drop = max(drop, ev.magnitude)
+            elif ev.kind == "partition":
+                drop = 1.0
+            elif ev.kind == "corrupt":
+                corrupt = max(corrupt, ev.magnitude)
+        return delay, drop, corrupt
+
+    def coin(self, p: float) -> bool:
+        """One seeded fault decision (shared rng -> reproducible runs)."""
+        return p > 0 and self._rng.random() < p
+
+
+# Scheduled corruption travels from the per-CALL decision to the per-FRAME
+# write through the task context (each call's frame write runs in its own
+# wait_for task, which snapshots this at creation) — concurrent calls on
+# one transport cannot steal each other's corruption.
+_corrupt_this_call: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "chaos_corrupt_this_call", default=False
+)
+
+
 class ChaosTransport(Transport):
     def __init__(
         self,
@@ -44,19 +173,25 @@ class ChaosTransport(Transport):
         delay_s: float = 0.0,
         corrupt_rate: float = 0.0,
         seed: int = 0,
+        schedule: Optional[FaultSchedule] = None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
         self.drop_rate = drop_rate
         self.delay_s = delay_s
         self.corrupt_rate = corrupt_rate
+        self.schedule = schedule
         self._chaos = random.Random(seed)
 
     # Overrides the base class method — called as self._write_frame at
     # every send site, so instance dispatch picks this up for both the
     # client and server halves of this node.
     async def _write_frame(self, writer, ftype: int, meta: dict, payload: bytes) -> None:  # type: ignore[override]
-        if payload and self.corrupt_rate and self._chaos.random() < self.corrupt_rate:
+        corrupt_now = _corrupt_this_call.get()
+        if payload and (
+            corrupt_now
+            or (self.corrupt_rate and self._chaos.random() < self.corrupt_rate)
+        ):
             import zlib
 
             meta_b = json.dumps(meta).encode()
@@ -84,4 +219,29 @@ class ChaosTransport(Transport):
             raise OSError(f"chaos: dropped call {method} to {addr}")
         if self.delay_s:
             await asyncio.sleep(self._chaos.random() * self.delay_s)
+        if self.schedule is not None and self.schedule.started:
+            delay, drop, corrupt = self.schedule.effects(addr)
+            if self.schedule.coin(drop):
+                raise OSError(
+                    f"chaos schedule: dropped call {method} to {addr} "
+                    f"(t={self.schedule.rel_time():.1f}s)"
+                )
+            if delay > 0:
+                # Deterministic magnitude (no jitter): a scheduled latency
+                # spike should reproduce exactly across campaign replays.
+                await asyncio.sleep(delay)
+            if self.schedule.coin(corrupt):
+                # Task-local, not a transport-level flag: Transport.call runs
+                # the actual frame write inside its own wait_for task, which
+                # COPIES this context at creation — so under concurrent
+                # pushes (asyncio.gather) the corruption lands on exactly
+                # the scheduled call's request frame, never on whichever
+                # unrelated frame (or server-half response) writes next.
+                tok = _corrupt_this_call.set(True)
+                try:
+                    return await super().call(
+                        addr, method, args=args, payload=payload, timeout=timeout
+                    )
+                finally:
+                    _corrupt_this_call.reset(tok)
         return await super().call(addr, method, args=args, payload=payload, timeout=timeout)

@@ -24,7 +24,7 @@ import asyncio
 import dataclasses
 import hashlib
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
@@ -44,6 +44,19 @@ class Group:
     # the full table in member_tokens; everyone else sees just their own).
     token: str = ""
     member_tokens: Optional[Dict[str, str]] = None
+    # Absolute consensus-clock time this round must COMMIT by (leader-stamped
+    # at begin; None for legacy leaders). Every member bounds its waits by
+    # this instead of its full configured timeout, so the whole group agrees
+    # on when the round closes — the deadline-bounded averaging contract.
+    deadline: Optional[float] = None
+    # The leader's round budget (seconds) behind that deadline, plus when
+    # THIS node learned the round on its own monotonic clock. Together they
+    # give a skew-free bound on the remaining wait: on step-cadence swarms
+    # the deadline clock is raw wall time, and a member whose clock runs
+    # ahead of the leader's by more than the budget would otherwise see the
+    # round as already expired (see AveragerBase._deadline_wait).
+    budget: Optional[float] = None
+    formed_mono: float = dataclasses.field(default_factory=time.monotonic)
 
     @property
     def leader_id(self) -> str:
@@ -61,10 +74,28 @@ class Group:
 
 
 class Matchmaker:
-    def __init__(self, transport: Transport, dht: DHTNode, peer_id: str):
+    def __init__(
+        self,
+        transport: Transport,
+        dht: DHTNode,
+        peer_id: str,
+        *,
+        clock: Callable[[], float] = time.time,
+        exclude: Optional[Callable[[str], bool]] = None,
+    ):
         self.transport = transport
         self.dht = dht
         self.peer_id = peer_id
+        # ``clock`` is the consensus wall clock round deadlines are stamped
+        # on (the volunteer passes ClockSync.now). ``exclude`` is the
+        # straggler pre-exclusion predicate (resilience policy / phi
+        # detector): a LEADER drops candidates it returns True for when
+        # freezing the member list — they stay in the swarm and retry next
+        # round, they just don't gate THIS round.
+        self.clock = clock
+        self.exclude = exclude
+        # Peers dropped from the last led round's member list (stats/tests).
+        self.last_preexcluded: List[str] = []
         self._begin_futures: Dict[str, asyncio.Future] = {}
         # Begins that arrived while no form_group() was waiting, stamped with
         # arrival time: consumed only if still fresh (a begin parked after a
@@ -111,6 +142,7 @@ class Matchmaker:
         max_group: int = 16,
         join_timeout: float = 10.0,
         settle: float = 0.5,
+        round_budget_s: Optional[float] = None,
     ) -> Optional[Group]:
         """Rendezvous under ``round_key``.
 
@@ -154,7 +186,10 @@ class Matchmaker:
                 full = len(members) >= max_group
                 if enough and (stable or full):
                     if members[0][0] == self.peer_id:
-                        return await self._lead(round_key, members[:max_group])
+                        return await self._lead(
+                            round_key, members[:max_group],
+                            min_group=min_group, round_budget_s=round_budget_s,
+                        )
                     # not leader: fall through to awaiting begin
                     break
                 await asyncio.sleep(0.1)
@@ -179,27 +214,49 @@ class Matchmaker:
             return None
         if self.peer_id not in ids:
             return None
+        deadline = begin.get("deadline")
+        budget = begin.get("budget")
         return Group(
             epoch=begin["epoch"],
             members=members,
             my_index=ids.index(self.peer_id),
             token=begin.get("token", ""),
+            deadline=float(deadline) if isinstance(deadline, (int, float)) else None,
+            budget=float(budget) if isinstance(budget, (int, float)) else None,
         )
 
-    async def _lead(self, round_key: str, members: List[Tuple[str, Addr]]) -> Optional[Group]:
+    async def _lead(
+        self,
+        round_key: str,
+        members: List[Tuple[str, Addr]],
+        *,
+        min_group: int = 2,
+        round_budget_s: Optional[float] = None,
+    ) -> Optional[Group]:
         import uuid
 
+        members = self._preexclude(members, min_group)
         ids = [pid for pid, _ in members]
         nonce = uuid.uuid4().hex[:8]
         epoch = self._epoch(round_key, ids, nonce)
         # One secret per member, delivered only in that member's begin.
         tokens = {pid: uuid.uuid4().hex for pid in ids}
+        # Deadline stamped BEFORE the begin fan-out: the fan-out itself
+        # (up to 5s per unreachable member) spends round budget, and every
+        # member must agree on the same absolute commit time.
+        deadline = (
+            self.clock() + float(round_budget_s) if round_budget_s else None
+        )
+        stamp_mono = time.monotonic()
         begin = {
             "round_key": round_key,
             "epoch": epoch,
             "nonce": nonce,
             "members": [[pid, list(addr)] for pid, addr in members],
         }
+        if deadline is not None:
+            begin["deadline"] = deadline
+            begin["budget"] = float(round_budget_s)
         reached = []
         for pid, addr in members:
             if pid == self.peer_id:
@@ -219,4 +276,39 @@ class Matchmaker:
             my_index=ids.index(self.peer_id),
             token=tokens[self.peer_id],
             member_tokens=tokens,
+            deadline=deadline,
+            budget=float(round_budget_s) if deadline is not None else None,
+            # The leader's budget counts from the STAMP, not from after the
+            # begin fan-out — slow formation must keep shrinking its gather.
+            formed_mono=stamp_mono,
         )
+
+    def _preexclude(
+        self, members: List[Tuple[str, Addr]], min_group: int
+    ) -> List[Tuple[str, Addr]]:
+        """Drop likely stragglers from a member list about to be frozen —
+        never ourselves (we're leading) and never below ``min_group`` (a
+        round with suspects beats no round: the deadline bounds the damage
+        a straggler can do anyway)."""
+        self.last_preexcluded = []
+        if self.exclude is None:
+            return members
+        kept = list(members)
+        for pid, addr in members:
+            if len(kept) <= min_group:
+                break
+            if pid == self.peer_id:
+                continue
+            try:
+                drop = bool(self.exclude(pid))
+            except Exception:  # noqa: BLE001 — a policy bug must not kill rounds
+                drop = False
+            if drop:
+                kept.remove((pid, addr))
+                self.last_preexcluded.append(pid)
+        if self.last_preexcluded:
+            log.info(
+                "round formation: pre-excluded likely stragglers %s",
+                self.last_preexcluded,
+            )
+        return kept

@@ -9,6 +9,14 @@ Liveness is soft-state: each volunteer re-announces itself under the shared
 ``peers`` DHT key with a TTL; death == record expiry. Nobody has to observe a
 crash — a kill -9'd volunteer vanishes from ``alive_peers()`` within one TTL
 (SURVEY.md §3-E).
+
+On top of the binary TTL, membership can feed a phi-accrual failure
+detector (swarm/failure_detector.py): every time a peer's record timestamp
+CHANGES between observations, that is one heartbeat arrival, and the
+detector learns the peer's inter-arrival distribution. The TTL stays the
+hard death line; phi is the earlier, continuous "probably stalled" signal
+the matchmaker and resilience policy consult to pre-exclude stragglers
+from rounds seconds before the record would expire.
 """
 
 from __future__ import annotations
@@ -32,11 +40,17 @@ class SwarmMembership:
         peer_id: str,
         ttl: float = 15.0,
         extra_info: Optional[dict] = None,
+        failure_detector=None,
     ):
         self.dht = dht
         self.peer_id = peer_id
         self.ttl = ttl
         self.extra_info = extra_info or {}
+        self.failure_detector = failure_detector
+        # Last announce-timestamp seen per peer: a new heartbeat is a CHANGED
+        # record ``t``, so observation cadence (who calls alive_peers, how
+        # often) can't fabricate arrivals out of re-reads of the same record.
+        self._seen_beats: dict = {}
         self._heartbeat_task: Optional[asyncio.Task] = None
         self._left = False
 
@@ -73,15 +87,57 @@ class SwarmMembership:
                     await self.dht.store(
                         PEERS_KEY, self._record(), subkey=self.peer_id, ttl=self.ttl
                     )
+                    if self.failure_detector is not None:
+                        # Piggyback one observation pass per own beat: the
+                        # detector keeps accruing even when nothing else on
+                        # this node happens to call alive_peers (an idle
+                        # trainer between wall-clock cadence boundaries).
+                        await self.alive_peers()
                 except Exception as e:
                     log.warning("heartbeat store failed: %s", errstr(e))
         except asyncio.CancelledError:
             pass
 
-    async def alive_peers(self, include_self: bool = True) -> Dict[str, dict]:
-        """Live peer_id -> record; tombstones (None) are filtered out."""
+    def _observe_beats(self, records: Dict[str, dict]) -> None:
+        """Feed the phi-accrual detector: a peer whose announce timestamp
+        changed since the last observation produced one heartbeat arrival
+        (stamped at the LOCAL monotonic clock — sender timestamps are only
+        compared for change, never trusted as times)."""
+        fd = self.failure_detector
+        if fd is None:
+            return
+        for pid, rec in records.items():
+            if pid == self.peer_id:
+                continue
+            t = rec.get("t")
+            if isinstance(t, (int, float)) and self._seen_beats.get(pid) != t:
+                self._seen_beats[pid] = t
+                fd.heartbeat(pid)
+
+    async def alive_peers(
+        self, include_self: bool = True, exclude_suspected: bool = False
+    ) -> Dict[str, dict]:
+        """Live peer_id -> record; tombstones (None) are filtered out.
+
+        ``exclude_suspected`` additionally drops peers the phi-accrual
+        detector currently suspects — the soft pre-exclusion consumers like
+        gossip partner selection opt into (the hard TTL filter always
+        applies)."""
         rec = await self.dht.get(PEERS_KEY)
         out = {pid: info for pid, info in rec.items() if info is not None}
+        self._observe_beats(out)
+        if self.failure_detector is not None:
+            # A tombstoned/expired peer must not keep accruing silence as
+            # suspicion — its next join starts with a clean history.
+            for pid in [p for p in self._seen_beats if p not in out]:
+                self._seen_beats.pop(pid, None)
+                self.failure_detector.forget(pid)
+            if exclude_suspected:
+                out = {
+                    pid: info
+                    for pid, info in out.items()
+                    if pid == self.peer_id or not self.failure_detector.suspect(pid)
+                }
         if not include_self:
             out.pop(self.peer_id, None)
         return out

@@ -1,0 +1,303 @@
+"""Adaptive resilience policy for averaging rounds (Chameleon-style).
+
+One object per volunteer that watches how rounds actually go and adjusts
+the knobs the averaging tier runs on, instead of static configuration:
+
+- **round deadline** (``round_budget()``): the wall-clock budget a round is
+  allowed before it commits with partial participation. Learned from
+  COMPLETE rounds' durations (EWMA + 4 deviations, the classic adaptive-RTO
+  shape) and AIMD-backed-off on failures — a healthy swarm converges to
+  tight deadlines where a stalled peer costs little; a genuinely slow
+  network ratchets the budget back toward the configured ceiling instead
+  of failing forever.
+- **retry backoff** (``backoff_s()``): consecutive failed rounds back off
+  exponentially (capped), so a partitioned volunteer stops hammering
+  matchmaking at full cadence and re-probes on a widening schedule.
+- **robust-estimator escalation** (``recommend_method()``): per-peer
+  rejected-contribution counts (size/schema/token mismatches at
+  aggregation, plus estimator-flagged outlier rows) escalate the
+  aggregation method at runtime — a swarm configured with the cheap
+  ``mean`` switches itself to ``trimmed_mean``/``median`` while rejection
+  evidence persists, Chameleon's select-the-policy-from-observed-failures
+  idea applied to the estimator choice.
+- **pre-exclusion** (``should_preexclude()``): per-peer outcome history
+  (absent/late streaks) combined with the phi-accrual detector's suspicion
+  marks peers the matchmaker should leave out of group formation.
+
+The policy is advisory and local: every averager consults its own
+instance; nothing is negotiated over the wire (the leader's deadline
+travels in the round's begin message, which is the one place a single
+node's policy binds a group — bounded by every member's own ceiling).
+
+Thread-safety: all mutation happens on the asyncio loop (averager round
+paths); reads from other threads see atomically-replaced floats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterable, Optional
+
+from distributedvolunteercomputing_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Escalation ladder for the estimator recommendation. Only estimators with
+# parameter-free (derived) robustness knobs — krum/bulyan need an explicit
+# n_byzantine and stay operator-chosen.
+_METHOD_LADDER = ("mean", "trimmed_mean", "coordinate_median")
+
+
+@dataclasses.dataclass
+class PeerOutcomes:
+    """Per-peer round-outcome counters (sliding decay, see _decay)."""
+
+    on_time: float = 0.0
+    late: float = 0.0
+    absent: float = 0.0
+    rejected: float = 0.0
+    # Consecutive not-on-time rounds; resets on any on-time arrival.
+    miss_streak: int = 0
+
+    def total(self) -> float:
+        return self.on_time + self.late + self.absent + self.rejected
+
+
+class ResiliencePolicy:
+    def __init__(
+        self,
+        *,
+        max_deadline_s: float = 20.0,
+        min_deadline_s: float = 2.0,
+        initial_deadline_s: Optional[float] = None,
+        decay: float = 0.9,
+        preexclude_misses: int = 3,
+        escalate_rejections: float = 3.0,
+        failure_detector=None,
+        clock=time.monotonic,
+    ):
+        if min_deadline_s <= 0 or max_deadline_s < min_deadline_s:
+            raise ValueError(
+                f"need 0 < min_deadline_s <= max_deadline_s, got "
+                f"{min_deadline_s} / {max_deadline_s}"
+            )
+        if not 0.0 < decay <= 1.0:
+            raise ValueError(f"decay must be in (0, 1], got {decay}")
+        self.max_deadline_s = float(max_deadline_s)
+        self.min_deadline_s = float(min_deadline_s)
+        self._deadline = float(
+            max_deadline_s if initial_deadline_s is None else initial_deadline_s
+        )
+        self._deadline = min(max(self._deadline, min_deadline_s), max_deadline_s)
+        self.decay = float(decay)
+        self.preexclude_misses = int(preexclude_misses)
+        self.escalate_rejections = float(escalate_rejections)
+        self.failure_detector = failure_detector
+        self.clock = clock
+        self.peers: Dict[str, PeerOutcomes] = {}
+        # Adaptive-deadline estimate over COMPLETE (non-degraded) rounds.
+        self._rt_ewma: Optional[float] = None
+        self._rt_ewdev = 0.0
+        self._consecutive_failures = 0
+        self.rounds_seen = 0
+        self.rounds_degraded = 0
+        self._method_level = 0
+        # One slow round must count ONCE: a peer whose push lands after the
+        # commit is seen twice (absent in the commit batch, late on the RPC
+        # path), in either order. These two sets reconcile the duplicate —
+        # _last_absent remembers who the latest flush counted absent (so a
+        # late arrival after it reclassifies instead of re-counting), and
+        # _late_noted who record_late_arrival already counted (so a flush
+        # arriving after it skips them).
+        self._last_absent: set = set()
+        self._late_noted: set = set()
+
+    # -- deadline ----------------------------------------------------------
+
+    def round_budget(self) -> float:
+        """Wall-clock budget for the NEXT round, in seconds."""
+        return self._deadline
+
+    def backoff_s(self) -> float:
+        """Extra wait before retrying after failed rounds (0 when healthy)."""
+        k = self._consecutive_failures
+        if k <= 0:
+            return 0.0
+        return float(min(0.5 * (2.0 ** (k - 1)), 30.0))
+
+    def _observe_duration(self, dt: float) -> None:
+        if self._rt_ewma is None:
+            self._rt_ewma, self._rt_ewdev = dt, dt / 2.0
+        else:
+            self._rt_ewdev += 0.25 * (abs(dt - self._rt_ewma) - self._rt_ewdev)
+            self._rt_ewma += 0.25 * (dt - self._rt_ewma)
+        est = self._rt_ewma + 4.0 * self._rt_ewdev + 0.5
+        # Multiplicative decrease TOWARD the estimate (never jumping below
+        # it): one fast outlier round must not slam the deadline down onto
+        # the next round's normal tail.
+        target = min(max(est, self.min_deadline_s), self.max_deadline_s)
+        if target < self._deadline:
+            self._deadline = max(0.7 * self._deadline + 0.3 * target, target)
+        else:
+            self._deadline = target
+
+    def _observe_failure(self) -> None:
+        # AIMD: a failed round doubles the budget toward the ceiling — a
+        # genuinely slow network recovers instead of timing out forever.
+        self._deadline = min(self._deadline * 2.0, self.max_deadline_s)
+        self._rt_ewma = None  # re-learn at the new regime
+
+    # -- outcomes ----------------------------------------------------------
+
+    def _peer(self, peer: str) -> PeerOutcomes:
+        st = self.peers.get(peer)
+        if st is None:
+            st = self.peers[peer] = PeerOutcomes()
+        return st
+
+    def _decay_all(self) -> None:
+        for st in self.peers.values():
+            st.on_time *= self.decay
+            st.late *= self.decay
+            st.absent *= self.decay
+            st.rejected *= self.decay
+
+    def record_round(
+        self,
+        *,
+        duration_s: float,
+        ok: bool,
+        degraded: bool = False,
+        on_time: Iterable[str] = (),
+        late: Iterable[str] = (),
+        absent: Iterable[str] = (),
+        rejected: Iterable[str] = (),
+    ) -> None:
+        """One finished round, from whichever vantage this node had (a
+        leader knows per-peer arrivals; a member may only know ok/duration).
+
+        A DEGRADED round (committed at the deadline with a subset) counts
+        as success for the deadline estimate's failure logic but its
+        duration is NOT observed — it took ~the deadline by construction,
+        and observing it would ratchet the estimate to the ceiling in
+        exactly the persistent-straggler case this policy targets."""
+        self.rounds_seen += 1
+        self._decay_all()
+        for p in on_time:
+            st = self._peer(p)
+            st.on_time += 1.0
+            st.miss_streak = 0
+        for p in late:
+            st = self._peer(p)
+            st.late += 1.0
+            st.miss_streak += 1
+        counted_absent = set()
+        for p in absent:
+            if p in self._late_noted:
+                # Its late arrival already counted this round's miss (the
+                # push landed between the commit and this flush).
+                continue
+            st = self._peer(p)
+            st.absent += 1.0
+            st.miss_streak += 1
+            counted_absent.add(p)
+        self._last_absent = counted_absent
+        self._late_noted.clear()
+        for p in rejected:
+            st = self._peer(p)
+            st.rejected += 1.0
+            st.miss_streak += 1
+        if ok:
+            self._consecutive_failures = 0
+            if degraded:
+                self.rounds_degraded += 1
+            else:
+                self._observe_duration(duration_s)
+        else:
+            self._consecutive_failures += 1
+            self._observe_failure()
+        self._maybe_escalate()
+
+    def record_late_arrival(self, peer: str) -> None:
+        """A contribution that landed AFTER its round committed (detected
+        on the RPC path, outside record_round's batch). The commit usually
+        counted the same peer absent already — that one event reclassifies
+        absent -> late rather than advancing the miss streak twice."""
+        st = self._peer(peer)
+        if peer in self._last_absent:
+            self._last_absent.discard(peer)
+            st.absent = max(0.0, st.absent - 1.0)
+            st.late += 1.0
+            return  # the absent count already advanced the streak
+        st.late += 1.0
+        st.miss_streak += 1
+        self._late_noted.add(peer)
+
+    def record_rejection(self, peer: str) -> None:
+        """A contribution dropped at aggregation (bad size/schema/token, or
+        flagged as an outlier row by the robust estimator)."""
+        self._peer(peer).rejected += 1.0
+        self._maybe_escalate()
+
+    # -- decisions ---------------------------------------------------------
+
+    def should_preexclude(self, peer: str) -> bool:
+        """Should group formation leave this peer out? True when the
+        phi-accrual detector suspects it, or its recent outcome history is
+        a miss streak (absent/late/rejected ``preexclude_misses`` rounds
+        running)."""
+        if self.failure_detector is not None and self.failure_detector.suspect(peer):
+            return True
+        st = self.peers.get(peer)
+        return st is not None and st.miss_streak >= self.preexclude_misses
+
+    def _maybe_escalate(self) -> None:
+        worst = max(
+            (st.rejected for st in self.peers.values()), default=0.0
+        )
+        if worst >= 2.0 * self.escalate_rejections:
+            level = 2
+        elif worst >= self.escalate_rejections:
+            level = 1
+        else:
+            level = 0
+        if level > self._method_level:
+            log.warning(
+                "resilience: escalating aggregation to %s "
+                "(peer rejection score %.1f)", _METHOD_LADDER[level], worst,
+            )
+            self._method_level = level
+        elif level < self._method_level and worst < 0.5:
+            # De-escalate only once the evidence has decayed away entirely —
+            # flapping between estimators round-to-round helps nobody.
+            log.info("resilience: rejection evidence decayed; back to %s",
+                     _METHOD_LADDER[level])
+            self._method_level = level
+
+    def recommend_method(self, configured: str) -> str:
+        """Estimator to aggregate with THIS round. Only ever escalates an
+        explicitly-cheap configuration (``mean``) up the derived-knob ladder;
+        an operator-chosen robust method is respected as the floor."""
+        if configured != _METHOD_LADDER[0]:
+            return configured
+        return _METHOD_LADDER[self._method_level]
+
+    def stats(self) -> dict:
+        return {
+            "deadline_s": round(self._deadline, 3),
+            "rounds_seen": self.rounds_seen,
+            "rounds_degraded": self.rounds_degraded,
+            "consecutive_failures": self._consecutive_failures,
+            "method_level": _METHOD_LADDER[self._method_level],
+            "peers": {
+                p: {
+                    "on_time": round(st.on_time, 2),
+                    "late": round(st.late, 2),
+                    "absent": round(st.absent, 2),
+                    "rejected": round(st.rejected, 2),
+                    "miss_streak": st.miss_streak,
+                }
+                for p, st in self.peers.items()
+            },
+        }

@@ -36,6 +36,11 @@ from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
 
+# Wall-clock cadence the AUTO default resolves to for butterfly params-mode
+# swarms (the value both committed A/Bs ran: BASELINE.md config 4b and the
+# scale16 butterfly arm).
+DEFAULT_BUTTERFLY_INTERVAL_S = 20.0
+
 
 @dataclasses.dataclass
 class VolunteerConfig:
@@ -52,9 +57,15 @@ class VolunteerConfig:
     average_every: int = 10
     # Wall-clock averaging cadence (params mode; 0 = step cadence above).
     # Rounds fire when wall time crosses a multiple of the interval, so
-    # NTP-synced heterogeneous volunteers rendezvous within ms regardless
+    # clock-synced heterogeneous volunteers rendezvous within ms regardless
     # of step speed; contributions weigh by actual window progress.
-    average_interval_s: float = 0.0
+    # None = AUTO (the default): butterfly params-mode swarms — the
+    # heterogeneous-volunteer config — get the wall-clock cadence at
+    # DEFAULT_BUTTERFLY_INTERVAL_S (the step cadence is measured-
+    # pathological there at n=4 and n=16: BASELINE.md config 4 vs 4b and
+    # the scale16 step-cadence arm); every other mode keeps the step
+    # cadence. Pass an explicit 0 to force step cadence anywhere.
+    average_interval_s: Optional[float] = None
     average_what: str = "params"  # params (local-SGD) | grads (GradientAverager)
     # Overlap WAN rounds with local compute (params mode; see Trainer). On by
     # default: blocking the device for a whole WAN round is what sinks
@@ -110,6 +121,23 @@ class VolunteerConfig:
     # Adaptive round deadlines (EWMA of successful rounds; see AveragerBase):
     # a dead peer costs seconds instead of the full gather budget.
     adaptive_timeout: bool = False
+    # Resilience layer (swarm/resilience.py + swarm/failure_detector.py):
+    # phi-accrual liveness feeding straggler pre-exclusion at group
+    # formation, plus the adaptive policy engine (learned round deadlines,
+    # failure backoff, runtime robust-estimator escalation). Opt-in — the
+    # deadline-bounded COMMIT machinery itself is always on (rounds commit
+    # with the contributions that arrived by the budget), this flag adds
+    # the adaptive/learning layer on top.
+    resilience: bool = False
+    # phi at/above which a peer counts as suspected (8 ~ one-in-1e8 under
+    # the fitted heartbeat model — the classic accrual-detector default).
+    phi_threshold: float = 8.0
+    # Static wall-clock budget per averaging round, seconds (0 = use the
+    # gather timeout; the resilience policy, when on, supersedes both with
+    # its learned deadline). The leader stamps clock()+budget into the
+    # round begin; the whole group commits at that instant with whatever
+    # contributions arrived, re-weighting the mean over the subset.
+    round_deadline_s: float = 0.0
     # DiLoCo-style outer optimizer over params-mode rounds (see Trainer):
     # Nesterov momentum on the per-round aggregate delta instead of adopting
     # the raw mean — convergence-per-round at the same WAN byte budget.
@@ -140,9 +168,28 @@ class VolunteerConfig:
     def __post_init__(self):
         if not self.peer_id:
             self.peer_id = f"vol-{uuid.uuid4().hex[:8]}"
+        if self.average_interval_s is None:
+            # AUTO cadence (VERDICT r5 #5): butterfly is the heterogeneous-
+            # swarm config, and both committed cadence A/Bs (config 4 vs 4b
+            # at n=4; scale16 butterfly arms at n=16) show the step cadence
+            # parking fast peers / never aligning there. Wall-clock default
+            # for butterfly params mode; step cadence everywhere else.
+            self.average_interval_s = (
+                DEFAULT_BUTTERFLY_INTERVAL_S
+                if self.averaging == "butterfly" and self.average_what == "params"
+                else 0.0
+            )
         if self.average_interval_s < 0:
             raise ValueError(
                 f"average_interval_s must be >= 0, got {self.average_interval_s}"
+            )
+        if self.round_deadline_s < 0:
+            raise ValueError(
+                f"round_deadline_s must be >= 0, got {self.round_deadline_s}"
+            )
+        if self.phi_threshold <= 0:
+            raise ValueError(
+                f"phi_threshold must be > 0, got {self.phi_threshold}"
             )
         if self.average_interval_s > 0:
             if self.average_what != "params":
@@ -294,6 +341,8 @@ class Volunteer:
         self.dht = DHTNode(self.transport)
         self.membership: Optional[SwarmMembership] = None
         self.clocksync = None
+        self.failure_detector = None
+        self.resilience_policy = None
         self.averager = None
         self.state_sync: Optional[StateSyncService] = None
         self.trainer: Optional[Trainer] = None
@@ -353,8 +402,37 @@ class Volunteer:
         await self.transport.start()
         bootstrap = _parse_addrs(self.cfg.coordinator) or None
         await self.dht.start(bootstrap=bootstrap)
+        if self.cfg.resilience:
+            # Resilience layer: phi-accrual liveness fed by membership
+            # heartbeats, and the adaptive policy (learned round deadlines,
+            # failure backoff, estimator escalation) the averager and
+            # matchmaker consult. Constructed BEFORE membership so the very
+            # first observed peer records start the heartbeat distributions.
+            from distributedvolunteercomputing_tpu.swarm.failure_detector import (
+                PhiAccrualDetector,
+            )
+            from distributedvolunteercomputing_tpu.swarm.resilience import (
+                ResiliencePolicy,
+            )
+
+            self.failure_detector = PhiAccrualDetector(
+                threshold=self.cfg.phi_threshold,
+                # Heartbeats arrive at the announce cadence (ttl/3, see
+                # SwarmMembership.join): seed the bootstrap gap there so a
+                # peer heard from once accrues suspicion on the right scale.
+                bootstrap_s=max(self.cfg.heartbeat_ttl / 3.0, 1.0),
+            )
+            self.resilience_policy = ResiliencePolicy(
+                max_deadline_s=self.cfg.gather_timeout,
+                # A tight-LAN --gather-timeout below the stock 2s deadline
+                # floor must not trip the ctor's range check at startup.
+                min_deadline_s=min(2.0, float(self.cfg.gather_timeout)),
+                initial_deadline_s=self.cfg.round_deadline_s or None,
+                failure_detector=self.failure_detector,
+            )
         self.membership = SwarmMembership(
             self.dht, self.cfg.peer_id, ttl=self.cfg.heartbeat_ttl,
+            failure_detector=self.failure_detector,
             extra_info={
                 "model": self.cfg.model,
                 # Full averaging namespace (model/average_what): gossip picks
@@ -392,6 +470,14 @@ class Volunteer:
                 topk_warmup_rounds=self.cfg.topk_warmup_rounds,
                 powersgd_rank=self.cfg.powersgd_rank,
                 adaptive_timeout=self.cfg.adaptive_timeout,
+                # Deadline-bounded rounds: leaders stamp clock()+budget into
+                # the begin on the consensus clock when one exists (wall-
+                # cadence swarms), else local wall time — the same clock the
+                # whole group's members compare the deadline against.
+                clock=self.clocksync.now if self.clocksync is not None else None,
+                round_deadline_s=self.cfg.round_deadline_s or None,
+                resilience=self.resilience_policy,
+                failure_detector=self.failure_detector,
             )
             if self.cfg.averaging == "byzantine" and (
                 self.cfg.method != "mean" or self.cfg.wire == "topk"

@@ -133,8 +133,9 @@ def _save_wire_state(trainer, snapshot_path: str) -> None:
 
 def _maybe_restore_wire_state(trainer, snapshot_path: str) -> None:
     """Hand the sidecar back to the averager, which validates against its
-    schema at first pack and silently re-seeds on mismatch (same cold-start
-    semantics as the outer-state sidecar)."""
+    schema at first pack and re-seeds on mismatch with one LOUD warning
+    naming the old/new wire+rank+size (same cold-start semantics as the
+    outer-state sidecar; see AveragerBase._apply_pending_wire_state)."""
     avg = getattr(trainer, "_wire_averager", None)
     if avg is None:
         return

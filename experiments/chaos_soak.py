@@ -1,0 +1,447 @@
+#!/usr/bin/env python
+"""Chaos soak: deadline-bounded averaging vs a x10-delayed straggler.
+
+The resilience layer's proving ground (ISSUE 1 acceptance): a 4-volunteer
+swarm with ONE peer delayed x10 under a seeded fault schedule must
+
+  1. complete >= 95% of averaging rounds within the round budget via
+     partial-participation (deadline) commit — measured against a BLOCKING
+     baseline in the same run (deadline machinery off, same fault active);
+  2. have the phi-accrual failure detector suspect (and the leader's
+     policy pre-exclude) the injected straggler within 3 rounds of fault
+     onset;
+  3. (training phase, subprocess volunteers) still cross the target loss
+     with the straggler injected.
+
+Three phases, one process-local swarm (real localhost TCP, real DHT,
+real matchmaking — the same stack tests/test_averaging.py drives):
+
+  warmup   — all 4 healthy: policies learn tight deadlines, detectors
+             learn ~1s heartbeat gaps.
+  faulted  — fault onset: the straggler's outbound RPCs gain a scheduled
+             delay of 10x the healthy round time (FaultSchedule, seeded)
+             and its heartbeat cadence stretches x10 (a stalled peer whose
+             membership record does NOT TTL-expire — the window where phi
+             is the only liveness signal). Honest rounds must keep
+             committing at their learned deadlines with 3/4 participants.
+  blocking — same fault, deadline machinery disabled (the pre-tentpole
+             behavior): every round now waits on the straggler's delayed
+             push, measuring what the deadline commit saves.
+
+Artifact: experiments/results/chaos_soak.json (committed — the numbers
+quoted in docs/resilience.md come from it).
+
+Usage:
+    python experiments/chaos_soak.py                  # full campaign + training
+    python experiments/chaos_soak.py --quick          # short campaign, no training
+    python experiments/chaos_soak.py --no-train       # campaign only
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import re
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.chaos import (  # noqa: E402
+    ChaosTransport,
+    FaultSchedule,
+    fault_event,
+)
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.failure_detector import (  # noqa: E402
+    PhiAccrualDetector,
+)
+from distributedvolunteercomputing_tpu.swarm.membership import (  # noqa: E402
+    PEERS_KEY,
+    SwarmMembership,
+)
+from distributedvolunteercomputing_tpu.swarm.resilience import (  # noqa: E402
+    ResiliencePolicy,
+)
+from distributedvolunteercomputing_tpu.swarm.transport import Transport  # noqa: E402
+
+STRAGGLER = "v3"  # sorts last: v0 always leads
+
+
+def tree_for(i: int, size: int = 2048):
+    return {"w": np.full((size,), float(i), np.float32)}
+
+
+async def build_swarm(seed: int, gather_timeout: float):
+    """4 volunteers: v0..v2 honest (detector + policy attached), v3 the
+    future straggler on a ChaosTransport driven by a seeded schedule."""
+    vols = []
+    boot = None
+    schedule = FaultSchedule([], seed=seed)  # events injected at onset
+    for i in range(4):
+        pid = f"v{i}"
+        if pid == STRAGGLER:
+            t = ChaosTransport(schedule=schedule)
+        else:
+            t = Transport()
+        dht = DHTNode(t)
+        await dht.start(bootstrap=[boot] if boot else None)
+        if boot is None:
+            boot = t.addr
+        fd = policy = None
+        if pid != STRAGGLER:
+            fd = PhiAccrualDetector(bootstrap_s=2.0)
+            policy = ResiliencePolicy(
+                max_deadline_s=gather_timeout, min_deadline_s=1.0,
+                preexclude_misses=3, failure_detector=fd,
+            )
+        mem = SwarmMembership(dht, pid, ttl=3.0, failure_detector=fd)
+        await mem.join()
+        avg = SyncAverager(
+            t, dht, mem,
+            min_group=3, max_group=4,
+            join_timeout=8.0, gather_timeout=gather_timeout,
+            resilience=policy, failure_detector=fd,
+        )
+        vols.append({
+            "pid": pid, "t": t, "dht": dht, "mem": mem, "avg": avg,
+            "fd": fd, "policy": policy,
+        })
+    return vols, schedule
+
+
+async def run_round(vols, r, include_straggler, timeout=60.0):
+    """One synchronized round over ``vols`` (honest subset or all four);
+    returns the leader's (dt, result, budget_before)."""
+    players = [v for v in vols if include_straggler or v["pid"] != STRAGGLER]
+    leader = vols[0]
+    budget = leader["avg"]._round_budget()
+    t0 = time.monotonic()
+    results = await asyncio.gather(
+        *(
+            asyncio.wait_for(
+                v["avg"].average(tree_for(i), round_no=r), timeout=timeout
+            )
+            for i, v in enumerate(players)
+        ),
+        return_exceptions=True,
+    )
+    dt = time.monotonic() - t0
+    lead_res = results[0]
+    if isinstance(lead_res, BaseException):
+        lead_res = None
+    return dt, lead_res, budget
+
+
+async def straggler_loop(straggler, stop: asyncio.Event):
+    """Free-running straggler: a stalled peer is not synchronized with the
+    swarm — it keeps trying rounds on its own crawling schedule, its stale
+    matchmaking announce keeps it a formation candidate, and its begin
+    handler stays reachable (inbound RPCs are not delayed)."""
+    r = 10_000
+    while not stop.is_set():
+        r += 1
+        try:
+            await asyncio.wait_for(
+                straggler["avg"].average(tree_for(3), round_no=r), timeout=30.0
+            )
+        except Exception:
+            pass
+        try:
+            await asyncio.wait_for(asyncio.shield(stop.wait()), timeout=0.2)
+        except asyncio.TimeoutError:
+            pass
+
+
+async def campaign(args):
+    gather_timeout = 12.0
+    vols, schedule = await build_swarm(args.seed, gather_timeout)
+    honest = [v for v in vols if v["pid"] != STRAGGLER]
+    straggler = vols[3]
+    leader = vols[0]
+    out = {"seed": args.seed}
+    try:
+        # -- phase 1: healthy warmup --------------------------------------
+        warm_dts = []
+        for r in range(args.warmup_rounds):
+            dt, res, _ = await run_round(vols, r, include_straggler=True)
+            assert res is not None, f"healthy warmup round {r} failed"
+            warm_dts.append(dt)
+        healthy_mean = statistics.mean(warm_dts)
+        healthy_p95 = sorted(warm_dts)[max(0, int(0.95 * len(warm_dts)) - 1)]
+        # Round-trip overhead allowance for the within-budget accounting:
+        # the budget bounds the GATHER; formation (announce + settle) rides
+        # on top in every round, healthy or not.
+        overhead = max(healthy_p95, 1.0)
+        out["healthy"] = {
+            "rounds": len(warm_dts),
+            "mean_round_s": round(healthy_mean, 3),
+            "p95_round_s": round(healthy_p95, 3),
+            "learned_deadline_s": round(leader["policy"].round_budget(), 3),
+        }
+        print(f"[warmup] {len(warm_dts)} rounds, mean {healthy_mean:.2f}s, "
+              f"learned deadline {leader['policy'].round_budget():.2f}s")
+
+        # -- fault onset ---------------------------------------------------
+        # The straggler becomes x10 slow: every outbound RPC gains a
+        # scheduled delay of 10x the healthy round time, and its heartbeat
+        # cadence stretches x10 (ttl 3 -> 30: the record stays ALIVE, so
+        # the binary TTL never fires — only phi can see the stall).
+        delay = 10.0 * healthy_mean
+        schedule.events = [fault_event(0.0, float("inf"), "delay", delay)]
+        schedule.start()
+        straggler["mem"].ttl = 30.0
+        # Bridge announce: the last ttl=3 record must not expire before the
+        # first slow beat (10s) or honest peers would forget + re-learn.
+        await straggler["dht"].store(
+            PEERS_KEY, straggler["mem"]._record(), subkey=STRAGGLER, ttl=30.0
+        )
+        print(f"[onset] straggler delay {delay:.2f}s/call, heartbeat x10")
+
+        # -- phase 2: faulted, deadline-bounded ---------------------------
+        stop = asyncio.Event()
+        strag_task = asyncio.create_task(straggler_loop(straggler, stop))
+        rounds = []
+        suspect_round = preexclude_round = None
+        degraded_before = leader["avg"].rounds_degraded
+        for r in range(args.warmup_rounds, args.warmup_rounds + args.faulted_rounds):
+            # Rounds ride a training cadence, not back-to-back: the pause is
+            # the local-compute window between averaging points.
+            await asyncio.sleep(args.round_cadence_s)
+            dt, res, budget = await run_round(vols, r, include_straggler=False)
+            degraded_now = leader["avg"].rounds_degraded
+            rec = {
+                "round": r,
+                "dt_s": round(dt, 3),
+                "budget_s": round(budget, 3),
+                "committed": res is not None,
+                "within_budget": res is not None and dt <= budget + overhead,
+                "degraded_commit": degraded_now > degraded_before,
+                "preexcluded": list(leader["avg"].matchmaker.last_preexcluded),
+                "phi": round(min(leader["fd"].phi(STRAGGLER), 99.0), 2),
+            }
+            degraded_before = degraded_now
+            idx = len(rounds)
+            if suspect_round is None and leader["fd"].suspect(STRAGGLER):
+                suspect_round = idx + 1  # 1-based: "within N rounds of onset"
+            if preexclude_round is None and rec["preexcluded"] == [STRAGGLER]:
+                preexclude_round = idx + 1
+            rounds.append(rec)
+        stop.set()
+        strag_task.cancel()
+        try:
+            await strag_task
+        except (asyncio.CancelledError, Exception):
+            pass
+        committed = [r for r in rounds if r["committed"]]
+        within = [r for r in rounds if r["within_budget"]]
+        out["faulted_deadline"] = {
+            "rounds": len(rounds),
+            "committed": len(committed),
+            "within_budget": len(within),
+            "within_budget_frac": round(len(within) / len(rounds), 4),
+            "degraded_commits": sum(r["degraded_commit"] for r in rounds),
+            "mean_round_s": round(
+                statistics.mean(r["dt_s"] for r in rounds), 3
+            ),
+            "overhead_allowance_s": round(overhead, 3),
+            "detector_suspect_after_rounds": suspect_round,
+            "leader_preexcludes_after_rounds": preexclude_round,
+            "straggler_phi_final": rounds[-1]["phi"],
+            "per_round": rounds,
+        }
+        print(f"[faulted/deadline] {len(within)}/{len(rounds)} within budget "
+              f"({100.0 * len(within) / len(rounds):.1f}%), straggler "
+              f"suspected after {suspect_round} round(s), pre-excluded "
+              f"after {preexclude_round} round(s)")
+
+        # -- phase 3: faulted, BLOCKING baseline --------------------------
+        # Deadline machinery off (the pre-tentpole behavior): rounds wait
+        # for the straggler's delayed push up to the full gather budget.
+        for v in vols:
+            v["avg"].resilience = None
+            v["avg"].round_deadline_s = None
+            v["avg"].matchmaker.exclude = None
+        blocking = []
+        base = args.warmup_rounds + args.faulted_rounds
+        for r in range(base, base + args.blocking_rounds):
+            dt, res, _ = await run_round(
+                vols, r, include_straggler=True,
+                timeout=3.0 * gather_timeout + 3.0 * delay,
+            )
+            blocking.append({
+                "round": r, "dt_s": round(dt, 3), "committed": res is not None,
+            })
+        mean_blocking = statistics.mean(b["dt_s"] for b in blocking)
+        out["faulted_blocking"] = {
+            "rounds": len(blocking),
+            "mean_round_s": round(mean_blocking, 3),
+            "per_round": blocking,
+        }
+        mean_deadline = out["faulted_deadline"]["mean_round_s"]
+        out["round_time_ratio_blocking_over_deadline"] = round(
+            mean_blocking / max(mean_deadline, 1e-9), 2
+        )
+        print(f"[faulted/blocking] mean round {mean_blocking:.2f}s vs "
+              f"deadline-bounded {mean_deadline:.2f}s "
+              f"({out['round_time_ratio_blocking_over_deadline']}x)")
+    finally:
+        for v in vols:
+            try:
+                await v["mem"].leave()
+            except Exception:
+                pass
+            try:
+                await v["dht"].stop()
+            except Exception:
+                pass
+            await v["t"].close()
+    return out
+
+
+# -- training phase (subprocess volunteers, real entrypoints) --------------
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def training_phase(args):
+    """4 real volunteers (run_volunteer.py) with --resilience, one stepping
+    x10 slow (DVC_STEP_DELAY_MS): the swarm must still cross target loss."""
+    coord = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "coordinator.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=_env(),
+    )
+    addr = None
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = coord.stdout.readline()
+        m = re.match(r"COORDINATOR_READY (\S+)", line or "")
+        if m:
+            addr = m.group(1)
+            break
+    if addr is None:
+        coord.kill()
+        raise RuntimeError("coordinator did not become ready")
+    common = [
+        "--coordinator", addr, "--model", "mnist_mlp",
+        "--model-override", "d_hidden=16",
+        "--averaging", "sync", "--average-every", "10",
+        "--batch-size", "16", "--lr", "0.01",
+        "--steps", str(args.train_steps),
+        "--target-loss", "1.0", "--target-mode", "record",
+        "--min-group", "2", "--max-group", "4",
+        "--join-timeout", "20", "--gather-timeout", "20",
+        "--resilience", "--round-deadline-s", "5",
+    ]
+    vols = []
+    try:
+        for i in range(4):
+            env = _env()
+            if i == 3:  # the straggler steps x10 slower than its peers
+                env["DVC_STEP_DELAY_MS"] = "150"
+            vols.append(subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "run_volunteer.py"),
+                 "--peer-id", f"t{i}", "--seed", str(i), *common],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=env,
+            ))
+        summaries = []
+        for v in vols:
+            out_text, _ = v.communicate(timeout=600)
+            for line in out_text.splitlines():
+                if line.startswith("VOLUNTEER_DONE "):
+                    summaries.append(json.loads(line[len("VOLUNTEER_DONE "):]))
+                    break
+            else:
+                raise AssertionError(f"no VOLUNTEER_DONE:\n{out_text[-3000:]}")
+    finally:
+        coord.kill()
+        for v in vols:
+            if v.poll() is None:
+                v.kill()
+    honest = summaries[:3]
+    crossed = [s.get("target_crossed_step") for s in honest]
+    return {
+        "volunteers": 4,
+        "straggler_step_delay_ms": 150,
+        "steps": args.train_steps,
+        "rounds_ok_total": sum(s.get("rounds_ok", 0) for s in summaries),
+        "rounds_degraded_total": sum(
+            s.get("rounds_degraded", 0) for s in summaries
+        ),
+        "final_losses": [round(s["final_loss"], 4) for s in summaries],
+        "target_crossed_steps_honest": crossed,
+        "target_crossed": all(c is not None for c in crossed),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--warmup-rounds", type=int, default=10)
+    ap.add_argument("--faulted-rounds", type=int, default=25)
+    ap.add_argument("--blocking-rounds", type=int, default=6)
+    ap.add_argument("--round-cadence-s", type=float, default=0.75,
+                    help="local-compute pause between faulted rounds")
+    ap.add_argument("--train-steps", type=int, default=120)
+    ap.add_argument("--no-train", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="short campaign, no training phase")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "experiments", "results", "chaos_soak.json"))
+    args = ap.parse_args()
+    if args.quick:
+        args.warmup_rounds = 6
+        args.faulted_rounds = 10
+        args.blocking_rounds = 3
+        args.no_train = True
+
+    result = {"campaign": asyncio.run(campaign(args))}
+    if not args.no_train:
+        print("[training] 4 subprocess volunteers, one x10-slow stepper ...")
+        result["training"] = training_phase(args)
+        print(f"[training] target crossed: {result['training']['target_crossed']}, "
+              f"final losses {result['training']['final_losses']}")
+
+    fd = result["campaign"]["faulted_deadline"]
+    result["verdict"] = {
+        "within_budget_frac": fd["within_budget_frac"],
+        "pass_95pct_within_budget": fd["within_budget_frac"] >= 0.95,
+        "detector_suspect_after_rounds": fd["detector_suspect_after_rounds"],
+        "pass_detector_within_3_rounds": (
+            fd["detector_suspect_after_rounds"] is not None
+            and fd["detector_suspect_after_rounds"] <= 3
+        ),
+        "round_time_ratio_blocking_over_deadline": result["campaign"][
+            "round_time_ratio_blocking_over_deadline"
+        ],
+    }
+    if "training" in result:
+        result["verdict"]["pass_target_crossed_under_fault"] = result[
+            "training"]["target_crossed"]
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"[done] artifact -> {args.out}")
+    print(json.dumps(result["verdict"], indent=2))
+    ok = all(v for k, v in result["verdict"].items() if k.startswith("pass_"))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

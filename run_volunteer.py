@@ -55,14 +55,19 @@ def main() -> None:
                     help="scan up to N train steps inside one compiled call "
                          "between cadence points (host-loop amortization; "
                          "params mode, no --mesh). 1 = off")
-    ap.add_argument("--average-interval-s", type=float, default=0.0,
+    ap.add_argument("--average-interval-s", type=float, default=None,
                     help="wall-clock averaging cadence in seconds (params "
                          "mode; 0 = every --average-every steps). Rounds "
                          "fire at absolute multiples of the interval, so "
-                         "NTP-synced heterogeneous volunteers rendezvous "
+                         "clock-synced heterogeneous volunteers rendezvous "
                          "within ms regardless of per-volunteer step speed; "
                          "contributions are weighted by actual window "
-                         "progress")
+                         "progress. Default AUTO: butterfly params-mode "
+                         "swarms (the heterogeneous config) get 20s "
+                         "wall-clock cadence — step cadence is measured-"
+                         "pathological there (BASELINE.md config 4 vs 4b, "
+                         "scale16) — every other mode keeps step cadence; "
+                         "pass an explicit 0 to force step cadence")
     ap.add_argument("--average-what", default="params", choices=("params", "grads"),
                     help="params = local-SGD periodic averaging; grads = GradientAverager")
     ap.add_argument("--wire", default="f32",
@@ -174,6 +179,26 @@ def main() -> None:
                     help="bound round waits by an EWMA of successful round "
                          "times (dead peers cost seconds, not the full "
                          "gather budget); --gather-timeout stays the ceiling")
+    ap.add_argument("--resilience", action="store_true",
+                    help="adaptive resilience layer: phi-accrual liveness "
+                         "(straggler pre-exclusion at group formation) plus "
+                         "the policy engine that learns round deadlines, "
+                         "backs off retries after failures, and escalates "
+                         "the robust estimator on rejection evidence "
+                         "(docs/RESILIENCE.md)")
+    ap.add_argument("--phi-threshold", type=float, default=8.0,
+                    help="suspicion threshold for the phi-accrual detector "
+                         "(8 ~ one-in-1e8 false-positive odds under the "
+                         "fitted heartbeat model; lower = more aggressive "
+                         "pre-exclusion)")
+    ap.add_argument("--round-deadline-s", type=float, default=0.0,
+                    help="static wall-clock budget per averaging round, "
+                         "seconds: the leader stamps clock()+budget into "
+                         "the round begin and the whole group COMMITS at "
+                         "that instant with the contributions that arrived "
+                         "(re-weighted mean over the subset). 0 = use "
+                         "--gather-timeout; --resilience supersedes both "
+                         "with its learned deadline")
     args = ap.parse_args()
 
     if args.list_models:
@@ -247,6 +272,9 @@ def main() -> None:
         join_timeout=args.join_timeout,
         gather_timeout=args.gather_timeout,
         adaptive_timeout=args.adaptive_timeout,
+        resilience=args.resilience,
+        phi_threshold=args.phi_threshold,
+        round_deadline_s=args.round_deadline_s,
         outer_optimizer=args.outer_optimizer,
         outer_lr=args.outer_lr,
         outer_momentum=args.outer_momentum,

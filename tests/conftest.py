@@ -26,6 +26,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: payload-scale / long-running tests (opt-in: -m slow or DVC_RUN_SLOW=1)"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (drop/delay/corrupt/partition, fault "
+        "schedules, deadline-bounded degradation) — in the default lane, and "
+        "selectable on their own with -m chaos",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -983,3 +983,200 @@ class TestDerivedTrimFloor:
         assert done
         for r in done[:2]:
             assert 0.9 < float(np.asarray(r["w"]).mean()) < 1.3
+
+
+class TestDeadlineCommit:
+    """Deadline-bounded rounds: commit with the contributions that arrived
+    by the budget, re-weighting over the subset, instead of blocking on the
+    slowest participant (OptiReduce genre — the resilience tentpole)."""
+
+    def test_deadline_wait_clamps(self):
+        """_deadline_wait: bounded below by the floor (a slow formation
+        must not commit with nothing) and above by the local ceiling (a
+        crafted/skewed foreign deadline can't extend our wait)."""
+        from distributedvolunteercomputing_tpu.swarm.matchmaking import Group
+
+        async def main():
+            avg = SyncAverager(
+                *await _solo_stack("solo"), gather_timeout=10.0,
+                round_deadline_s=3.0,
+            )
+            try:
+                members = [("solo", ("h", 1))]
+                # No deadline in the begin (legacy leader): the budget.
+                g = Group(epoch="e", members=members, my_index=0)
+                assert avg._deadline_wait(g) == pytest.approx(3.0)
+                # Deadline already passed: clamped to the floor, not negative.
+                g = Group(epoch="e", members=members, my_index=0,
+                          deadline=avg.clock() - 100.0)
+                assert avg._deadline_wait(g) == pytest.approx(0.5)
+                # Absurd far-future deadline: clamped to the local ceiling.
+                g = Group(epoch="e", members=members, my_index=0,
+                          deadline=avg.clock() + 10_000.0)
+                assert avg._deadline_wait(g) <= 10.0 + 1e-6
+            finally:
+                await avg.transport.close()
+
+        run(main())
+
+    def test_deadline_wait_skew_guard_without_clocksync(self):
+        """Step-cadence swarms stamp deadlines on raw wall time. A member
+        whose clock runs AHEAD of the leader's by more than the budget
+        would read the round as already expired and collapse every wait to
+        the floor (timing out its own pushes round after round, straight
+        into pre-exclusion). With the begin-carried budget, the wait is
+        counted from when this node learned the round instead — skew-free.
+        A synced averager (explicit clock=) keeps trusting the consensus
+        deadline, where a small remaining wait is REAL fan-out spend."""
+        import time as _time
+
+        from distributedvolunteercomputing_tpu.swarm.matchmaking import Group
+
+        async def main():
+            avg = SyncAverager(
+                *await _solo_stack("skewed"), gather_timeout=10.0,
+            )
+            try:
+                members = [("skewed", ("h", 1))]
+                # Leader stamped deadline = its_clock + 3.0; our wall clock
+                # runs 60s ahead, so the consensus view says long expired.
+                g = Group(epoch="e", members=members, my_index=0,
+                          deadline=avg.clock() - 57.0, budget=3.0)
+                assert avg._deadline_wait(g) == pytest.approx(3.0, abs=0.2)
+                # And a begin WITHOUT a budget (legacy leader) still follows
+                # the consensus deadline: floor, not a full-budget wait.
+                g = Group(epoch="e", members=members, my_index=0,
+                          deadline=avg.clock() - 57.0)
+                assert avg._deadline_wait(g) == pytest.approx(0.5)
+                # Synced averager: consensus remaining wins even when the
+                # budget says more (late begin, not skew).
+                synced = SyncAverager(
+                    *await _solo_stack("synced"), gather_timeout=10.0,
+                    clock=_time.time,
+                )
+                try:
+                    g = Group(epoch="e", members=members, my_index=0,
+                              deadline=synced.clock() + 1.0, budget=3.0)
+                    assert synced._deadline_wait(g) == pytest.approx(1.0, abs=0.2)
+                finally:
+                    await synced.transport.close()
+            finally:
+                await avg.transport.close()
+
+        run(main())
+
+    def test_sync_commits_partial_at_deadline_and_reweights(self):
+        """3-member group, one silent: the round must commit at ~the
+        deadline with the two arrived contributions, the mean re-weighted
+        over the ARRIVED weight (not the expected group weight), and the
+        leader's resilience policy must record the silent peer absent in a
+        degraded round."""
+        import time as _time
+
+        from distributedvolunteercomputing_tpu.swarm.resilience import (
+            ResiliencePolicy,
+        )
+
+        class SilentSync(SyncAverager):
+            # Passes matchmaking like a live peer, then contributes nothing.
+            async def average(self, tree, round_no, weight=1.0):
+                await self.matchmaker.form_group(
+                    self.round_key, self.min_group, self.max_group,
+                    self.join_timeout,
+                )
+                return None
+
+        async def main():
+            vols = await spawn_volunteers(
+                2, SyncAverager, min_group=2, max_group=3,
+                gather_timeout=30.0, join_timeout=8.0, round_deadline_s=2.5,
+            )
+            # Leader-side policy (vol0 < vol1 < zz-silent sorts first, so
+            # vol0 leads): learns per-peer outcomes from this round.
+            policy = ResiliencePolicy(max_deadline_s=2.5, min_deadline_s=1.0)
+            vols[0][3].resilience = policy
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=[vols[0][0].addr])
+            mem = SwarmMembership(dht, "zz-silent", ttl=10.0)
+            await mem.join()
+            silent = SilentSync(
+                t, dht, mem, min_group=2, max_group=3,
+                gather_timeout=30.0, join_timeout=8.0,
+            )
+            try:
+                t0 = _time.monotonic()
+                silent_task = asyncio.create_task(
+                    silent.average(make_tree(9.0), 1)
+                )
+                await asyncio.sleep(0.3)  # silent is announced first
+                ra, rb = await asyncio.gather(
+                    vols[0][3].average(make_tree(1.0), 1),
+                    vols[1][3].average(make_tree(3.0), 1),
+                )
+                await silent_task
+                dt = _time.monotonic() - t0
+                # Both honest members committed, at the deadline-bounded
+                # wait — nowhere near the 30s gather budget.
+                assert ra is not None and rb is not None
+                assert dt < 15.0, dt
+                # Re-weighted mean over the ARRIVED subset: (1 + 3) / 2.
+                # (Normalizing by the expected group weight would give 1.33.)
+                leaves_close(ra, 2.0)
+                leaves_close(rb, 2.0)
+                # The leader saw a degraded (partial-participation) commit
+                # and recorded the straggler absent.
+                stats = vols[0][3].stats()
+                assert stats["rounds_degraded"] == 1
+                res = stats["resilience"]
+                assert res["rounds_degraded"] == 1
+                assert res["peers"]["zz-silent"]["absent"] >= 1.0
+                assert res["peers"]["vol1"]["on_time"] >= 1.0
+                # Three straggler rounds and the policy pre-excludes it.
+                policy.record_round(duration_s=2.5, ok=True, degraded=True,
+                                    absent=["zz-silent"])
+                policy.record_round(duration_s=2.5, ok=True, degraded=True,
+                                    absent=["zz-silent"])
+                assert policy.should_preexclude("zz-silent")
+            finally:
+                await t.close()
+                await teardown(vols)
+
+        run(main())
+
+    def test_leader_preexcludes_suspected_straggler_from_formation(self):
+        """The matchmaker drops peers the leader's policy flags BEFORE the
+        member list freezes — they stay in the swarm, they just don't gate
+        this round (and never below min_group)."""
+        from distributedvolunteercomputing_tpu.swarm.resilience import (
+            ResiliencePolicy,
+        )
+
+        async def main():
+            vols = await spawn_volunteers(
+                3, SyncAverager, min_group=2, max_group=3,
+                gather_timeout=8.0, join_timeout=8.0,
+            )
+            policy = ResiliencePolicy(max_deadline_s=8.0, preexclude_misses=3)
+            for _ in range(3):
+                policy.record_round(duration_s=1.0, ok=True, absent=["vol2"])
+            leader = vols[0][3]
+            leader.resilience = policy
+            leader.matchmaker.exclude = policy.should_preexclude
+            try:
+                ra, rb, rc = await asyncio.gather(
+                    vols[0][3].average(make_tree(0.0), 1),
+                    vols[1][3].average(make_tree(2.0), 1),
+                    vols[2][3].average(make_tree(9.0), 1),
+                )
+                # The two kept members averaged without the straggler...
+                assert ra is not None and rb is not None
+                leaves_close(ra, 1.0)
+                leaves_close(rb, 1.0)
+                # ...which was excluded at formation (no begin, no round).
+                assert rc is None
+                assert leader.matchmaker.last_preexcluded == ["vol2"]
+            finally:
+                await teardown(vols)
+
+        run(main())

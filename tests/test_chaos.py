@@ -12,6 +12,12 @@ from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
 from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
 
 
+# The whole module is the fault-injection lane: `pytest -m chaos` runs
+# exactly these (plus chaos-marked tests elsewhere); the default lane still
+# includes them (the marker selects, it never skips).
+pytestmark = pytest.mark.chaos
+
+
 def run(coro):
     return asyncio.run(asyncio.wait_for(coro, timeout=90))
 
@@ -36,6 +42,52 @@ def test_corrupt_frame_rejected_by_crc():
         finally:
             await client.close()
             await server.close()
+
+    run(scenario())
+
+
+def test_scheduled_corruption_stays_on_the_scheduled_call():
+    """Scheduled corruption is decided per CALL but applied per FRAME; under
+    concurrent pushes the corruption must land on exactly the scheduled
+    destination's frame. A schedule corrupting only server A, driven with
+    interleaved calls to A and B, must fail every A call at the CRC and
+    never touch a B call (a shared next-frame flag let B steal A's fault)."""
+    from distributedvolunteercomputing_tpu.swarm.chaos import (
+        FaultSchedule,
+        fault_event,
+    )
+
+    async def scenario():
+        a, b = Transport(), Transport()
+
+        async def echo(args, payload):
+            return {"n": len(payload)}, payload
+
+        for srv in (a, b):
+            srv.register("echo", echo)
+            await srv.start()
+        sched = FaultSchedule(
+            [fault_event(0, None, "corrupt", 1.0, targets=[a.addr])], seed=3
+        )
+        sched.start()
+        client = ChaosTransport(schedule=sched)
+        await client.start()
+        try:
+            for _ in range(4):
+                results = await asyncio.gather(
+                    client.call(a.addr, "echo", {}, b"x" * 512, timeout=10),
+                    client.call(b.addr, "echo", {}, b"y" * 512, timeout=10),
+                    client.call(b.addr, "echo", {}, b"z" * 512, timeout=10),
+                    return_exceptions=True,
+                )
+                assert isinstance(results[0], RPCError), results[0]
+                for r in results[1:]:
+                    assert not isinstance(r, BaseException), r
+                    assert r[0]["n"] == 512
+        finally:
+            await client.close()
+            await a.close()
+            await b.close()
 
     run(scenario())
 
@@ -213,3 +265,146 @@ class TestAsyncioInvariants:
 
         stalls = run(scenario())
         assert not stalls, f"averaging round blocked the loop: {stalls}"
+
+
+class TestFaultSchedule:
+    """Deterministic, seedable fault scripts — the chaos-campaign substrate."""
+
+    def test_window_effects_combine(self):
+        """Delays ADD across overlapping windows; drop/corrupt probabilities
+        take the max; partition is drop at rate 1.0; target scoping cuts
+        exactly the named edge."""
+        from distributedvolunteercomputing_tpu.swarm.chaos import (
+            FaultSchedule,
+            fault_event,
+        )
+
+        addr_a, addr_b = ("10.0.0.1", 1), ("10.0.0.2", 2)
+        sched = FaultSchedule(
+            [
+                fault_event(10, 20, "delay", 0.5),
+                fault_event(15, 25, "delay", 0.25),
+                fault_event(10, 20, "drop", 0.3),
+                fault_event(12, 18, "drop", 0.1),
+                fault_event(30, 40, "partition", targets=[addr_a]),
+                fault_event(30, 40, "corrupt", 0.2),
+            ]
+        )
+        sched.start(now=1000.0)
+        # Before any window: clean.
+        assert sched.effects(addr_a, now=1000.0) == (0.0, 0.0, 0.0)
+        # t=16: both delays active (add), both drops active (max).
+        delay, drop, corrupt = sched.effects(addr_a, now=1016.0)
+        assert delay == 0.75 and drop == 0.3 and corrupt == 0.0
+        # t=35: partition scoped to addr_a only; corrupt hits everyone.
+        assert sched.effects(addr_a, now=1035.0) == (0.0, 1.0, 0.2)
+        assert sched.effects(addr_b, now=1035.0) == (0.0, 0.0, 0.2)
+        # Window end is exclusive.
+        assert sched.effects(addr_a, now=1040.0) == (0.0, 0.0, 0.0)
+
+    def test_not_started_is_inert(self):
+        from distributedvolunteercomputing_tpu.swarm.chaos import (
+            FaultSchedule,
+            fault_event,
+        )
+
+        sched = FaultSchedule([fault_event(0, 1e9, "partition")])
+        assert sched.effects(("h", 1)) == (0.0, 0.0, 0.0)
+
+    def test_seeded_coin_flips_reproduce(self):
+        """Same seed -> same fault decisions; restart() rewinds the rng, so
+        replaying a campaign reproduces it exactly."""
+        from distributedvolunteercomputing_tpu.swarm.chaos import FaultSchedule
+
+        a = FaultSchedule([], seed=42)
+        b = FaultSchedule([], seed=42)
+        c = FaultSchedule([], seed=7)
+        a.start(now=0.0)
+        b.start(now=0.0)
+        c.start(now=0.0)
+        flips_a = [a.coin(0.5) for _ in range(64)]
+        assert flips_a == [b.coin(0.5) for _ in range(64)]
+        assert flips_a != [c.coin(0.5) for _ in range(64)]
+        a.start(now=100.0)  # restart = same coin sequence again
+        assert flips_a == [a.coin(0.5) for _ in range(64)]
+
+    def test_validation(self):
+        from distributedvolunteercomputing_tpu.swarm.chaos import fault_event
+
+        with pytest.raises(ValueError, match="kind"):
+            fault_event(0, 1, "meteor")
+        with pytest.raises(ValueError, match="window"):
+            fault_event(5, 1, "drop")
+
+    def test_scheduled_partition_drops_then_heals(self):
+        """End-to-end through ChaosTransport: calls inside a partition
+        window fail deterministically; the same transport works again once
+        the window has passed (no sleeps — the second schedule's window is
+        already over when it starts)."""
+        from distributedvolunteercomputing_tpu.swarm.chaos import (
+            FaultSchedule,
+            fault_event,
+        )
+
+        async def scenario():
+            server = Transport()
+
+            async def echo(args, payload):
+                return {"n": len(payload)}, payload
+
+            server.register("echo", echo)
+            await server.start()
+            # Scope the partition to the server's actual (runtime) addr.
+            sched = FaultSchedule(
+                [fault_event(0, 3600, "partition", targets=[server.addr])],
+                seed=3,
+            )
+            client = ChaosTransport(schedule=sched)
+            await client.start()
+            try:
+                sched.start()
+                with pytest.raises(OSError, match="chaos schedule"):
+                    await client.call(server.addr, "echo", {}, b"x", timeout=5)
+                # Heal: re-anchor the schedule so the window is in the past.
+                sched.start(now=__import__("time").monotonic() - 4000.0)
+                ret, payload = await client.call(
+                    server.addr, "echo", {}, b"hi", timeout=5
+                )
+                assert payload == b"hi"
+            finally:
+                await client.close()
+                await server.close()
+
+        run(scenario())
+
+    def test_scheduled_slow_peer_delays_calls(self):
+        """A 'slow peer' window really defers delivery: the call completes,
+        but not before the scripted delay has elapsed."""
+        import time as _time
+
+        from distributedvolunteercomputing_tpu.swarm.chaos import (
+            FaultSchedule,
+            fault_event,
+        )
+
+        async def scenario():
+            server = Transport()
+
+            async def echo(args, payload):
+                return {}, payload
+
+            server.register("echo", echo)
+            await server.start()
+            sched = FaultSchedule([fault_event(0, 3600, "delay", 0.4)])
+            client = ChaosTransport(schedule=sched)
+            await client.start()
+            try:
+                sched.start()
+                t0 = _time.monotonic()
+                await client.call(server.addr, "echo", {}, b"x", timeout=10)
+                assert _time.monotonic() - t0 >= 0.4
+            finally:
+                await client.close()
+                await server.close()
+
+        run(scenario())

@@ -1,0 +1,149 @@
+"""Phi-accrual failure detector: suspicion-score transitions under a
+controlled clock (no sleeps — every scenario advances a fake monotonic
+clock explicitly, so the tests are exact and instant)."""
+
+import math
+
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm.failure_detector import (
+    DEFAULT_PHI_THRESHOLD,
+    PhiAccrualDetector,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def beat_regularly(det, clock, peer, n, gap):
+    for _ in range(n):
+        clock.advance(gap)
+        det.heartbeat(peer)
+
+
+class TestScoring:
+    def test_unknown_peer_scores_zero(self):
+        det = PhiAccrualDetector(clock=FakeClock())
+        assert det.phi("ghost") == 0.0
+        assert not det.suspect("ghost")
+
+    def test_healthy_peer_stays_unsuspected(self):
+        """Beating on schedule keeps phi near zero: just after a beat the
+        elapsed silence is ~0, and at one nominal gap of silence the model
+        says 'this is normal' (phi well under the threshold)."""
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock)
+        beat_regularly(det, clock, "p", n=20, gap=1.0)
+        assert det.phi("p") < 0.5
+        clock.advance(1.0)
+        assert det.phi("p") < DEFAULT_PHI_THRESHOLD
+        assert not det.suspect("p")
+
+    def test_silence_accrues_to_suspicion(self):
+        """The transition the averaging tier consumes: a peer with a learned
+        ~1s cadence that goes silent crosses the suspicion threshold as the
+        silence grows — and phi is MONOTONE in the silence (no flapping on
+        a dead peer)."""
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock)
+        beat_regularly(det, clock, "p", n=20, gap=1.0)
+        phis = []
+        for _ in range(10):
+            clock.advance(1.0)
+            phis.append(det.phi("p"))
+        assert all(b >= a for a, b in zip(phis, phis[1:])), phis
+        assert phis[0] < DEFAULT_PHI_THRESHOLD  # 1 gap late: not suspected
+        assert phis[-1] >= DEFAULT_PHI_THRESHOLD  # 10 gaps silent: suspected
+        assert det.suspect("p")
+        assert "p" in det.suspected()
+
+    def test_bootstrap_allows_suspicion_before_history(self):
+        """A peer heard from ONCE must still become suspectable: the
+        bootstrap gap model covers the window before MIN_SAMPLES real
+        inter-arrival samples exist."""
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock, bootstrap_s=5.0)
+        det.heartbeat("newborn")
+        clock.advance(1.0)
+        assert not det.suspect("newborn")
+        clock.advance(120.0)
+        assert det.suspect("newborn")
+
+    def test_min_std_floor_prevents_infinite_spike(self):
+        """Near-periodic localhost heartbeats fit std ~ 0; without the
+        floor, the first slightly-late beat would send phi to infinity."""
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock, min_std_s=0.25)
+        beat_regularly(det, clock, "p", n=20, gap=1.0)  # exactly periodic
+        clock.advance(1.3)  # 0.3s late — within one std floor
+        assert math.isfinite(det.phi("p"))
+        assert det.phi("p") < DEFAULT_PHI_THRESHOLD
+
+    def test_suspicion_clears_on_next_beat(self):
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock)
+        beat_regularly(det, clock, "p", n=10, gap=1.0)
+        clock.advance(30.0)
+        assert det.suspect("p")
+        det.heartbeat("p")  # it was slow, not dead
+        assert det.phi("p") < 1.0
+        assert not det.suspect("p")
+
+
+class TestFeeding:
+    def test_duplicate_observation_is_not_a_beat(self):
+        """Re-reading the same membership record must not fabricate
+        arrivals (gap <= 0 is a re-observation, not a heartbeat)."""
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock)
+        det.heartbeat("p", t=5.0)
+        det.heartbeat("p", t=5.0)
+        det.heartbeat("p", t=4.0)
+        assert len(det._gaps.get("p", ())) == 0
+
+    def test_forget_resets_history(self):
+        """A tombstoned peer's rejoin starts clean: its own absence must
+        not be inherited as one giant inter-arrival sample."""
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock)
+        beat_regularly(det, clock, "p", n=10, gap=1.0)
+        clock.advance(600.0)
+        assert det.suspect("p")
+        det.forget("p")
+        assert det.phi("p") == 0.0
+        det.heartbeat("p")  # rejoin
+        assert not det.suspect("p")
+        assert len(det._gaps.get("p", ())) == 0
+
+    def test_window_bounds_memory(self):
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock, window=8)
+        beat_regularly(det, clock, "p", n=100, gap=1.0)
+        assert len(det._gaps["p"]) == 8
+
+    def test_snapshot_shape(self):
+        clock = FakeClock()
+        det = PhiAccrualDetector(clock=clock)
+        beat_regularly(det, clock, "p", n=5, gap=2.0)
+        snap = det.snapshot()
+        assert snap["p"]["n_samples"] == 4
+        assert snap["p"]["mean_gap_s"] == pytest.approx(2.0)
+        assert snap["p"]["phi"] >= 0.0
+
+
+class TestValidation:
+    def test_bad_window(self):
+        with pytest.raises(ValueError, match="window"):
+            PhiAccrualDetector(window=1)
+
+    def test_bad_threshold(self):
+        with pytest.raises(ValueError, match="threshold"):
+            PhiAccrualDetector(threshold=0.0)

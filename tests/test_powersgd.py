@@ -529,25 +529,59 @@ class TestWireStateCheckpoint:
 
         run(main())
 
-    def test_mismatched_state_reseeds_silently(self):
+    def test_mismatched_state_reseeds_loudly(self):
+        """A wire-sidecar mismatch re-seeds compressor state (cold-start
+        semantics) but must be LOUD about it: one warning naming the
+        old/new wire+rank+size, so a fleet-wide wire or rank change is
+        diagnosable from a single log line instead of silently costing the
+        EF residual (VERDICT r5 #6)."""
+        from unittest import mock
+
+        from distributedvolunteercomputing_tpu.swarm import averager as avg_mod
         from tests.test_averaging import _solo_stack
+
+        def warnings_of(warn_mock):
+            return [
+                (c.args[0] % tuple(c.args[1:])) if len(c.args) > 1 else c.args[0]
+                for c in warn_mock.call_args_list
+            ]
 
         async def main():
             rng = np.random.default_rng(8)
             a = ByzantineAverager(*await _solo_stack("a"), wire="powersgd")
             try:
-                a.load_wire_state({"wire": np.bytes_(b"topk"), "ef": np.ones(3, np.float32)})
-                buf = a._pack(psgd_tree(rng=rng))
+                with mock.patch.object(avg_mod.log, "warning") as warn:
+                    a.load_wire_state(
+                        {"wire": np.bytes_(b"topk"), "ef": np.ones(3, np.float32)}
+                    )
+                    buf = a._pack(psgd_tree(rng=rng))
                 assert a._ef_residual is None  # wrong wire: dropped whole
-                # Right wire, wrong sizes: EF dropped, Qs dropped, no crash.
-                a.load_wire_state({
-                    "wire": np.bytes_(b"powersgd"),
-                    "ef": np.ones(3, np.float32),
-                    "rank": np.int64(4),
-                    "q_1": np.ones((999, 4), np.float32),
-                })
+                msgs = warnings_of(warn)
+                assert any(
+                    "wire=topk" in m and "wire=powersgd" in m for m in msgs
+                ), msgs
+                # Right wire, wrong EF size AND wrong rank: both named, no
+                # crash, still functional.
+                with mock.patch.object(avg_mod.log, "warning") as warn:
+                    a.load_wire_state({
+                        "wire": np.bytes_(b"powersgd"),
+                        "ef": np.ones(3, np.float32),
+                        "rank": np.int64(2),
+                        "q_1": np.ones((999, 2), np.float32),
+                    })
                 assert a._ef_residual is None
+                msgs = warnings_of(warn)
+                # The regression this guards: a RANK change must fire a
+                # warning naming both ranks (it used to re-seed silently).
+                assert any("rank=2" in m and "rank=4" in m for m in msgs), msgs
+                assert any("size 3" in m for m in msgs), msgs
                 a._compress_contribution(buf)  # still functional
+                # And a MATCHING sidecar stays quiet (no warning spam on
+                # every healthy restore).
+                state = a.wire_state()
+                with mock.patch.object(avg_mod.log, "warning") as warn:
+                    a.load_wire_state(state)
+                assert not warn.call_args_list, warnings_of(warn)
             finally:
                 await a.transport.close()
 

@@ -1,0 +1,222 @@
+"""Adaptive resilience policy: deadline learning, failure backoff,
+pre-exclusion, and runtime estimator escalation — all under explicit
+inputs (no clocks, no swarms; the policy is pure bookkeeping)."""
+
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm.resilience import ResiliencePolicy
+
+
+class FakeDetector:
+    def __init__(self, suspects=()):
+        self.suspects = set(suspects)
+
+    def suspect(self, peer):
+        return peer in self.suspects
+
+
+def complete_round(policy, duration_s, **kw):
+    policy.record_round(duration_s=duration_s, ok=True, **kw)
+
+
+class TestDeadline:
+    def test_starts_at_ceiling(self):
+        p = ResiliencePolicy(max_deadline_s=20.0)
+        assert p.round_budget() == 20.0
+
+    def test_initial_deadline_clamped(self):
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=2.0,
+                             initial_deadline_s=500.0)
+        assert p.round_budget() == 20.0
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=2.0,
+                             initial_deadline_s=0.5)
+        assert p.round_budget() == 2.0
+
+    def test_learns_down_from_fast_rounds(self):
+        """A healthy swarm's deadline converges toward observed round time
+        + margin, far under the configured ceiling — the property that
+        makes a stalled peer cheap."""
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=1.0)
+        for _ in range(20):
+            complete_round(p, 0.5)
+        assert 1.0 <= p.round_budget() < 4.0, p.round_budget()
+
+    def test_failed_round_doubles_toward_ceiling(self):
+        """AIMD recovery: a genuinely slow network must ratchet the budget
+        back up instead of timing out forever at a learned-tight deadline."""
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=1.0)
+        for _ in range(20):
+            complete_round(p, 0.5)
+        tight = p.round_budget()
+        p.record_round(duration_s=tight, ok=False)
+        assert p.round_budget() == pytest.approx(min(tight * 2.0, 20.0))
+        for _ in range(5):
+            p.record_round(duration_s=1.0, ok=False)
+        assert p.round_budget() == 20.0  # capped at the ceiling
+
+    def test_degraded_round_is_not_an_observation(self):
+        """A deadline-committed round took ~the deadline BY CONSTRUCTION;
+        feeding it back would ratchet the estimate to the ceiling in
+        exactly the persistent-straggler case the policy targets."""
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=1.0)
+        for _ in range(20):
+            complete_round(p, 0.5)
+        tight = p.round_budget()
+        for _ in range(10):
+            p.record_round(duration_s=tight, ok=True, degraded=True)
+        assert p.round_budget() == pytest.approx(tight)
+        assert p.rounds_degraded == 10
+
+    def test_one_fast_outlier_does_not_slam_deadline(self):
+        """Multiplicative decrease TOWARD the estimate: one unusually fast
+        round must not cut the budget onto the next round's normal tail."""
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=0.5)
+        for _ in range(20):
+            complete_round(p, 5.0)
+        settled = p.round_budget()
+        complete_round(p, 0.1)
+        assert p.round_budget() > settled * 0.5
+
+
+class TestBackoff:
+    def test_exponential_growth_and_reset(self):
+        p = ResiliencePolicy()
+        assert p.backoff_s() == 0.0
+        p.record_round(duration_s=1.0, ok=False)
+        first = p.backoff_s()
+        assert first > 0.0
+        p.record_round(duration_s=1.0, ok=False)
+        assert p.backoff_s() == pytest.approx(first * 2.0)
+        for _ in range(20):
+            p.record_round(duration_s=1.0, ok=False)
+        assert p.backoff_s() <= 30.0  # capped
+        complete_round(p, 1.0)  # one success clears the backoff
+        assert p.backoff_s() == 0.0
+
+
+class TestPreExclusion:
+    def test_miss_streak_triggers_preexclusion(self):
+        p = ResiliencePolicy(preexclude_misses=3)
+        for _ in range(2):
+            complete_round(p, 1.0, absent=["lag"])
+        assert not p.should_preexclude("lag")
+        complete_round(p, 1.0, absent=["lag"])
+        assert p.should_preexclude("lag")
+
+    def test_on_time_resets_streak(self):
+        p = ResiliencePolicy(preexclude_misses=3)
+        for _ in range(2):
+            complete_round(p, 1.0, late=["flaky"])
+        complete_round(p, 1.0, on_time=["flaky"])
+        complete_round(p, 1.0, absent=["flaky"])
+        assert not p.should_preexclude("flaky")
+
+    def test_late_and_rejected_count_as_misses(self):
+        p = ResiliencePolicy(preexclude_misses=3)
+        complete_round(p, 1.0, late=["p"])
+        complete_round(p, 1.0, rejected=["p"])
+        complete_round(p, 1.0, absent=["p"])
+        assert p.should_preexclude("p")
+
+    def test_phi_suspicion_preexcludes(self):
+        det = FakeDetector(suspects={"stalled"})
+        p = ResiliencePolicy(failure_detector=det)
+        assert p.should_preexclude("stalled")
+        assert not p.should_preexclude("healthy")
+
+    def test_late_arrival_outside_round_batch(self):
+        p = ResiliencePolicy(preexclude_misses=3)
+        for _ in range(3):
+            p.record_late_arrival("slow")
+        assert p.should_preexclude("slow")
+
+    def test_late_after_absent_counts_one_miss(self):
+        """A slow-but-alive peer is seen twice per round — absent in the
+        commit-time batch, then late when its push finally lands. That is
+        ONE missed round: the arrival reclassifies the absent event, it
+        must not advance the streak (or the counters) a second time."""
+        p = ResiliencePolicy(preexclude_misses=3)
+        for _ in range(2):
+            complete_round(p, 1.0, absent=["slow"])
+            p.record_late_arrival("slow")
+        # 2 slow rounds: still below the documented 3-round threshold
+        # (double counting used to pre-exclude here).
+        assert not p.should_preexclude("slow")
+        assert p.peers["slow"].miss_streak == 2
+        # The events were reclassified, not duplicated.
+        assert p.peers["slow"].absent == pytest.approx(0.0)
+        complete_round(p, 1.0, absent=["slow"])
+        assert p.should_preexclude("slow")
+
+    def test_late_before_flush_counts_one_miss(self):
+        """Same slow round, opposite arrival order: the push lands between
+        the commit and the round flush (record_late_arrival first, the
+        absent batch after). Still one miss."""
+        p = ResiliencePolicy(preexclude_misses=3)
+        for _ in range(2):
+            p.record_late_arrival("slow")
+            complete_round(p, 1.0, absent=["slow"])
+        assert not p.should_preexclude("slow")
+        assert p.peers["slow"].miss_streak == 2
+        complete_round(p, 1.0, absent=["slow"])
+        assert p.should_preexclude("slow")
+
+    def test_tight_gather_timeout_below_deadline_floor(self):
+        """--resilience with a sub-2s --gather-timeout (tight LAN) must
+        construct, the way the volunteer wires it: the default 2s deadline
+        floor clamps to the ceiling instead of tripping the range check."""
+        p = ResiliencePolicy(
+            max_deadline_s=1.5, min_deadline_s=min(2.0, 1.5)
+        )
+        assert p.round_budget() == pytest.approx(1.5)
+
+
+class TestEstimatorEscalation:
+    def test_ladder_escalates_on_rejection_evidence(self):
+        p = ResiliencePolicy(escalate_rejections=3.0)
+        assert p.recommend_method("mean") == "mean"
+        for _ in range(3):
+            p.record_rejection("byz")
+        assert p.recommend_method("mean") == "trimmed_mean"
+        for _ in range(3):
+            p.record_rejection("byz")
+        assert p.recommend_method("mean") == "coordinate_median"
+
+    def test_operator_chosen_method_is_the_floor(self):
+        """Escalation only lifts an explicitly-cheap 'mean'; an operator's
+        robust choice (krum, trimmed_mean, ...) is never overridden."""
+        p = ResiliencePolicy(escalate_rejections=1.0)
+        for _ in range(10):
+            p.record_rejection("byz")
+        assert p.recommend_method("krum") == "krum"
+        assert p.recommend_method("trimmed_mean") == "trimmed_mean"
+
+    def test_deescalates_only_after_evidence_decays(self):
+        """No flapping: the ladder steps down only once the decayed
+        rejection score is essentially gone."""
+        p = ResiliencePolicy(escalate_rejections=3.0, decay=0.5)
+        for _ in range(3):
+            p.record_rejection("byz")
+        assert p.recommend_method("mean") == "trimmed_mean"
+        complete_round(p, 1.0)  # one clean round: evidence not gone yet
+        assert p.recommend_method("mean") == "trimmed_mean"
+        for _ in range(5):  # 1.5 * 0.5^k < 0.5 within a few clean rounds
+            complete_round(p, 1.0)
+        assert p.recommend_method("mean") == "mean"
+
+
+class TestBookkeeping:
+    def test_stats_shape(self):
+        p = ResiliencePolicy()
+        complete_round(p, 1.0, on_time=["a"], absent=["b"])
+        s = p.stats()
+        assert s["rounds_seen"] == 1
+        assert s["method_level"] == "mean"
+        assert s["peers"]["a"]["on_time"] == pytest.approx(1.0)
+        assert s["peers"]["b"]["miss_streak"] == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="deadline"):
+            ResiliencePolicy(max_deadline_s=1.0, min_deadline_s=2.0)
+        with pytest.raises(ValueError, match="decay"):
+            ResiliencePolicy(decay=0.0)
