@@ -47,7 +47,11 @@ from distributedvolunteercomputing_tpu.ops import robust
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.matchmaking import Group, Matchmaker
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
-from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+from distributedvolunteercomputing_tpu.swarm.transport import (
+    RPCError,
+    StreamPayload,
+    Transport,
+)
 from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer, unflatten_from_buffer
 
@@ -600,11 +604,21 @@ class AveragerBase:
         ``_commit_ef(ok)`` once the round's outcome is known. For every other
         codec this is (_to_wire, lazy decode of the same bytes); the dense
         view is lazy because sync members never need it — only the leader
-        and the byzantine path stack their own contribution."""
+        and the byzantine path stack their own contribution.
+
+        The f32/bf16 wires return a StreamPayload instead of bytes when the
+        payload is big: chunks are encoded lazily while the transport is
+        already writing earlier chunks (encode/send overlap), and the
+        factory re-iterates for the byzantine full-mesh fan-out (one lazy
+        encoding per push, none of them materializing the whole buffer)."""
         if self.wire not in ("topk", "powersgd", "sign"):
-            wire = self._to_wire(buf)
             if self.wire == "f32":
-                return wire, lambda: buf
+                return self._wire_stream(buf), lambda: buf
+            if self.wire == "bf16":
+                # Dense view via the roundtrip helper, not the wire bytes —
+                # the wire may be a lazy stream that is never materialized.
+                return self._wire_stream(buf), lambda: self._wire_roundtrip(buf)
+            wire = self._to_wire(buf)
             return wire, lambda: self._buf_from_payload(wire)
         # Lossy-truncation codecs share the error-feedback protocol: add the
         # banked residual, truncate, stage (buf - sent) as PENDING until the
@@ -798,6 +812,88 @@ class AveragerBase:
     async def _encode_wire(self, buf: np.ndarray) -> bytes:
         return await asyncio.to_thread(self._to_wire, buf)
 
+    def _wire_stream(self, buf: np.ndarray):
+        """Wire form of ``buf`` as a lazily-encoded StreamPayload when the
+        codec is elementwise (f32/bf16: encoding a slice == slice of the
+        encoding) and the payload is big enough to chunk. The transport
+        pulls each chunk on a worker thread while the previous chunk is
+        already on the socket — encode/send overlap — instead of paying a
+        full encode before the first byte moves. Other codecs (q8's
+        scales-then-data layout, the sparse/low-rank containers) are not
+        slice-concatenable and return whole bytes, which the transport
+        still chunk-frames on the wire."""
+        cb = self.transport.chunk_bytes
+        if self.wire == "f32" and buf.nbytes > cb:
+            step = cb // 4
+
+            def gen(b=buf, step=step):
+                for i in range(0, b.size, step):
+                    yield b[i : i + step].tobytes()
+
+            return StreamPayload(buf.size * 4, gen)
+        if self.wire == "bf16" and buf.size * 2 > cb:
+            step = cb // 2
+
+            def gen(b=buf, step=step):
+                for i in range(0, b.size, step):
+                    yield native.f32_to_bf16(b[i : i + step]).tobytes()
+
+            return StreamPayload(buf.size * 2, gen)
+        return self._to_wire(buf)
+
+    async def _encode_wire_stream(self, buf: np.ndarray):
+        """``_encode_wire`` that prefers the lazy stream: cheap closure
+        creation for f32/bf16 (the encode itself happens chunk-by-chunk off
+        the loop during the write), full off-loop encode otherwise."""
+        if self.wire in ("f32", "bf16"):
+            return self._wire_stream(buf)
+        return await self._encode_wire(buf)
+
+    def _result_sink(self):
+        """(sink, state) for decode-on-arrival of a round-result fetch on
+        the f32/bf16 wires: each verified chunk lands straight in the final
+        f32 buffer (f32: a byte copy; bf16: the native widening) while later
+        chunks are still in flight — fetch-side decode starts on the FIRST
+        chunk, and the full payload is never held as a separate byte
+        buffer. Returns (None, None) when the wire or schema doesn't allow
+        it; the caller then falls back to the plain payload decode."""
+        if self.wire not in ("f32", "bf16") or self._specs is None:
+            return None, None
+        n = sum(s.size for s in self._specs)
+        esz = 4 if self.wire == "f32" else 2
+        expect = n * esz
+        state: dict = {"filled": 0, "out": None, "expect": expect}
+        wire = self.wire
+
+        def sink(off: int, total: int, data: bytes) -> None:
+            # Raising rejects the payload at the transport (the call fails
+            # with an RPCError; the connection survives) — the same fate a
+            # wrong-size result meets in the buffered decode path.
+            if total != expect:
+                raise ValueError(f"result payload {total}B != schema {expect}B")
+            if off % esz or len(data) % esz:
+                raise ValueError("result chunk not element-aligned")
+            out = state["out"]
+            if out is None:
+                out = state["out"] = np.empty(n, np.float32)
+            if wire == "f32":
+                out.view(np.uint8)[off : off + len(data)] = np.frombuffer(
+                    data, np.uint8
+                )
+            else:
+                out[off // 2 : (off + len(data)) // 2] = native.bf16_to_f32(
+                    np.frombuffer(data, np.uint16)
+                )
+            state["filled"] += len(data)
+
+        def reset() -> None:
+            # The transport's transparent retry re-delivers the response
+            # from offset 0: forget anything the dead stream handed us.
+            state["filled"] = 0
+
+        sink.reset = reset
+        return sink, state
+
     # -- public API --------------------------------------------------------
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
@@ -809,6 +905,10 @@ class AveragerBase:
             "rounds_ok": self.rounds_ok,
             "rounds_skipped": self.rounds_skipped,
             "rounds_degraded": self.rounds_degraded,
+            # Per-peer transport counters (bytes in/out, RPC count, connect
+            # count, latency EWMA): the WAN-tier evidence operators and
+            # experiments read off the volunteer summary.
+            "transport": self.transport.stats(),
         }
         if self.resilience is not None:
             out["resilience"] = self.resilience.stats()
@@ -1126,16 +1226,25 @@ class SyncAverager(AveragerBase):
         # The push must land BEFORE the group deadline or the leader commits
         # without it — spending more than the remaining budget on it would
         # only produce a late arrival the policy then counts against us.
+        # record_latency=False on the payload legs: bulk-transfer (and, for
+        # the fetch, deliberately-parked) durations must not poison the
+        # control-plane latency EWMA the failure detector suspects on.
         await self.transport.call(
             leader_addr, "sync.contribute", args, wire_bytes,
             timeout=self._deadline_wait(group, floor=1.0),
+            record_latency=False,
         )
+        # Decode-on-arrival (f32/bf16): verified result chunks land straight
+        # in the final f32 buffer while later chunks are still in flight.
+        sink, sink_state = self._result_sink()
         ret, payload = await self.transport.call(
             leader_addr, "sync.fetch", {"epoch": group.epoch},
             # Outwait the leader's own commit point (the deadline) plus its
             # off-loop aggregation headroom plus transfer margin.
             timeout=self._deadline_wait(group, floor=1.0)
             + self.AGGREGATION_HEADROOM + 6.0,
+            chunk_sink=sink,
+            record_latency=False,
         )
         # Older leaders don't report the included set; treat absence as
         # included (the pre-existing behavior) rather than stalling EF.
@@ -1151,6 +1260,15 @@ class SyncAverager(AveragerBase):
                 "contribution (push arrived late or was dropped)"
             )
         self.rounds_ok += 1
+        if (
+            sink_state is not None
+            and sink_state["out"] is not None
+            and sink_state["filled"] == sink_state["expect"]
+        ):
+            # The streamed sink already decoded the result: unpack only.
+            buf = sink_state["out"]
+            return await asyncio.to_thread(lambda: self._unpack(buf))
+        # Inline (small) response, or a wire the sink doesn't cover.
         return await asyncio.to_thread(
             lambda: self._unpack(self._buf_from_payload(payload))
         )
@@ -1194,39 +1312,56 @@ class GossipAverager(AveragerBase):
         buf = self._pack(tree)
         self._current = (weight, self._wire_roundtrip(buf))
 
-    def _xid_fresh(self, xid: Any) -> bool:
+    def _xid_seen(self, xid: str) -> bool:
+        return xid in self._seen_xids
+
+    def _xid_record(self, xid: str) -> None:
         now = time.monotonic()
         if len(self._seen_xids) >= self._XID_CAP:
             cutoff = now - self._XID_TTL_S
             self._seen_xids = {k: t for k, t in self._seen_xids.items() if t >= cutoff}
             while len(self._seen_xids) >= self._XID_CAP:  # still full: drop oldest
                 self._seen_xids.pop(min(self._seen_xids, key=self._seen_xids.get))
-        if not isinstance(xid, str) or not xid or xid in self._seen_xids:
-            return False
         self._seen_xids[xid] = now
-        return True
 
     async def _rpc_exchange(self, args: dict, payload: bytes):
         if not self._check_schema(args):
             raise RPCError("schema mismatch")
-        if not self._xid_fresh(args.get("xid")):
-            raise RPCError("duplicate or missing exchange id (replay?)")
+        xid = args.get("xid")
+        if not isinstance(xid, str) or not xid:
+            raise RPCError("missing exchange id")
         if self._current is None:
             raise RPCError("peer has no params published yet")
         my_w, my_buf = self._current
+        if self._xid_seen(xid):
+            # A seen xid is either the transport's transparent retry of an
+            # exchange whose response was lost (the caller's vector IS
+            # banked — failing here would skew the mix it already entered),
+            # or a replayed frame. Both get the idempotent answer: serve
+            # our half WITHOUT banking, so the same vector can never enter
+            # the inbox twice no matter how often the frame is repeated.
+            return {"weight": my_w}, await self._encode_wire_stream(my_buf)
         inbuf = await self._decode_payload(payload)
         if inbuf.size != my_buf.size:
+            # Invalid exchanges never record their xid: a corrected retry
+            # under the same xid gets a fresh verdict, not a silent serve.
             raise RPCError(f"buffer size {inbuf.size} != local {my_buf.size}")
-        if len(self._inbox) < self.MAX_PARKED_CONTRIBS:
-            self._inbox.append((float(args["weight"]), inbuf))
-        else:
-            # Inbox full (peer long between averaging points — e.g. still
-            # compiling after publish()): serve OUR half of the exchange but
-            # drop theirs, bounding banked param-sized buffers. Push-pull
-            # degrades to pull-only instead of growing without bound.
-            log.debug("gossip inbox full (%d); dropping incoming contribution",
-                      len(self._inbox))
-        return {"weight": my_w}, await self._encode_wire(my_buf)
+        if not self._xid_seen(xid):  # re-check: a twin ran during the decode
+            self._xid_record(xid)
+            if len(self._inbox) < self.MAX_PARKED_CONTRIBS:
+                self._inbox.append((float(args["weight"]), inbuf))
+            else:
+                # Inbox full (peer long between averaging points — e.g.
+                # still compiling after publish()): serve OUR half of the
+                # exchange but drop theirs, bounding banked param-sized
+                # buffers. Push-pull degrades to pull-only instead of
+                # growing without bound.
+                log.debug("gossip inbox full (%d); dropping incoming contribution",
+                          len(self._inbox))
+        # Lazy stream on the dense wires: the reply's chunks are encoded
+        # while the transport writes earlier ones, instead of a full encode
+        # before the first response byte moves.
+        return {"weight": my_w}, await self._encode_wire_stream(my_buf)
 
     def _mix(self, w1, b1, w2, b2) -> Tuple[float, np.ndarray]:
         total = w1 + w2
@@ -1285,11 +1420,12 @@ class GossipAverager(AveragerBase):
                     "gossip.exchange",
                     {"peer": self.peer_id, "weight": w, "schema": self._schema,
                      "xid": uuid.uuid4().hex},
-                    await self._encode_wire(buf),
+                    await self._encode_wire_stream(buf),
                     # The round budget (policy-learned when attached) bounds
                     # the exchange: a stalled partner costs seconds, and the
                     # inbox fold above already banked everyone else's pushes.
                     timeout=min(self._round_budget(), self.effective_gather_timeout),
+                    record_latency=False,  # bulk payload both ways
                 )
                 self._observe_round_time(time.monotonic() - t0)
                 rbuf = await self._decode_payload(payload)
@@ -1378,7 +1514,7 @@ class ButterflyAverager(AveragerBase):
             raise RPCError(f"buffer size {inbuf.size} != local {st['buf'].size}")
         st["in"] = (float(args["weight"]), inbuf)
         st["done"].set()
-        return {"weight": st["w"]}, await self._encode_wire(st["buf"])
+        return {"weight": st["w"]}, await self._encode_wire_stream(st["buf"])
 
     @staticmethod
     def _mix(w1: float, b1: np.ndarray, w2: float, b2: np.ndarray) -> Tuple[float, np.ndarray]:
@@ -1445,8 +1581,11 @@ class ButterflyAverager(AveragerBase):
                             "weight": w,
                             "schema": self._schema,
                         },
-                        await self._encode_wire(buf),
+                        await self._encode_wire_stream(buf),
                         timeout=stage_wait,
+                        # Bulk payload, and the partner may legitimately
+                        # park until it reaches this stage.
+                        record_latency=False,
                     )
                     pw, pbuf = float(ret["weight"]), await self._decode_payload(payload)
                 else:
@@ -1575,6 +1714,7 @@ class ByzantineAverager(AveragerBase):
                 await self.transport.call(
                     addr, "byz.contribute", args, wire_bytes,
                     timeout=self._deadline_wait(group, floor=1.0),
+                    record_latency=False,  # bulk payload leg
                 )
             except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                 log.info("byz push to %s failed: %s", addr, errstr(e))

@@ -32,18 +32,11 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import dataclasses
-import json
 import random
 import time
 from typing import Iterable, Optional, Sequence, Tuple
 
-from distributedvolunteercomputing_tpu.swarm.transport import (
-    _HEADER,
-    MAGIC,
-    VERSION,
-    Addr,
-    Transport,
-)
+from distributedvolunteercomputing_tpu.swarm.transport import Addr, Transport
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -157,9 +150,10 @@ class FaultSchedule:
 
 
 # Scheduled corruption travels from the per-CALL decision to the per-FRAME
-# write through the task context (each call's frame write runs in its own
-# wait_for task, which snapshots this at creation) — concurrent calls on
-# one transport cannot steal each other's corruption.
+# write through the task context (each call's message write runs inside the
+# call's own wait_for task, which snapshots this at creation) — concurrent
+# calls multiplexed onto ONE pooled connection cannot steal each other's
+# corruption.
 _corrupt_this_call: contextvars.ContextVar[bool] = contextvars.ContextVar(
     "chaos_corrupt_this_call", default=False
 )
@@ -183,37 +177,32 @@ class ChaosTransport(Transport):
         self.schedule = schedule
         self._chaos = random.Random(seed)
 
-    # Overrides the base class method — called as self._write_frame at
-    # every send site, so instance dispatch picks this up for both the
-    # client and server halves of this node.
-    async def _write_frame(self, writer, ftype: int, meta: dict, payload: bytes) -> None:  # type: ignore[override]
-        corrupt_now = _corrupt_this_call.get()
-        if payload and (
-            corrupt_now
-            or (self.corrupt_rate and self._chaos.random() < self.corrupt_rate)
+    # Overrides the base transport's fault-injection hook — consulted once
+    # per outbound MESSAGE (client request or server response) with the
+    # total payload size. Returning an offset makes the transport flip that
+    # payload byte AFTER computing the frame/chunk checksums, so the
+    # corruption is wire-level and must be caught by the receiver's CRC;
+    # on the chunked path the flip lands inside exactly one chunk, whose
+    # per-chunk CRC is what fails.
+    def _chaos_corrupt_offset(self, ftype: int, total: int):  # type: ignore[override]
+        if total <= 0:
+            return None
+        if _corrupt_this_call.get() or (
+            self.corrupt_rate and self._chaos.random() < self.corrupt_rate
         ):
-            import zlib
-
-            meta_b = json.dumps(meta).encode()
-            crc = zlib.crc32(payload) & 0xFFFFFFFF  # checksum of the TRUE payload
-            bad = bytearray(payload)
-            pos = self._chaos.randrange(len(bad))
-            bad[pos] ^= 0xFF
+            pos = self._chaos.randrange(total)
             log.debug("chaos: corrupting payload byte %d", pos)
-            writer.write(_HEADER.pack(MAGIC, VERSION, ftype, len(meta_b), len(bad), crc))
-            writer.write(meta_b)
-            writer.write(bytes(bad))
-            await writer.drain()
-            return
-        await Transport._write_frame(self, writer, ftype, meta, payload)
+            return pos
+        return None
 
     async def call(
         self,
         addr: Addr,
         method: str,
         args: Optional[dict] = None,
-        payload: bytes = b"",
+        payload=b"",
         timeout: float = 30.0,
+        **kw,
     ):
         if self.drop_rate and self._chaos.random() < self.drop_rate:
             raise OSError(f"chaos: dropped call {method} to {addr}")
@@ -232,16 +221,19 @@ class ChaosTransport(Transport):
                 await asyncio.sleep(delay)
             if self.schedule.coin(corrupt):
                 # Task-local, not a transport-level flag: Transport.call runs
-                # the actual frame write inside its own wait_for task, which
-                # COPIES this context at creation — so under concurrent
-                # pushes (asyncio.gather) the corruption lands on exactly
-                # the scheduled call's request frame, never on whichever
-                # unrelated frame (or server-half response) writes next.
+                # the request write inside the call's own wait_for task,
+                # which COPIES this context at creation — so under
+                # concurrent pushes (asyncio.gather) sharing one pooled
+                # connection the corruption lands on exactly the scheduled
+                # call's request frame, never on whichever unrelated frame
+                # (or server-half response) writes next.
                 tok = _corrupt_this_call.set(True)
                 try:
                     return await super().call(
-                        addr, method, args=args, payload=payload, timeout=timeout
+                        addr, method, args=args, payload=payload, timeout=timeout, **kw
                     )
                 finally:
                     _corrupt_this_call.reset(tok)
-        return await super().call(addr, method, args=args, payload=payload, timeout=timeout)
+        return await super().call(
+            addr, method, args=args, payload=payload, timeout=timeout, **kw
+        )

@@ -114,8 +114,14 @@ class ClockSync:
             for _ in range(self.samples_per_peer):
                 t0 = self.now()
                 try:
+                    # Pooled transport: only the FIRST probe to a peer pays
+                    # the TCP dial; the min-RTT ladder then samples pure
+                    # request/response time, so the offset estimate's
+                    # RTT/2 error bound tightens to the real network RTT
+                    # instead of handshake + slow-start noise.
                     ret, _ = await self.transport.call(
-                        addr, METHOD, {}, b"", timeout=self.probe_timeout
+                        addr, METHOD, {}, b"", timeout=self.probe_timeout,
+                        connect_timeout=min(2.0, self.probe_timeout),
                     )
                 except Exception as e:  # noqa: BLE001
                     log.debug("clock probe to %s failed: %s", pid, errstr(e))

@@ -79,6 +79,10 @@ class Coordinator:
             "n_alive": len(alive),
             "swarm_samples_per_sec": agg_sps,
             "uptime_s": time.time() - self._t0,
+            # Transport-level counters (per-peer bytes/RPCs/connects/latency
+            # EWMA): the coordinator's own WAN vantage, one `coord.status`
+            # away for operators.
+            "transport": self.transport.stats(),
         }, b""
 
 

@@ -190,8 +190,11 @@ class DHTNode:
         async def probe():
             try:
                 try:
+                    # Split budget: a dead LRS node usually fails at the dial
+                    # (2s), leaving the RPC budget for peers that do accept.
                     await self.transport.call(
-                        lrs_addr, "dht.ping", {"sender": self._self_info()}, timeout=3.0
+                        lrs_addr, "dht.ping", {"sender": self._self_info()},
+                        timeout=3.0, connect_timeout=2.0,
                     )
                     self.table.add(lrs_nid, lrs_addr)  # alive: refresh to MRU
                 except (RPCError, OSError, asyncio.TimeoutError):

@@ -34,7 +34,7 @@ from __future__ import annotations
 import math
 import time
 from collections import deque
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
 
@@ -58,9 +58,24 @@ class PhiAccrualDetector:
                       goes silent (rather than being unsuspectable until
                       its distribution is learned).
     ``clock``       — monotonic-time source (injectable for tests).
+
+    Secondary signal: the transport's per-peer RPC latency EWMA
+    (``observe_latency``, fed by SwarmMembership from the pooled
+    transport's counters). Heartbeats ride the DHT with multi-second
+    cadence, so a peer whose RPC latency explodes — congested link, paging
+    host, half-partitioned pipe — can look heartbeat-healthy for several
+    beats while already being a round-killing straggler. A peer whose
+    current latency EWMA exceeds ``lat_factor`` x its own slow-moving
+    baseline AND the absolute ``lat_floor_s`` is suspected even at phi 0.
+    Both gates are deliberately conservative: localhost/CI jitter is
+    routinely 5-10x on a ms-scale baseline, which the absolute floor
+    ignores.
     """
 
     MIN_SAMPLES = 3  # below this, fall back to the bootstrap gap model
+    # Latency-EWMA suspicion gates (see class docstring).
+    LAT_FACTOR = 8.0
+    LAT_FLOOR_S = 1.0
 
     def __init__(
         self,
@@ -82,6 +97,8 @@ class PhiAccrualDetector:
         self.clock = clock
         self._last: Dict[str, float] = {}
         self._gaps: Dict[str, deque] = {}
+        # peer -> (current latency EWMA, slow baseline) — see observe_latency.
+        self._lat: Dict[str, Tuple[float, float]] = {}
 
     # -- feeding -----------------------------------------------------------
 
@@ -97,12 +114,38 @@ class PhiAccrualDetector:
             return
         self._gaps.setdefault(peer, deque(maxlen=self.window)).append(gap)
 
+    def observe_latency(self, peer: str, latency_s: float) -> None:
+        """Record the transport's current RPC latency EWMA for ``peer``.
+
+        The fast value is stored as-is (the transport already smooths it);
+        this detector maintains the SLOW baseline (alpha 0.02, ~50-sample
+        memory) the suspicion ratio compares against, so a gradual genuine
+        latency regime change re-baselines instead of suspecting forever."""
+        if not (isinstance(latency_s, (int, float)) and latency_s >= 0):
+            return
+        prev = self._lat.get(peer)
+        if prev is None:
+            self._lat[peer] = (float(latency_s), float(latency_s))
+        else:
+            _, slow = prev
+            self._lat[peer] = (float(latency_s), slow + 0.02 * (latency_s - slow))
+
+    def latency_suspect(self, peer: str) -> bool:
+        """Is the peer's current RPC latency far outside its own baseline?
+        (The secondary suspicion signal; see class docstring.)"""
+        entry = self._lat.get(peer)
+        if entry is None:
+            return False
+        fast, slow = entry
+        return fast > max(self.LAT_FACTOR * slow, self.LAT_FLOOR_S)
+
     def forget(self, peer: str) -> None:
         """Drop a peer's history (graceful leave / tombstone): a rejoiner
         starts with a clean distribution instead of inheriting the silence
         of its own absence as one giant inter-arrival sample."""
         self._last.pop(peer, None)
         self._gaps.pop(peer, None)
+        self._lat.pop(peer, None)
 
     # -- scoring -----------------------------------------------------------
 
@@ -135,7 +178,7 @@ class PhiAccrualDetector:
         return -math.log10(p_later)
 
     def suspect(self, peer: str, now: Optional[float] = None) -> bool:
-        return self.phi(peer, now) >= self.threshold
+        return self.phi(peer, now) >= self.threshold or self.latency_suspect(peer)
 
     def suspected(self, now: Optional[float] = None) -> Dict[str, float]:
         """{peer: phi} for every peer at/above the threshold right now."""
@@ -154,9 +197,12 @@ class PhiAccrualDetector:
         for peer in list(self._last):
             gaps = self._gaps.get(peer) or ()
             mean = sum(gaps) / len(gaps) if gaps else None
+            lat = self._lat.get(peer)
             out[peer] = {
                 "phi": round(self.phi(peer, now), 3),
                 "n_samples": len(gaps),
                 "mean_gap_s": round(mean, 4) if mean is not None else None,
+                "lat_ewma_ms": round(lat[0] * 1e3, 3) if lat else None,
+                "lat_suspect": self.latency_suspect(peer),
             }
         return out

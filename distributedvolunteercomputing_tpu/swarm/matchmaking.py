@@ -262,8 +262,14 @@ class Matchmaker:
             if pid == self.peer_id:
                 continue
             try:
+                # The begin fan-out spends round budget per member: bound
+                # the dial separately (an unreachable member should cost its
+                # connect timeout, not the full per-call budget). Members
+                # already dialed this round (their join traffic shares the
+                # pooled connection) skip the dial entirely.
                 await self.transport.call(
-                    addr, "avg.begin", {**begin, "token": tokens[pid]}, timeout=5.0
+                    addr, "avg.begin", {**begin, "token": tokens[pid]},
+                    timeout=5.0, connect_timeout=3.0,
                 )
                 reached.append(pid)
             except Exception as e:

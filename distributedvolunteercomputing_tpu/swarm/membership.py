@@ -106,6 +106,7 @@ class SwarmMembership:
         fd = self.failure_detector
         if fd is None:
             return
+        transport = self.dht.transport
         for pid, rec in records.items():
             if pid == self.peer_id:
                 continue
@@ -113,6 +114,16 @@ class SwarmMembership:
             if isinstance(t, (int, float)) and self._seen_beats.get(pid) != t:
                 self._seen_beats[pid] = t
                 fd.heartbeat(pid)
+            # Secondary signal: the pooled transport's per-peer RPC latency
+            # EWMA, mapped from the record's advertised address to the peer
+            # id here (the one place both are known). Heartbeats ride the
+            # DHT at a multi-second cadence; the RPC latency notices a
+            # congested/paging peer rounds earlier.
+            addr = rec.get("addr")
+            if isinstance(addr, (list, tuple)) and len(addr) == 2:
+                lat = transport.peer_latency(addr)
+                if lat is not None:
+                    fd.observe_latency(pid, lat)
 
     async def alive_peers(
         self, include_self: bool = True, exclude_suspected: bool = False

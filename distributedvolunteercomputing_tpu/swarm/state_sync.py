@@ -257,12 +257,20 @@ class StateSyncService:
         session = ""
         offset = 0
         while True:
+            # Every session chunk rides ONE pooled connection (the transport
+            # chunk-frames each 64 MB RPC payload into wire chunks with
+            # per-chunk CRCs); the dial is bounded separately so a dead
+            # provider costs seconds, not the 60 s transfer budget.
             ret, chunk = await self.transport.call(
                 addr,
                 "state.fetch",
                 {"peer": self.peer_id, "session": session, "offset": offset,
                  "length": self.chunk_bytes},
                 timeout=self.fetch_timeout,
+                connect_timeout=5.0,
+                # Bulk transfer: must not poison the control-plane latency
+                # EWMA the failure detector suspects on.
+                record_latency=False,
             )
             total = int(ret["total"])
             if out is None:  # first response: wire + size validation

@@ -758,8 +758,13 @@ class Volunteer:
                 self.summary.update(self.averager.stats())
             # WAN accounting: every byte this volunteer moved over DCN
             # (averaging payloads dominate; DHT/heartbeat traffic is noise).
+            # rpcs/connects expose the pooling win directly: pre-pool these
+            # were equal (one dial per RPC); pooled, connects stays at
+            # ~one-per-peer while rpcs keeps counting.
             self.summary["wan_bytes_sent"] = self.transport.bytes_sent
             self.summary["wan_bytes_received"] = self.transport.bytes_received
+            self.summary["wan_rpcs"] = self.transport.rpcs_sent
+            self.summary["wan_connects"] = self.transport.connects
             return self.summary
         finally:
             self._stop.set()

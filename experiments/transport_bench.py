@@ -1,0 +1,175 @@
+"""Transport bench: pooled multiplexed connections vs per-call connects.
+
+The committed artifact behind the ISSUE-3 transport rewrite
+(``experiments/results/transport_bench.json``): measures RPC round-trip
+throughput at small payloads (where the per-call TCP dial used to dominate
+— every heartbeat, DHT ping, clock probe, and matchmaking begin paid one)
+and large-payload goodput (which must NOT regress under chunked framing),
+for the pooled transport against the v1 per-call-connect behavior
+(``Transport(pooled=False)``).
+
+Scenarios, each run in both modes over real localhost TCP:
+- ``seq_small``:  N sequential small-payload RPCs (the latency-bound shape
+                  of heartbeats/DHT traffic) -> RPCs/sec;
+- ``conc_small``: batches of K concurrent small RPCs (the fan-out shape of
+                  byzantine pushes and begin fan-outs) -> RPCs/sec;
+- ``large``:      M transfers of a multi-MB payload (an averaging
+                  contribution) -> MB/s goodput.
+
+Usage:
+    python experiments/transport_bench.py            # full run + artifact
+    python experiments/transport_bench.py --quick    # small sanity run
+
+The default tier-1 suite runs a fast smoke of the same harness
+(tests/test_transport_pool.py::TestTransportBenchSmoke), so an RPC
+throughput regression fails loudly without this script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedvolunteercomputing_tpu.swarm.transport import Transport  # noqa: E402
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+
+async def _bench_mode(
+    pooled: bool,
+    *,
+    seq_calls: int,
+    payload_bytes: int,
+    concurrency: int,
+    conc_batches: int,
+    large_mb: int,
+    large_transfers: int,
+) -> dict:
+    server = Transport()
+
+    async def echo(args, payload):
+        return {"ok": True}, b""  # ack-only: the bench measures transport, not memcpy
+
+    async def sink(args, payload):
+        return {"n": len(payload)}, b""
+
+    server.register("echo", echo)
+    server.register("sink", sink)
+    addr = await server.start()
+    client = Transport(pooled=pooled)
+    out: dict = {
+        "pooled": pooled,
+        "seq_calls": seq_calls,
+        "payload_bytes": payload_bytes,
+        "concurrency": concurrency,
+        "conc_batches": conc_batches,
+        "large_mb": large_mb,
+        "large_transfers": large_transfers,
+    }
+    try:
+        payload = os.urandom(payload_bytes)
+        # Warmup (compile/caches/first dial out of the measured window).
+        for _ in range(5):
+            await client.call(addr, "echo", {}, payload)
+
+        t0 = time.perf_counter()
+        for _ in range(seq_calls):
+            await client.call(addr, "echo", {}, payload)
+        dt = time.perf_counter() - t0
+        out["seq_small_rps"] = round(seq_calls / dt, 1)
+        out["seq_small_mean_ms"] = round(1e3 * dt / seq_calls, 4)
+
+        t0 = time.perf_counter()
+        for _ in range(conc_batches):
+            await asyncio.gather(
+                *(client.call(addr, "echo", {}, payload) for _ in range(concurrency))
+            )
+        dt = time.perf_counter() - t0
+        out["conc_small_rps"] = round(conc_batches * concurrency / dt, 1)
+
+        big = os.urandom(large_mb << 20)
+        # One unmeasured transfer to settle buffers.
+        await client.call(addr, "sink", {}, big, timeout=120)
+        t0 = time.perf_counter()
+        for _ in range(large_transfers):
+            ret, _ = await client.call(addr, "sink", {}, big, timeout=120)
+            assert ret["n"] == len(big)
+        dt = time.perf_counter() - t0
+        out["large_goodput_mb_s"] = round(large_transfers * large_mb / dt, 1)
+        out["connects"] = client.connects
+        out["rpcs"] = client.rpcs_sent
+        out["bytes_sent"] = client.bytes_sent
+    finally:
+        await client.close()
+        await server.close()
+    return out
+
+
+async def run_bench(
+    seq_calls: int = 2000,
+    payload_bytes: int = 256,
+    concurrency: int = 16,
+    conc_batches: int = 50,
+    large_mb: int = 8,
+    large_transfers: int = 6,
+) -> dict:
+    kw = dict(
+        seq_calls=seq_calls,
+        payload_bytes=payload_bytes,
+        concurrency=concurrency,
+        conc_batches=conc_batches,
+        large_mb=large_mb,
+        large_transfers=large_transfers,
+    )
+    per_call = await _bench_mode(False, **kw)
+    pooled = await _bench_mode(True, **kw)
+    ratios = {
+        "seq_small_rps": round(pooled["seq_small_rps"] / per_call["seq_small_rps"], 2),
+        "conc_small_rps": round(pooled["conc_small_rps"] / per_call["conc_small_rps"], 2),
+        "large_goodput_mb_s": round(
+            pooled["large_goodput_mb_s"] / per_call["large_goodput_mb_s"], 2
+        ),
+    }
+    return {
+        "bench": "transport_pooled_vs_per_call",
+        "host": platform.node(),
+        "python": platform.python_version(),
+        "unix_time": round(time.time(), 1),
+        "per_call": per_call,
+        "pooled": pooled,
+        "ratios": ratios,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true", help="small sanity run")
+    ap.add_argument("--out", default=os.path.join(RESULTS, "transport_bench.json"))
+    ap.add_argument("--seq-calls", type=int, default=None)
+    ap.add_argument("--large-mb", type=int, default=None)
+    args = ap.parse_args()
+    kw = {}
+    if args.quick:
+        kw = dict(seq_calls=300, conc_batches=10, large_mb=2, large_transfers=2)
+    if args.seq_calls is not None:
+        kw["seq_calls"] = args.seq_calls
+    if args.large_mb is not None:
+        kw["large_mb"] = args.large_mb
+    result = asyncio.run(run_bench(**kw))
+    os.makedirs(RESULTS, exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(result["ratios"], indent=2))
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

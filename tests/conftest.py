@@ -32,6 +32,13 @@ def pytest_configure(config):
         "schedules, deadline-bounded degradation) — in the default lane, and "
         "selectable on their own with -m chaos",
     )
+    config.addinivalue_line(
+        "markers",
+        "transport: wire/pool/framing tests (connection pooling, rid demux, "
+        "chunked payload streaming, per-peer counters, RPC-throughput "
+        "smoke) — in the default lane, and selectable on their own with "
+        "-m transport",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

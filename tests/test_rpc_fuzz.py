@@ -123,6 +123,168 @@ class TestAveragerFuzz:
                     assert np.isfinite(np.asarray(r["w"])).all()
 
 
+@pytest.mark.transport
+class TestChunkedFrameFuzz:
+    """Chunk-framing fuzz (ISSUE 3 satellite): truncated mid-stream,
+    corrupted chunk CRC, duplicated/reordered chunk indices, and framing
+    that overruns the declared total. The server must reject each without
+    wedging the event loop — and for the attributable shapes (CRC, index)
+    WITHOUT dropping the connection, since the explicit per-chunk lengths
+    keep the stream in sync."""
+
+    @staticmethod
+    def _chunked_frames(rid, method, payload, chunk, mutate=None):
+        """Raw wire bytes for one chunked request; ``mutate(i, idx, data,
+        crc) -> (idx, data, crc)`` lets a case corrupt exactly one chunk."""
+        import json as _json
+        import zlib as _zlib
+
+        from distributedvolunteercomputing_tpu.swarm.transport import (
+            _CHUNK, _HEADER, MAGIC, TYPE_REQ, VERSION,
+        )
+
+        pieces = [payload[i : i + chunk] for i in range(0, len(payload), chunk)]
+        meta = {"rid": rid, "method": method, "args": {}, "chunks": len(pieces)}
+        meta_b = _json.dumps(meta).encode()
+        out = [
+            _HEADER.pack(MAGIC, VERSION, TYPE_REQ, len(meta_b), len(payload), 0),
+            meta_b,
+        ]
+        for i, data in enumerate(pieces):
+            idx, crc = i, _zlib.crc32(data) & 0xFFFFFFFF
+            if mutate is not None:
+                idx, data, crc = mutate(i, idx, data, crc)
+            out.append(_CHUNK.pack(idx, len(data), crc))
+            out.append(bytes(data))
+        return b"".join(out)
+
+    def test_bad_chunks_rejected_without_wedging(self):
+        from distributedvolunteercomputing_tpu.swarm.transport import (
+            TYPE_ERR, TYPE_RESP,
+        )
+
+        payload = bytes(range(256)) * 64  # 16 KB over 4 KB chunks
+        CH = 4096
+
+        def corrupt_crc(i, idx, data, crc):
+            if i == 2:
+                bad = bytearray(data)
+                bad[0] ^= 0xFF
+                return idx, bytes(bad), crc  # crc of the TRUE bytes: mismatch
+            return idx, data, crc
+
+        def duplicate_index(i, idx, data, crc):
+            return (1 if i == 2 else idx), data, crc
+
+        def reorder_index(i, idx, data, crc):
+            remap = {1: 2, 2: 1}
+            return remap.get(i, idx), data, crc
+
+        cases = [
+            ("crc", corrupt_crc, "CRC"),
+            ("dup", duplicate_index, "duplicated/reordered"),
+            ("reorder", reorder_index, "duplicated/reordered"),
+        ]
+
+        async def main():
+            server = Transport()
+
+            async def echo(args, payload):
+                return {"n": len(payload)}, b""
+
+            server.register("echo", echo)
+            addr = await server.start()
+            probe = Transport()  # parses response frames for us
+            try:
+                for name, mutate, expect in cases:
+                    reader, writer = await asyncio.open_connection(*addr)
+                    try:
+                        writer.write(self._chunked_frames(
+                            f"rid-{name}", "echo", payload, CH, mutate
+                        ))
+                        await writer.drain()
+                        ftype, meta, _ = await asyncio.wait_for(
+                            probe._read_frame(reader), timeout=5
+                        )
+                        assert ftype == TYPE_ERR, (name, meta)
+                        assert expect in meta.get("error", ""), (name, meta)
+                        assert meta.get("rid") == f"rid-{name}", (
+                            "rejection must be attributable", meta)
+                        # SAME connection still serves: a clean chunked
+                        # request right behind the rejected one succeeds.
+                        writer.write(self._chunked_frames(
+                            "rid-ok", "echo", payload, CH
+                        ))
+                        await writer.drain()
+                        ftype, meta, _ = await asyncio.wait_for(
+                            probe._read_frame(reader), timeout=5
+                        )
+                        assert ftype == TYPE_RESP and meta["ret"]["n"] == len(payload), (
+                            name, meta)
+                    finally:
+                        writer.close()
+            finally:
+                await server.close()
+
+        run(main())
+
+    def test_truncated_and_overrun_streams_drop_cleanly(self):
+        async def main():
+            server = Transport()
+
+            async def echo(args, payload):
+                return {"n": len(payload)}, b""
+
+            server.register("echo", echo)
+            addr = await server.start()
+            payload = b"z" * 16384
+            try:
+                # Truncated mid-stream: header promises 4 chunks, the sender
+                # dies after 1.5 — the server must drop the conn without
+                # wedging (IncompleteReadError containment).
+                frames = self._chunked_frames("rid-t", "echo", payload, 4096)
+                reader, writer = await asyncio.open_connection(*addr)
+                writer.write(frames[: len(frames) // 2])
+                await writer.drain()
+                writer.write_eof()
+                await asyncio.wait_for(reader.read(1 << 16), timeout=5)
+                writer.close()
+                # Overrun: a chunk whose length exceeds the declared total —
+                # the incremental size cap must kill the connection (the
+                # stream position past it is untrustworthy).
+                import json as _json
+                import zlib as _zlib
+
+                from distributedvolunteercomputing_tpu.swarm.transport import (
+                    _CHUNK, _HEADER, MAGIC, TYPE_REQ, VERSION,
+                )
+
+                meta_b = _json.dumps(
+                    {"rid": "rid-o", "method": "echo", "args": {}, "chunks": 2}
+                ).encode()
+                reader, writer = await asyncio.open_connection(*addr)
+                writer.write(
+                    _HEADER.pack(MAGIC, VERSION, TYPE_REQ, len(meta_b), 100, 0)
+                )
+                writer.write(meta_b)
+                big = b"x" * 4096  # 4096 > the declared 100-byte total
+                writer.write(_CHUNK.pack(0, len(big), _zlib.crc32(big) & 0xFFFFFFFF))
+                writer.write(big)
+                await writer.drain()
+                writer.write_eof()
+                await asyncio.wait_for(reader.read(1 << 16), timeout=5)
+                writer.close()
+                # After both volleys the node still answers legit RPCs.
+                client = Transport()
+                ret, _ = await client.call(addr, "echo", {}, payload)
+                assert ret["n"] == len(payload)
+                await client.close()
+            finally:
+                await server.close()
+
+        run(main())
+
+
 class TestClockSyncFuzz:
     def test_clock_probe_survives_junk_then_estimates(self):
         """clock.probe (swarm/clocksync.py) joins the fuzzed surface: junk

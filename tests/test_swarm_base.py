@@ -21,6 +21,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
+@pytest.mark.transport
 class TestTransport:
     def test_echo_roundtrip(self):
         async def main():
