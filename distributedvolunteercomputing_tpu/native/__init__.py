@@ -191,16 +191,30 @@ def f32_to_bf16(arr: np.ndarray) -> np.ndarray:
     return arr.astype(ml_dtypes.bfloat16).view(np.uint16)
 
 
-def bf16_to_f32(bits: np.ndarray) -> np.ndarray:
+def bf16_to_f32(bits: np.ndarray, out: Optional[np.ndarray] = None) -> np.ndarray:
+    """uint16 bf16 bit patterns -> float32. ``out``, when given, receives
+    the decode in place (must be a contiguous f32 buffer of matching size)
+    — the streaming aggregation tier decodes wire chunks straight into
+    pooled tile buffers instead of allocating per chunk."""
     bits = np.ascontiguousarray(bits, np.uint16)
+    if out is None:
+        out = np.empty(bits.size, np.float32)
+    elif (
+        out.dtype != np.float32 or out.size != bits.size
+        or not out.flags.c_contiguous
+    ):
+        raise ValueError(
+            f"bf16_to_f32 out= needs a contiguous float32[{bits.size}], got "
+            f"{out.dtype}[{out.size}]"
+        )
     lib = get_lib()
     if lib is not None:
-        out = np.empty(bits.size, np.float32)
         lib.dvc_bf16_to_f32(_ptr(bits, ctypes.c_uint16), _ptr(out, ctypes.c_float), bits.size)
         return out
     import ml_dtypes
 
-    return bits.view(ml_dtypes.bfloat16).astype(np.float32)
+    out[:] = bits.view(ml_dtypes.bfloat16).astype(np.float32)
+    return out
 
 
 def weighted_sum_inplace(acc: np.ndarray, x: np.ndarray, w: float) -> None:

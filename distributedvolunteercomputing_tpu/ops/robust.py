@@ -69,6 +69,14 @@ def trimmed_mean(stack: np.ndarray, trim: int = 1) -> np.ndarray:
     return srt[trim : n - trim].mean(axis=0)
 
 
+def pairwise_sq_dists(stack: np.ndarray) -> np.ndarray:
+    """[n, n] pairwise squared L2 distances between rows. d² is a plain sum
+    over coordinates, which is what lets the streaming leader accumulate it
+    tile-by-tile as contributions arrive (swarm/agg_stream.py) instead of
+    paying the O(n²·D) pass at commit time."""
+    return ((stack[:, None, :] - stack[None, :, :]) ** 2).sum(axis=-1)
+
+
 def _krum_scores(d2: np.ndarray, n_byzantine: int) -> np.ndarray:
     """Krum score per row of a pairwise squared-distance matrix: sum of the
     m - f - 2 smallest neighbour distances (clamped to >= 1 defensively —
@@ -81,14 +89,22 @@ def _krum_scores(d2: np.ndarray, n_byzantine: int) -> np.ndarray:
     return np.sort(d2, axis=1)[:, :n_neighbors].sum(axis=1)
 
 
-def krum(stack: np.ndarray, n_byzantine: int = 1, multi: int = 1) -> np.ndarray:
+def krum(
+    stack: np.ndarray,
+    n_byzantine: int = 1,
+    multi: int = 1,
+    d2: Optional[np.ndarray] = None,
+) -> np.ndarray:
     """(Multi-)Krum: average the ``multi`` contributions with the smallest
-    sum of squared distances to their n - f - 2 nearest neighbours."""
+    sum of squared distances to their n - f - 2 nearest neighbours.
+    ``d2`` may carry a precomputed pairwise squared-distance matrix (the
+    streaming leader accumulates it tile-wise during arrival)."""
     n = stack.shape[0]
     if n < n_byzantine + 3:
         # Not enough honest mass for Krum's guarantee; degrade to median.
         return coordinate_median(stack)
-    d2 = ((stack[:, None, :] - stack[None, :, :]) ** 2).sum(axis=-1)
+    if d2 is None or d2.shape != (n, n):
+        d2 = pairwise_sq_dists(stack)
     scores = _krum_scores(d2, n_byzantine)
     chosen = np.argsort(scores)[:multi]
     return stack[chosen].mean(axis=0)
@@ -113,7 +129,9 @@ def geometric_median(stack: np.ndarray, iters: int = 32, eps: float = 1e-8) -> n
     return z.astype(stack.dtype)
 
 
-def bulyan(stack: np.ndarray, n_byzantine: int = 1) -> np.ndarray:
+def bulyan(
+    stack: np.ndarray, n_byzantine: int = 1, d2: Optional[np.ndarray] = None
+) -> np.ndarray:
     """Bulyan (El Mhamdi, Guerraoui, Rouault 2018): Multi-Krum repeatedly
     SELECTS the n - 2f contributions closest to their neighbour sets, then a
     per-coordinate trimmed mean (trim f) over the selected set. Needs
@@ -124,6 +142,8 @@ def bulyan(stack: np.ndarray, n_byzantine: int = 1) -> np.ndarray:
     f = n_byzantine
     if n < 4 * f + 3:
         return geometric_median(stack)
+    if d2 is not None and d2.shape != (n, n):
+        d2 = None
     # Single-pass Multi-Krum selection: score once on the full set (with
     # n >= 4f + 3 the neighbour count is n - f - 2 >= 3f + 1, never
     # degenerate) and keep the n - 2f best. Iterative select-remove-rescore
@@ -131,7 +151,8 @@ def bulyan(stack: np.ndarray, n_byzantine: int = 1) -> np.ndarray:
     # (m shrinks to f + 2 where the neighbour count hits zero, and the
     # 1-NN clamp then ties symmetric pairs exactly, making the selected
     # SET depend on peer row order; observed before this was changed).
-    d2 = ((stack[:, None, :] - stack[None, :, :]) ** 2).sum(axis=-1)
+    if d2 is None:
+        d2 = pairwise_sq_dists(stack)
     selected = np.argsort(_krum_scores(d2, f))[: n - 2 * f]
     chosen = stack[selected]
     # Bulyan's second phase: per coordinate, keep the (n - 2f) - 2f values
@@ -203,3 +224,37 @@ def aggregate(stack: np.ndarray, method: str = "mean", **kw) -> np.ndarray:
     if stack.ndim != 2:
         raise ValueError(f"expected [n_peers, D] stack, got shape {stack.shape}")
     return AGGREGATORS[method](stack, **kw)
+
+
+# -- streaming / tiled aggregation support (swarm/agg_stream.py) ------------
+#
+# How each estimator decomposes over a column partition (tiles), which is
+# what decides the leader's streaming mode and its memory bound:
+#
+# - "mean":     linear — accumulate w·x per tile, O(D) total state.
+# - "window":   COORDINATE-WISE estimators (per-coordinate sort/median/trim
+#               touch no other coordinate), so aggregating each [n, tile]
+#               window independently is EXACTLY the dense result — only the
+#               in-flight window is held, O(n·tile).
+# - "d2_dense": selection needs full vectors, but the selection STATISTIC
+#               (pairwise d²) is a sum over coordinates and accumulates
+#               tile-by-tile; rows stay dense, the O(n²·D) distance pass
+#               overlaps arrival.
+# - "dense":    genuinely coupled across coordinates (Weiszfeld's per-row
+#               L2 norms, centered_clip's full-vector clip radii): tiling
+#               would change the estimator, so these keep the dense path.
+_TILE_MODES = {
+    "mean": "mean",
+    "median": "window",
+    "trimmed_mean": "window",
+    "krum": "d2_dense",
+    "bulyan": "d2_dense",
+    "geometric_median": "dense",
+    "centered_clip": "dense",
+}
+
+
+def tile_mode(method: str) -> str:
+    """Streaming decomposition class for ``method`` (see table above);
+    unknown methods conservatively report "dense"."""
+    return _TILE_MODES.get(method, "dense")

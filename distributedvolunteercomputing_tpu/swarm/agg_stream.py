@@ -1,0 +1,731 @@
+"""Streaming chunk-aligned leader aggregation: decode/aggregate overlapped
+with arrival, O(N·tile) in-flight memory for the elementwise estimators.
+
+PR 2 made multi-MB contributions CROSS the wire as bounded chunk frames,
+but the leader still materialized every peer's full dense f32 buffer before
+any aggregation started, and the robust path then paid a second O(N·D) copy
+via ``np.stack``. This module is the missing half of that pipeline: the
+transport hands each verified contribution chunk to a per-round
+``StreamingAggregator`` (via the request-sink plumbing in
+``swarm/transport.py``), which decodes it and folds it in immediately —
+aggregation overlaps arrival, and the deadline commit reduces to finishing
+whatever tiles are still open.
+
+Tiles are aligned 1:1 with the transport's wire chunks (``chunk_bytes``
+bytes of f32/bf16 == ``chunk_bytes // esz`` elements), so "one verified
+chunk" and "one tile row" are the same event — no re-buffering between the
+framing layer and the math.
+
+Aggregation modes, chosen by ``ops.robust.tile_mode(method)``:
+
+- ``mean``     — each arriving chunk is axpy-accumulated straight into one
+                 O(D) accumulator (``native.weighted_sum_inplace``) and its
+                 bytes released; a per-tile float64 tally records the weight
+                 that arrived for that tile, so the deadline commit is one
+                 per-tile re-normalization. The leader never holds a
+                 per-peer dense vector.
+- ``window``   — coordinate-wise estimators (trimmed_mean, median) hold only
+                 the in-flight ``[n_slots, tile]`` window per tile: a tile
+                 aggregates on a worker thread the moment every armed peer's
+                 copy of it has arrived (or at the deadline, over the
+                 arrived subset). Peak memory O(N·tile), not O(N·D).
+- ``d2_dense`` — krum/bulyan need full vectors for the selected rows, but
+                 their O(n²·D) pairwise-distance pass is a sum over
+                 coordinates: d² accumulates tile-by-tile as rows fill, so
+                 the commit-time selection starts from a finished distance
+                 matrix instead of recomputing it.
+- ``dense``    — estimators that genuinely couple all coordinates
+                 (geometric_median's Weiszfeld iterations, centered_clip's
+                 full-vector L2 clipping) keep dense rows; they still gain
+                 decode-on-arrival, just not the memory bound.
+
+Partial-contribution semantics (the price of eager commitment): a streamed
+contribution that ABORTS mid-payload (corrupt chunk, connection death) has
+already folded its sealed tiles into the aggregate — un-doing an axpy needs
+the data, which was deliberately released. The committed result is then a
+PER-TILE partial-participation aggregate: each tile is a valid weighted
+mean / robust estimate over exactly the peers whose copy of that tile
+arrived intact. That is the deadline-commit contract applied per tile
+rather than per round — every committed coordinate is still a convex
+combination (or robust estimate) of honest inputs, and the aborting peer is
+reported absent, so its shipped mass is never double-counted by error
+feedback (the streaming wires, f32/bf16, carry no EF residual). A slot that
+aborts before ANY tile committed is reset cleanly and may retry; one that
+aborts after committing tiles is tainted for the round and later pushes
+under its key are refused.
+
+Thread model: ``add_chunk``/``add_dense`` run on the event-loop thread (the
+transport's frame reader) or an averager worker thread, serialized by one
+lock; tile aggregation jobs run via ``asyncio.to_thread`` when a loop is
+running (inline otherwise — unit tests stay deterministic); ``finalize``
+awaits in-flight jobs, closes open windows over the arrived subsets, and
+returns the committed buffer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from distributedvolunteercomputing_tpu import native
+from distributedvolunteercomputing_tpu.ops import robust
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
+
+log = get_logger(__name__)
+
+
+class TilePool:
+    """Reusable float32 scratch buffers, keyed by element count.
+
+    Window buffers and decode staging churn one allocation per tile per
+    peer per round without this; the pool caps held bytes so an unusually
+    large round can't pin its high-water mark forever."""
+
+    def __init__(self, max_bytes: int = 64 << 20):
+        self._lock = threading.Lock()
+        self._free: Dict[int, List[np.ndarray]] = {}
+        self._held = 0
+        self.max_bytes = int(max_bytes)
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, n_elems: int) -> np.ndarray:
+        with self._lock:
+            lst = self._free.get(n_elems)
+            if lst:
+                buf = lst.pop()
+                self._held -= buf.nbytes
+                self.hits += 1
+                return buf
+            self.misses += 1
+        return np.empty(n_elems, np.float32)
+
+    def put(self, buf: Optional[np.ndarray]) -> None:
+        if buf is None or buf.dtype != np.float32:
+            return
+        with self._lock:
+            if self._held + buf.nbytes > self.max_bytes:
+                return
+            self._free.setdefault(buf.size, []).append(buf)
+            self._held += buf.nbytes
+
+    @property
+    def held_bytes(self) -> int:
+        return self._held
+
+
+# One process-wide pool: rounds come and go, the buffers stay warm.
+_POOL = TilePool()
+
+
+class _Window:
+    """One tile's in-flight [n_slots, tile_elems] row window."""
+
+    __slots__ = ("buf", "mask", "count")
+
+    def __init__(self, buf: np.ndarray, n_slots: int):
+        self.buf = buf  # flat pool buffer viewed as [n_slots, tile_elems]
+        self.mask = np.zeros(n_slots, bool)
+        self.count = 0
+
+
+class ContributionSink:
+    """Transport-facing request sink for ONE streamed contribution.
+
+    The transport calls ``sink(offset, total, data)`` per verified chunk and
+    ``sink.close(ok)`` exactly once when the frame completes or dies; both
+    are forwarded to the aggregator with this contribution's slot."""
+
+    __slots__ = ("_agg", "slot", "weight", "_on_done", "_closed")
+
+    def __init__(
+        self,
+        agg: "StreamingAggregator",
+        slot: int,
+        weight: float,
+        on_done: Optional[Callable[[bool], None]] = None,
+    ):
+        self._agg = agg
+        self.slot = slot
+        self.weight = float(weight)
+        self._on_done = on_done
+        self._closed = False
+
+    def __call__(self, off: int, total: int, data: bytes) -> None:
+        self._agg.add_chunk(self.slot, self.weight, off, data)
+
+    def close(self, ok: bool) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        done = self._agg.seal_slot(self.slot) if ok else False
+        if not ok:
+            self._agg.abort_slot(self.slot)
+        if self._on_done is not None:
+            try:
+                self._on_done(ok and done)
+            except Exception as e:  # noqa: BLE001 — a callback bug must not kill the frame reader
+                log.debug("contribution sink callback failed: %s", errstr(e))
+
+
+class StreamingAggregator:
+    """Leader-side streaming aggregation state for one round.
+
+    ``slots`` fixes the armed peer set (the round's members, leader
+    included); every contribution is addressed by its slot index. The
+    instance is safe for concurrent ``add_chunk``/``add_dense``/``abort``
+    from the loop thread and worker threads; ``finalize`` is async and must
+    be called exactly once, after which the committed buffer is returned
+    and all transient tiles are back in the pool."""
+
+    def __init__(
+        self,
+        n_elems: int,
+        slots: List[str],
+        method: str,
+        wire: str,
+        chunk_bytes: int,
+        kw_fn: Optional[Callable[[int], dict]] = None,
+        pool: Optional[TilePool] = None,
+    ):
+        if wire not in ("f32", "bf16"):
+            raise ValueError(f"streaming aggregation needs an elementwise wire, got {wire!r}")
+        esz = 4 if wire == "f32" else 2
+        if chunk_bytes % esz:
+            raise ValueError(f"chunk_bytes {chunk_bytes} not {wire} element-aligned")
+        self.n_elems = int(n_elems)
+        self.wire = wire
+        self.esz = esz
+        self.chunk_bytes = int(chunk_bytes)
+        self.tile_elems = self.chunk_bytes // esz
+        self.n_tiles = max(-(-self.n_elems // self.tile_elems), 1)
+        self.method = method
+        self.mode = robust.tile_mode(method)
+        self._kw_fn = kw_fn or (lambda n: {})
+        self.slots = list(slots)
+        self.slot_index = {p: i for i, p in enumerate(self.slots)}
+        self.pool = pool or _POOL
+        n = len(self.slots)
+
+        self._lock = threading.Lock()
+        self.frozen = False
+        self._weights: Dict[int, float] = {}  # slot -> folded weight
+        self._aborted: Set[int] = set()
+        self._tainted: Set[int] = set()
+        self._sealed: Set[int] = set()  # slots whose full vector landed
+        self._filled = np.zeros(n, np.int64)  # elements received per slot
+        self._committed_tiles = np.zeros(n, np.int64)  # tiles folded per slot
+        self._tasks: List[asyncio.Task] = []
+
+        # The committed/result buffer is O(D) and exists in every mode.
+        self._out = np.zeros(self.n_elems, np.float32)
+        self._tile_w: Optional[np.ndarray] = None
+        self._windows: Dict[int, _Window] = {}
+        self._win_done = np.zeros(self.n_tiles, bool)
+        # Window mode: complete dense contributions (the leader's own, a
+        # parked pre-arming buffer) are held as BORROWED references whose
+        # rows copy into a window lazily when a streamed chunk opens it —
+        # a dense feed must not materialize every window up front, or the
+        # peak regresses to O(N·D) the moment the leader feeds itself.
+        self._resident: Dict[int, np.ndarray] = {}
+        self._rows: Dict[int, np.ndarray] = {}
+        self._d2: Optional[np.ndarray] = None
+        self._tile_sealed: Dict[int, List[int]] = {}
+        if self.mode == "mean":
+            self._tile_w = np.zeros(self.n_tiles, np.float64)
+        elif self.mode == "d2_dense":
+            self._d2 = np.zeros((n, n), np.float64)
+
+        # -- gauges (surfaced via Averager.stats()/volunteer summary) ------
+        self.t0 = time.monotonic()
+        self.tiles_early = 0  # window tiles aggregated while arrivals were still in flight
+        self.tiles_deadline = 0  # window tiles closed over a subset at finalize
+        self.busy_s = 0.0  # seconds spent inside aggregation math
+        self.streamed_contribs = 0
+        self.dense_contribs = 0
+        self.aborted_contribs = 0
+        self._held = self._out.nbytes
+        self.peak_bytes_held = self._held
+
+    # -- memory accounting --------------------------------------------------
+
+    def _note_alloc(self, nbytes: int) -> None:
+        self._held += nbytes
+        if self._held > self.peak_bytes_held:
+            self.peak_bytes_held = self._held
+
+    def _note_free(self, nbytes: int) -> None:
+        self._held -= nbytes
+
+    # -- decode ---------------------------------------------------------------
+
+    def _decode(self, data: bytes, out: Optional[np.ndarray] = None) -> np.ndarray:
+        if self.wire == "f32":
+            x = np.frombuffer(data, np.float32)
+            if out is not None:
+                out[: x.size] = x
+                return out[: x.size]
+            return x
+        bits = np.frombuffer(data, np.uint16)
+        if out is not None:
+            return native.bf16_to_f32(bits, out=out[: bits.size])
+        return native.bf16_to_f32(bits)
+
+    # -- sink construction ----------------------------------------------------
+
+    def make_sink(
+        self, peer: str, weight: float, total: int,
+        on_done: Optional[Callable[[bool], None]] = None,
+    ) -> Optional[ContributionSink]:
+        """A transport request sink for ``peer``'s streamed contribution, or
+        None when this round can't stream it (wrong size, frozen round,
+        tainted slot, unknown peer)."""
+        slot = self.slot_index.get(peer)
+        if slot is None or total != self.n_elems * self.esz:
+            return None
+        w = float(weight)
+        if not np.isfinite(w) or w <= 0:
+            return None
+        with self._lock:
+            if self.frozen or slot in self._tainted or slot in self._sealed:
+                return None
+            if slot in self._aborted:
+                # A cleanly-reset abort (nothing committed) may retry.
+                self._aborted.discard(slot)
+                self._filled[slot] = 0
+            self._weights[slot] = w
+        return ContributionSink(self, slot, w, on_done)
+
+    def taints(self, peer: str) -> bool:
+        """True when ``peer``'s earlier streamed push committed tiles and
+        then died: a later (dense or streamed) contribution under this key
+        can no longer enter the round coherently."""
+        slot = self.slot_index.get(peer)
+        return slot is not None and slot in self._tainted
+
+    # -- ingestion ------------------------------------------------------------
+
+    def add_chunk(self, slot: int, weight: float, off: int, data: bytes) -> None:
+        """Fold one verified wire chunk (``off`` in wire-byte space, always
+        chunk-aligned by the transport's framing) for ``slot``."""
+        if off % self.chunk_bytes or len(data) % self.esz:
+            # Framing the transport never produces: poison this slot rather
+            # than fold misaligned bytes.
+            self.abort_slot(slot)
+            return
+        tile = off // self.chunk_bytes
+        e0 = tile * self.tile_elems
+        n = len(data) // self.esz
+        if tile >= self.n_tiles or e0 + n > self.n_elems:
+            self.abort_slot(slot)
+            return
+        fire: List[tuple] = []
+        with self._lock:
+            if self.frozen or slot in self._aborted or slot in self._tainted:
+                return
+            if self._filled[slot] != e0:
+                # Chunks arrive strictly in order per contribution; a gap
+                # means a retry raced an earlier stream — refuse the slot.
+                self._aborted.add(slot)
+                if self._committed_tiles[slot]:
+                    self._tainted.add(slot)
+                return
+            self._filled[slot] = e0 + n
+            t0 = time.perf_counter()
+            if self.mode == "mean":
+                x = self._decode(data)
+                native.weighted_sum_inplace(self._out[e0 : e0 + n], x, weight)
+                self._tile_w[tile] += weight
+                self._committed_tiles[slot] += 1
+                self.tiles_early += 1  # folded while the push was in flight
+            elif self.mode == "window":
+                self._window_row(slot, tile, self._decode(data), n, fire)
+            else:  # d2_dense / dense
+                row = self._row_buffer(slot)
+                self._decode(data, out=row[e0:])
+                self._committed_tiles[slot] += 1
+                if self.mode == "d2_dense":
+                    self._accumulate_d2(slot, tile, e0, e0 + n)
+            self.busy_s += time.perf_counter() - t0
+        for t, w, r in fire:
+            self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
+
+    def add_dense(self, peer: str, weight: float, buf: np.ndarray) -> bool:
+        """Fold a complete dense contribution (the leader's own, a parked
+        pre-arming buffer, or an inline sub-chunk payload). Returns False —
+        contribution NOT folded — once the round is frozen."""
+        slot = self.slot_index.get(peer)
+        if slot is None or buf.size != self.n_elems:
+            return False
+        w = float(weight)
+        fire: List[tuple] = []
+        with self._lock:
+            if self.frozen or slot in self._aborted or slot in self._tainted or slot in self._sealed:
+                return False
+            t0 = time.perf_counter()
+            if self.mode == "mean":
+                native.weighted_sum_inplace(self._out, np.ascontiguousarray(buf, np.float32), w)
+                self._tile_w += w
+                self._committed_tiles[slot] += self.n_tiles
+            elif self.mode == "window":
+                # Borrowed reference, not a copy: rows flow into windows
+                # lazily (open ones now, future ones at creation, the rest
+                # at finalize). A tile that already aggregated EARLY before
+                # this feed excludes it — the same per-tile participation
+                # contract streamed stragglers get.
+                ref = np.ascontiguousarray(buf, np.float32)
+                self._resident[slot] = ref
+                for tile, win in list(self._windows.items()):
+                    if win.mask[slot]:
+                        continue
+                    e0 = tile * self.tile_elems
+                    n = min(self.tile_elems, self.n_elems - e0)
+                    row0 = slot * self.tile_elems
+                    win.buf[row0 : row0 + n] = ref[e0 : e0 + n]
+                    win.mask[slot] = True
+                    win.count += 1
+                    if win.count >= self._active_slots():
+                        del self._windows[tile]
+                        fire.append((tile, win))
+            else:
+                row = self._row_buffer(slot)
+                row[:] = buf
+                self._committed_tiles[slot] += self.n_tiles
+                if self.mode == "d2_dense":
+                    for tile in range(self.n_tiles):
+                        e0 = tile * self.tile_elems
+                        self._accumulate_d2(
+                            slot, tile, e0, min(e0 + self.tile_elems, self.n_elems)
+                        )
+            self.busy_s += time.perf_counter() - t0
+            self._filled[slot] = self.n_elems
+            self._sealed.add(slot)
+            self._weights[slot] = w
+            self.dense_contribs += 1
+        for t, w, r in fire:
+            self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
+        return True
+
+    def seal_slot(self, slot: int) -> bool:
+        """Mark a streamed contribution complete; False when it didn't
+        actually deliver every element (short stream)."""
+        with self._lock:
+            if slot in self._aborted or slot in self._tainted:
+                return False
+            if self._filled[slot] != self.n_elems:
+                return False
+            self._sealed.add(slot)
+            self.streamed_contribs += 1
+            return True
+
+    def abort_slot(self, slot: int) -> None:
+        """A streamed contribution died mid-payload. Tiles it already
+        committed stand (per-tile participation, module doc); open window
+        rows are withdrawn; a slot with committed tiles is tainted."""
+        fire: List[tuple] = []
+        with self._lock:
+            if slot in self._aborted or slot in self._sealed or self.frozen:
+                self._aborted.add(slot)
+                return
+            self._aborted.add(slot)
+            self.aborted_contribs += 1
+            if self.mode in ("mean", "window") and self._committed_tiles[slot]:
+                # Irreversibly folded tiles (axpy'd / aggregated): the slot
+                # can't coherently re-enter this round.
+                self._tainted.add(slot)
+            if self.mode in ("d2_dense", "dense"):
+                # Nothing irreversible happened (rows are retained until
+                # finalize): a retry starts clean.
+                self._committed_tiles[slot] = 0
+            if self.mode == "window":
+                for tile, win in self._windows.items():
+                    if win.mask[slot]:
+                        win.mask[slot] = False
+                        win.count -= 1
+                # Its absence may be exactly what held the remaining
+                # windows open — re-check the early-fire condition.
+                active = self._active_slots()
+                for tile, win in list(self._windows.items()):
+                    if win.count and win.count >= active:
+                        fire.append(self._fire_locked(tile, win, early=True))
+            elif self.mode in ("d2_dense", "dense"):
+                row = self._rows.pop(slot, None)
+                if row is not None:
+                    self._note_free(row.nbytes)
+                    self.pool.put(row)
+                # Withdraw its pairwise-d² participation so a clean retry
+                # can't double-accumulate pairs it already contributed.
+                for peers in self._tile_sealed.values():
+                    if slot in peers:
+                        peers.remove(slot)
+                if self._d2 is not None:
+                    self._d2[slot, :] = 0.0
+                    self._d2[:, slot] = 0.0
+        for t, w, r in fire:
+            self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
+
+    # -- internals ------------------------------------------------------------
+
+    def _active_slots(self) -> int:
+        return len(self.slots) - len(self._aborted)
+
+    def _row_buffer(self, slot: int) -> np.ndarray:
+        row = self._rows.get(slot)
+        if row is None:
+            row = self.pool.get(self.n_elems)
+            self._note_alloc(row.nbytes)
+            self._rows[slot] = row
+        return row
+
+    def _fire_locked(self, tile: int, win: _Window, early: bool):
+        """Commit one window's CLOSURE atomically (caller holds the lock):
+        the tile is done, its rows are committed, and the window leaves the
+        in-flight dict — all before the aggregation math runs, so neither
+        an abort nor a clean-retry re-stream can reopen or double-count the
+        tile while the worker job is still in flight. Returns the job args
+        for the caller to spawn OUTSIDE the lock."""
+        self._windows.pop(tile, None)
+        self._win_done[tile] = True
+        rows = np.flatnonzero(win.mask)
+        self._committed_tiles[rows] += 1
+        if early:
+            self.tiles_early += 1
+        else:
+            self.tiles_deadline += 1
+        return (tile, win, rows)
+
+    def _window_row(
+        self, slot: int, tile: int, x: np.ndarray, n: int,
+        fire: List[tuple],
+    ) -> None:
+        """Place one decoded tile row; when every active slot has
+        contributed it, close the window (atomically, via _fire_locked) and
+        queue its aggregation job on ``fire`` for the caller to spawn
+        OUTSIDE the lock. Caller holds the lock."""
+        if self._win_done[tile]:
+            return  # tile already closed (late row after an early fire)
+        win = self._windows.get(tile)
+        if win is None:
+            flat = self.pool.get(len(self.slots) * self.tile_elems)
+            self._note_alloc(flat.nbytes)
+            win = self._windows[tile] = _Window(flat, len(self.slots))
+            # Seed the new window with every resident dense contribution.
+            e0 = tile * self.tile_elems
+            for rslot, ref in self._resident.items():
+                if rslot == slot or rslot in self._aborted:
+                    continue
+                rn = min(self.tile_elems, self.n_elems - e0)
+                win.buf[rslot * self.tile_elems : rslot * self.tile_elems + rn] = (
+                    ref[e0 : e0 + rn]
+                )
+                win.mask[rslot] = True
+                win.count += 1
+        win.buf[slot * self.tile_elems : slot * self.tile_elems + n] = x[:n]
+        if not win.mask[slot]:
+            win.mask[slot] = True
+            win.count += 1
+        if win.count >= self._active_slots():
+            fire.append(self._fire_locked(tile, win, early=True))
+
+    def _aggregate_window(self, tile: int, win: _Window, rows: np.ndarray) -> None:
+        """The aggregation math for one ALREADY-CLOSED tile (closure —
+        done flag, committed rows — happened in _fire_locked): robust-
+        aggregate the arrived rows into the output slice, return the window
+        buffer to the pool. Runs on a worker thread when a loop is
+        available; an exception here propagates out of finalize() and fails
+        the round rather than committing a silently-zeroed tile."""
+        t0 = time.perf_counter()
+        e0 = tile * self.tile_elems
+        n = min(self.tile_elems, self.n_elems - e0)
+        try:
+            if rows.size:
+                stack = win.buf[: len(self.slots) * self.tile_elems].reshape(
+                    len(self.slots), self.tile_elems
+                )[rows, :n]
+                kw = self._kw_fn(rows.size)
+                self._out[e0 : e0 + n] = robust.aggregate(
+                    np.ascontiguousarray(stack), self.method, **kw
+                )
+        finally:
+            with self._lock:
+                self.busy_s += time.perf_counter() - t0
+                self._note_free(win.buf.nbytes)
+                self.pool.put(win.buf)
+
+    def _accumulate_d2(self, slot: int, tile: int, e0: int, e1: int) -> None:
+        """Tile-wise pairwise squared-distance accumulation (krum/bulyan):
+        d² is a plain sum over coordinates, so each sealed tile adds its
+        partial distances against every slot that already sealed the same
+        tile. Caller holds the lock."""
+        peers = self._tile_sealed.setdefault(tile, [])
+        a = self._rows[slot][e0:e1]
+        for other in peers:
+            if other == slot:
+                continue
+            b_row = self._rows.get(other)
+            if b_row is None:
+                continue
+            d = a.astype(np.float64) - b_row[e0:e1]
+            v = float(np.dot(d, d))
+            self._d2[slot, other] += v
+            self._d2[other, slot] += v
+        peers.append(slot)
+
+    def _spawn(self, fn: Callable[[], None]) -> None:
+        """Run an aggregation job off the event loop when one is running,
+        inline otherwise (synchronous tests, worker-thread callers)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            fn()
+            return
+        task = loop.create_task(asyncio.to_thread(fn))
+        self._tasks.append(task)
+
+    # -- commit ---------------------------------------------------------------
+
+    def freeze(self) -> None:
+        """Stop accepting contributions (the deadline hit): later chunks and
+        dense feeds become no-ops. ``finalize`` then closes what's open —
+        split from it so the caller can fix round membership between the
+        two without racing in-flight feeds.
+
+        Fully-delivered streams whose close() hasn't run yet (the commit
+        can interleave with a frame's trailing-MAC read) are auto-sealed:
+        every chunk CRC-verified and folded, so the mass IS in the
+        aggregate — the peer must be reported included, not absent."""
+        with self._lock:
+            self.frozen = True
+            for slot in range(len(self.slots)):
+                if (
+                    slot not in self._sealed
+                    and slot not in self._aborted
+                    and slot not in self._tainted
+                    and self._filled[slot] == self.n_elems
+                ):
+                    self._sealed.add(slot)
+                    self.streamed_contribs += 1
+
+    def weight_of(self, peer: str) -> float:
+        """The weight a peer's contribution was folded with (0.0 if it
+        never fed this round)."""
+        slot = self.slot_index.get(peer)
+        with self._lock:
+            return float(self._weights.get(slot, 0.0)) if slot is not None else 0.0
+
+    def included_peers(self) -> List[str]:
+        """Peers whose COMPLETE contribution entered the aggregate."""
+        with self._lock:
+            return [self.slots[s] for s in sorted(self._sealed)]
+
+    async def finalize(self, included: Optional[List[str]] = None) -> np.ndarray:
+        """Freeze arrivals, close open windows over the arrived subsets,
+        await in-flight tile jobs, and return the committed buffer; every
+        transient tile goes back to the pool. A failed tile job raises —
+        the round must FAIL loudly, never commit a silently-zeroed tile."""
+        self.freeze()
+        leftovers: List[tuple] = []
+        with self._lock:
+            for tile, win in list(self._windows.items()):
+                if win.count:
+                    leftovers.append(self._fire_locked(tile, win, early=False))
+                else:
+                    # Empty window (every row withdrawn): nothing to close.
+                    self._windows.pop(tile, None)
+                    self._note_free(win.buf.nbytes)
+                    self.pool.put(win.buf)
+        for t, w, r in leftovers:
+            self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
+        if self._tasks:
+            results = await asyncio.gather(*self._tasks, return_exceptions=True)
+            self._tasks.clear()
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise RuntimeError(f"tile aggregation failed: {r!r}") from r
+        out = await asyncio.to_thread(self._finalize_blocking, included)
+        self.release()  # transient rows/windows back to the pool NOW
+        return out
+
+    def _finalize_blocking(self, included: Optional[List[str]]) -> np.ndarray:
+        t0 = time.perf_counter()
+        try:
+            if self.mode == "mean":
+                # Per-tile re-normalization by the weight that ARRIVED: the
+                # deadline-commit re-weighting, applied at tile granularity.
+                for tile in range(self.n_tiles):
+                    e0 = tile * self.tile_elems
+                    w = self._tile_w[tile]
+                    if w > 0:
+                        self._out[e0 : e0 + self.tile_elems] *= np.float32(1.0 / w)
+                return self._out
+            if self.mode == "window":
+                # Tiles no streamed chunk ever opened (e.g. every push
+                # landed dense/pre-arming) close here over the residents.
+                if self._resident:
+                    rows = [
+                        s for s in sorted(self._resident) if s not in self._aborted
+                    ]
+                    for tile in range(self.n_tiles):
+                        if self._win_done[tile] or tile in self._windows or not rows:
+                            continue
+                        e0 = tile * self.tile_elems
+                        n = min(self.tile_elems, self.n_elems - e0)
+                        stack = np.stack(
+                            [self._resident[s][e0 : e0 + n] for s in rows]
+                        )
+                        self._out[e0 : e0 + n] = robust.aggregate(
+                            stack, self.method, **self._kw_fn(len(rows))
+                        )
+                        self._win_done[tile] = True
+                        self.tiles_deadline += 1
+                return self._out
+            # d2_dense / dense: stack the complete rows and run the dense
+            # estimator (selection from the PRE-ACCUMULATED d² for krum/bulyan).
+            slots = sorted(
+                self.slot_index[p]
+                for p in (included if included is not None else self.included_peers())
+                if self.slot_index.get(p) in self._rows
+                and self._filled[self.slot_index[p]] == self.n_elems
+            )
+            if not slots:
+                return self._out
+            stack = np.stack([self._rows[s] for s in slots])
+            kw = self._kw_fn(len(slots))
+            if self.mode == "d2_dense" and self._d2 is not None:
+                kw = dict(kw, d2=self._d2[np.ix_(slots, slots)].astype(np.float32))
+            self._out = robust.aggregate(stack, self.method, **kw)
+            return self._out
+        finally:
+            self.busy_s += time.perf_counter() - t0
+
+    def release(self) -> None:
+        """Return every transient buffer to the pool (skipped/failed round)."""
+        with self._lock:
+            self.frozen = True
+            for win in self._windows.values():
+                self._note_free(win.buf.nbytes)
+                self.pool.put(win.buf)
+            self._windows.clear()
+            for row in self._rows.values():
+                self._note_free(row.nbytes)
+                self.pool.put(row)
+            self._rows.clear()
+            self._resident.clear()  # borrowed references: just drop them
+
+    def gauges(self) -> dict:
+        wall = max(time.monotonic() - self.t0, 1e-9)
+        return {
+            "mode": self.mode,
+            "peak_bytes_held": int(self.peak_bytes_held),
+            "tiles_early": int(self.tiles_early),
+            "tiles_deadline": int(self.tiles_deadline),
+            "agg_busy_s": round(self.busy_s, 6),
+            "agg_busy_frac": round(min(self.busy_s / wall, 1.0), 4),
+            "streamed_contribs": int(self.streamed_contribs),
+            "dense_contribs": int(self.dense_contribs),
+            "aborted_contribs": int(self.aborted_contribs),
+        }

@@ -44,6 +44,7 @@ import numpy as np
 
 from distributedvolunteercomputing_tpu import native
 from distributedvolunteercomputing_tpu.ops import robust
+from distributedvolunteercomputing_tpu.swarm.agg_stream import StreamingAggregator
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.matchmaking import Group, Matchmaker
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
@@ -62,6 +63,20 @@ log = get_logger(__name__)
 # contribution (SG1) by construction (raw q8's leading u64 count could
 # collide with SG1 for unlucky model sizes).
 _SIGN_RESULT_MAGIC = b"SQ8"
+
+
+class _Streamed:
+    """Sentinel "buffer" for a contribution that was folded into the round's
+    StreamingAggregator on arrival: the leader never held its dense copy, so
+    there is nothing to stack — the aggregator owns that mass."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "<streamed>"
+
+
+STREAMED = _Streamed()
 
 
 class _Round:
@@ -92,6 +107,20 @@ class _Round:
         # recorded at commit, served in fetch meta, and fed to the
         # resilience policy as this round's absent set.
         self.excluded: List[str] = []
+        # Streaming leader aggregation (f32/bf16 wires, armed by the LEADER
+        # when it enters the round): contribution chunks decode and fold as
+        # they arrive instead of materializing per-peer dense buffers. None
+        # on member side, parked rounds, and non-elementwise wires.
+        self.stream: Optional[StreamingAggregator] = None
+        # Leader-side round prologue ran (tokens fixed, estimator chosen,
+        # stream armed): _prepare_lead_round is idempotent through this.
+        self.armed = False
+        self.method: Optional[str] = None
+        self.kw_fn: Optional[Callable[[int], dict]] = None
+        # (peer, token) -> weight for pushes the transport's request sink
+        # folded COMPLETELY into the stream (its close(ok=True) ran); the
+        # contribute handler and the commit adopt these into ``contribs``.
+        self.stream_done: Dict[Any, float] = {}
         self.t0 = time.monotonic()
 
 
@@ -270,6 +299,11 @@ class AveragerBase:
         # re-reported (their miss was already counted at their own flush).
         self._last_outcomes: Optional[dict] = None
         self._last_outcomes_epoch: Optional[str] = None
+        # Cumulative leader-side aggregation-pipeline gauges (peak bytes
+        # held, tiles aggregated early vs at the deadline, aggregate-thread
+        # busy fraction) — filled by rounds this node LED with a streaming
+        # aggregator; surfaced via stats()/volunteer summary/coord.status.
+        self._agg_gauges: Dict[str, Any] = {}
 
     @property
     def round_key(self) -> str:
@@ -910,9 +944,29 @@ class AveragerBase:
             # experiments read off the volunteer summary.
             "transport": self.transport.stats(),
         }
+        if self._agg_gauges:
+            out["aggregation"] = dict(self._agg_gauges)
         if self.resilience is not None:
             out["resilience"] = self.resilience.stats()
         return out
+
+    def _note_agg_round(self, stream: Optional[StreamingAggregator]) -> None:
+        """Roll one led round's streaming-aggregation gauges into the
+        cumulative counters behind ``stats()['aggregation']``."""
+        if stream is None:
+            return
+        g = stream.gauges()
+        agg = self._agg_gauges
+        agg["mode"] = g["mode"]
+        agg["rounds_streamed"] = agg.get("rounds_streamed", 0) + 1
+        agg["peak_bytes_held"] = max(agg.get("peak_bytes_held", 0), g["peak_bytes_held"])
+        for k in (
+            "tiles_early", "tiles_deadline", "streamed_contribs",
+            "dense_contribs", "aborted_contribs",
+        ):
+            agg[k] = agg.get(k, 0) + g[k]
+        agg["agg_busy_s"] = round(agg.get("agg_busy_s", 0.0) + g["agg_busy_s"], 6)
+        agg["last_busy_frac"] = g["agg_busy_frac"]
 
 
 class SyncAverager(AveragerBase):
@@ -931,6 +985,47 @@ class SyncAverager(AveragerBase):
         self._rounds: Dict[str, _Round] = {}
         self.transport.register("sync.contribute", self._rpc_contribute)
         self.transport.register("sync.fetch", self._rpc_fetch)
+        # Streaming leader aggregation: chunked contribute payloads decode
+        # and fold into the round's aggregator AS THEY ARRIVE instead of
+        # buffering per-peer dense vectors (swarm/agg_stream.py).
+        self.transport.register_request_sink(
+            "sync.contribute", self._contribute_stream_factory
+        )
+
+    def _contribute_stream_factory(self, args: dict, total: int):
+        """Per-request sink for a member's chunked contribution, or None to
+        buffer normally. Only an ARMED round streams (the leader entered it:
+        tokens and aggregator exist) — pre-arming pushes park as before, and
+        every condition a streamed push skips here is re-checked the same
+        way the buffered handler would have checked it."""
+        if self.wire not in ("f32", "bf16"):
+            return None
+        epoch = args.get("epoch")
+        st = self._rounds.get(epoch) if isinstance(epoch, str) else None
+        if st is None or st.stream is None or st.result_ready.is_set():
+            return None
+        if not self._check_schema(args):
+            return None
+        peer = args.get("peer")
+        token = args.get("token", "")
+        key = (peer, token)
+        if st.tokens is None or not peer or st.tokens.get(peer) != token:
+            return None  # forgery: the buffered handler rejects it loudly
+        if key in st.contribs or key in st.stream_done:
+            return None  # duplicate/retry: idempotent ack via the handler
+        try:
+            weight = float(args.get("weight"))
+        except (TypeError, ValueError):
+            return None
+
+        def on_done(ok: bool) -> None:
+            if ok:
+                # Sealed BEFORE the handler task runs (the transport closes
+                # the sink while still reading the frame), so the handler —
+                # and a commit racing it — can adopt the entry.
+                st.stream_done[key] = weight
+
+        return st.stream.make_sink(peer, weight, total, on_done=on_done)
 
     async def _rpc_contribute(self, args: dict, payload: bytes):
         if not self._check_schema(args):
@@ -967,6 +1062,25 @@ class SyncAverager(AveragerBase):
             # otherwise 64 fabricated keys fill the cap and pre-block every
             # honest push for the rest of the round.
             raise RPCError("invalid contribution token for this round")
+        if key in st.stream_done:
+            # The transport's request sink already decoded and folded this
+            # push chunk-by-chunk as it arrived (streaming aggregation):
+            # record the contribution without a dense copy — there is none.
+            st.contribs.setdefault(key, (st.stream_done[key], STREAMED))
+            if st.expected and {
+                p for p, t in st.contribs
+                if st.tokens is None or st.tokens.get(p) == t
+            } >= st.expected:
+                st.full.set()
+            return {"ok": True}, b""
+        if st.stream is not None and st.stream.taints(key[0]):
+            # An earlier streamed push under this key died AFTER committing
+            # tiles into the aggregate; a replacement can't enter the round
+            # coherently (its sealed tiles already count, per-tile).
+            raise RPCError(
+                "contribution partially streamed into committed tiles; "
+                "peer sits this round out"
+            )
         if key not in st.contribs and len(st.contribs) >= self.MAX_PARKED_CONTRIBS:
             raise RPCError("round contribution cap reached")
         buf = await self._decode_payload(payload)
@@ -985,6 +1099,22 @@ class SyncAverager(AveragerBase):
                 # contribution until _decode_deferred resolves it at
                 # aggregation time.
                 st.payloads[key] = payload
+            elif (
+                st.stream is not None
+                and buf is not None
+                and buf.size == st.stream.n_elems
+                and st.tokens is not None
+                and st.tokens.get(key[0]) == key[1]
+            ):
+                # Round is armed but this payload rode inline (sub-chunk) or
+                # the sink declined: fold the dense buffer into the stream
+                # and drop the copy — the aggregator owns that mass now. A
+                # feed refused (frozen round, tainted slot) keeps the dense
+                # entry, which the commit then ignores as late.
+                w = float(args["weight"])
+                fed = await asyncio.to_thread(st.stream.add_dense, key[0], w, buf)
+                if fed and st.contribs.get(key, (None, None))[1] is buf:
+                    st.contribs[key] = (w, STREAMED)
         if st.expected:
             valid = {
                 p for p, t in st.contribs
@@ -1034,6 +1164,11 @@ class SyncAverager(AveragerBase):
             self.rounds_skipped += 1
             self._last_outcomes = None
             return None
+        if group.my_index == 0 and self._specs is not None:
+            # Arm the streaming round BEFORE packing our own contribution:
+            # members push the instant formation completes, and the pack at
+            # param scale is exactly the window their first chunks land in.
+            await self._prepare_lead_round(group)
         # One compression per round, leader or member: the leader's own
         # contribution enters the aggregate exactly as a peer would see it.
         buf, wire_bytes, sent = await self._pack_and_compress(tree)
@@ -1069,17 +1204,26 @@ class SyncAverager(AveragerBase):
         self._flush_round_outcome(time.monotonic() - t0, ok=result is not None)
         return result
 
-    async def _lead_round(
-        self,
-        group: Group,
-        buf: np.ndarray,
-        weight: float,
-        wire_bytes: bytes = b"",
-    ):
-        member_ids = [pid for pid, _ in group.members]
+    async def _prepare_lead_round(self, group: Group) -> _Round:
+        """The leader-side round prologue, idempotent per epoch: fix the
+        token table, pick the estimator, ARM the streaming aggregator, and
+        fold any pre-arming parked buffers into it.
+
+        Split from _lead_round so ``average()`` can run it BEFORE packing
+        the leader's own contribution: members push the instant formation
+        completes, and a param-scale pack is exactly the window their
+        headers used to land in — every push that arrived pre-arming had
+        to buffer dense (observed on a localhost resnet18 swarm: all
+        contributions went dense). Pre-armed, the factory catches them
+        from the first chunk. Needs ``self._specs`` (any round after the
+        first); round one arms from _lead_round, after the pack."""
         st = self._rounds.get(group.epoch)
         if st is None:
             st = self._rounds[group.epoch] = _Round([])
+        if st.armed:
+            return st
+        st.armed = True
+        member_ids = [pid for pid, _ in group.members]
         st.expected = set(member_ids)
         tokens = group.member_tokens or {}
         st.tokens = tokens
@@ -1091,9 +1235,66 @@ class SyncAverager(AveragerBase):
         st.payloads = {
             k: pl for k, pl in st.payloads.items() if k in st.contribs
         }
+        # The estimator is fixed at ARMING (not commit): streamed tiles
+        # aggregate while contributions are still arriving, so the method
+        # must be known before the first chunk lands. Same policy input the
+        # commit-time call consulted — only the moment moved.
+        method, _ = self._effective_method(len(member_ids))
+        kw_cache: Dict[int, dict] = {}
+
+        def kw_fn(n: int, _m=method) -> dict:
+            # Memoized per row count: a per-tile recompute would re-log the
+            # infeasible-trim clamp warning once per tile.
+            if n not in kw_cache:
+                kw_cache[n] = self._robust_kw(n, method=_m)
+            return kw_cache[n]
+
+        st.method, st.kw_fn = method, kw_fn
+        n_elems = sum(s.size for s in self._specs)
+        esz = 4 if self.wire == "f32" else 2
+        if self.wire in ("f32", "bf16") and self.transport.chunk_bytes % esz == 0:
+            # Arm the streaming pipeline: from here on, chunked pushes fold
+            # tile-by-tile as they arrive (transport request sink), inline
+            # pushes fold at decode, and the deadline commit reduces to
+            # closing whatever is still open.
+            st.stream = StreamingAggregator(
+                n_elems, member_ids, method, self.wire,
+                self.transport.chunk_bytes, kw_fn=kw_fn,
+            )
+            # Fold every pre-arming parked buffer; fed entries drop their
+            # dense copy — the aggregator owns that mass now.
+            for k, (w_k, b_k) in [
+                (k, c) for k, c in st.contribs.items()
+                if c[1] is not None and c[1] is not STREAMED
+                and c[1].size == n_elems
+            ]:
+                fed = await asyncio.to_thread(st.stream.add_dense, k[0], w_k, b_k)
+                if fed:
+                    st.contribs[k] = (w_k, STREAMED)
+        return st
+
+    async def _lead_round(
+        self,
+        group: Group,
+        buf: np.ndarray,
+        weight: float,
+        wire_bytes: bytes = b"",
+    ):
+        st = await self._prepare_lead_round(group)
+        tokens = st.tokens or {}
+        method, kw_fn = st.method, st.kw_fn
         st.contribs[(self.peer_id, group.token)] = (weight, buf)
         if self.wire == "powersgd" and wire_bytes:
             st.payloads[(self.peer_id, group.token)] = wire_bytes
+        if st.stream is not None:
+            # Our own contribution enters through the same pipeline the
+            # members' do (mean: one O(D) axpy; window: a borrowed-reference
+            # resident).
+            fed = await asyncio.to_thread(
+                st.stream.add_dense, self.peer_id, weight, buf
+            )
+            if fed:
+                st.contribs[(self.peer_id, group.token)] = (weight, STREAMED)
         if {p for p, _ in st.contribs} >= st.expected:
             st.full.set()
         try:
@@ -1109,17 +1310,43 @@ class SyncAverager(AveragerBase):
             # Resolve pre-schema-parked powersgd payloads now that our own
             # pack fixed the specs (exact-size-capped decode).
             await self._decode_deferred(st)
+            if st.stream is not None:
+                # Freeze the pipeline BEFORE deciding membership: a feed
+                # that loses this race is late by definition (its dense
+                # entry survives but is not adopted), and stream-complete
+                # pushes whose handler task hasn't run yet are adopted here.
+                st.stream.freeze()
+                for k, w_k in list(st.stream_done.items()):
+                    if tokens.get(k[0]) == k[1]:
+                        st.contribs.setdefault(k, (w_k, STREAMED))
+                # The aggregator's own view beats the handler bookkeeping:
+                # a contribution that finished folding pre-freeze IS in the
+                # aggregate even when its handler (dense-feed STREAMED mark)
+                # or sink close() hasn't caught up — report it included, or
+                # the resilience policy penalizes an honest peer whose mass
+                # the round actually used.
+                for p in st.stream.included_peers():
+                    t = tokens.get(p)
+                    if t is not None:
+                        st.contribs[(p, t)] = (st.stream.weight_of(p), STREAMED)
             # Drop contributions whose buffer doesn't match ours (model
             # mismatch that slipped past the early-accept schema check) or
             # whose token isn't the secret WE issued to that member at begin
-            # — a member cannot submit under another member's identity.
+            # — a member cannot submit under another member's identity. On a
+            # streaming round only FOLDED (streamed) entries count: a dense
+            # buffer that never made it into the aggregator is late.
             good = {
                 p: c
                 for (p, t), c in st.contribs.items()
                 # c[1] None: a pre-schema deferred entry whose payload a
                 # straggler handler parked DURING _decode_deferred's awaits
                 # — unresolved, so it sits this round out.
-                if c[1] is not None and c[1].size == buf.size and tokens.get(p) == t
+                if tokens.get(p) == t
+                and (
+                    c[1] is STREAMED
+                    if st.stream is not None
+                    else c[1] is not None and c[1].size == buf.size
+                )
             }
             # Per-peer outcomes for the resilience policy: an expected
             # member missing from ``good`` either never arrived (absent) or
@@ -1129,6 +1356,7 @@ class SyncAverager(AveragerBase):
                 for (p, t), c in st.contribs.items()
                 if tokens.get(p) == t
                 and p != self.peer_id
+                and c[1] is not STREAMED
                 and (c[1] is None or c[1].size != buf.size)
             )
             st.excluded = sorted(
@@ -1144,6 +1372,12 @@ class SyncAverager(AveragerBase):
                 self.rounds_skipped += 1
                 # Fail members' pending fetches fast, then free the buffers.
                 st.result_ready.set()  # with st.result None -> fetch raises
+                # Eager release: the parked contributions are param-sized
+                # and nothing after this point reads them — holding them
+                # until the 5 s sweep fires kept O(N·D) pinned per skipped
+                # round. The _Round shell stays for fetch-error serving.
+                self._note_agg_round(st.stream)
+                self._release_round(st)
                 asyncio.get_running_loop().call_later(
                     5.0, self._rounds.pop, group.epoch, None
                 )
@@ -1156,7 +1390,7 @@ class SyncAverager(AveragerBase):
                 )
             peers = sorted(good)
             st.included = peers
-            method, method_kw = self._effective_method(len(peers))
+            method_kw = kw_fn(len(peers))
 
             def _aggregate() -> np.ndarray:
                 if method == "mean":
@@ -1173,9 +1407,19 @@ class SyncAverager(AveragerBase):
                 stack = np.stack([good[p][1] for p in peers])
                 return robust.aggregate(stack, method, **method_kw)
 
-            # Seconds of array math at param scale — off the loop (members'
-            # fetches park on result_ready; heartbeats must keep flowing).
-            st.result = await asyncio.to_thread(_aggregate)
+            if st.stream is not None:
+                # The pipeline already decoded and (for mean/window methods)
+                # aggregated most tiles while chunks were arriving: the
+                # commit closes the open windows over the arrived subsets,
+                # awaits in-flight tile jobs, and re-normalizes — bounded by
+                # the tail, not by N full decode+aggregate passes.
+                st.result = await st.stream.finalize(peers)
+                self._note_agg_round(st.stream)
+            else:
+                # Seconds of array math at param scale — off the loop
+                # (members' fetches park on result_ready; heartbeats must
+                # keep flowing).
+                st.result = await asyncio.to_thread(_aggregate)
             # Encode the wire form ONCE before releasing the fetch waiters.
             if self.wire == "powersgd" and method == "mean":
                 # Serve the EXACT factored mean (concatenated weighted
@@ -1201,6 +1445,14 @@ class SyncAverager(AveragerBase):
                         return self._to_wire(st.result)
 
                 st.result_wire = await asyncio.to_thread(_merge_or_dense)
+            elif self.wire in ("f32", "bf16"):
+                # Lazy wire form: each fetch response encodes chunk-by-chunk
+                # on a worker thread while earlier chunks are already on the
+                # socket (encode/send overlap), so the commit point never
+                # pays — or holds — a full-size encoded copy of the result.
+                # At most max_group cheap elementwise passes replace the one
+                # eager encode, each overlapped with its own send.
+                st.result_wire = self._wire_stream(st.result)
             else:
                 st.result_wire = await self._encode_wire(st.result)
             st.result_ready.set()
@@ -1211,8 +1463,20 @@ class SyncAverager(AveragerBase):
             )
             return self._unpack(st.result)
         except Exception:
-            self._rounds.pop(group.epoch, None)
+            failed = self._rounds.pop(group.epoch, None)
+            if failed is not None:
+                self._release_round(failed)
             raise
+
+    def _release_round(self, st: _Round) -> None:
+        """Free a round's held contribution buffers NOW (skipped/failed
+        rounds): parked payloads and dense contributions are param-sized,
+        and the streaming aggregator's tiles go back to the pool."""
+        st.contribs.clear()
+        st.payloads.clear()
+        st.stream_done.clear()
+        if st.stream is not None:
+            st.stream.release()
 
     async def _member_round(self, group: Group, weight: float, wire_bytes: bytes):
         leader_addr = group.members[0][1]

@@ -166,6 +166,7 @@ class ChaosTransport(Transport):
         drop_rate: float = 0.0,
         delay_s: float = 0.0,
         corrupt_rate: float = 0.0,
+        corrupt_at_frac: Optional[float] = None,
         seed: int = 0,
         schedule: Optional[FaultSchedule] = None,
         **kwargs,
@@ -174,6 +175,12 @@ class ChaosTransport(Transport):
         self.drop_rate = drop_rate
         self.delay_s = delay_s
         self.corrupt_rate = corrupt_rate
+        # Deterministic corruption PLACEMENT: flip the byte at this fraction
+        # of the payload (0.0 = first byte, ~1.0 = last) instead of a seeded
+        # random offset. On the chunked wire that pins which CHUNK dies —
+        # the streaming-aggregation tests use it to control exactly how many
+        # tiles a contribution seals before its stream aborts.
+        self.corrupt_at_frac = corrupt_at_frac
         self.schedule = schedule
         self._chaos = random.Random(seed)
 
@@ -190,7 +197,10 @@ class ChaosTransport(Transport):
         if _corrupt_this_call.get() or (
             self.corrupt_rate and self._chaos.random() < self.corrupt_rate
         ):
-            pos = self._chaos.randrange(total)
+            if self.corrupt_at_frac is not None:
+                pos = min(int(self.corrupt_at_frac * total), total - 1)
+            else:
+                pos = self._chaos.randrange(total)
             log.debug("chaos: corrupting payload byte %d", pos)
             return pos
         return None

@@ -83,6 +83,15 @@ class Coordinator:
             # EWMA): the coordinator's own WAN vantage, one `coord.status`
             # away for operators.
             "transport": self.transport.stats(),
+            # Per-volunteer leader-aggregation pipeline gauges (peak bytes
+            # held, tiles aggregated early vs at-deadline, aggregate-thread
+            # busy fraction) from the freshest reports — empty until some
+            # volunteer has led a streaming round.
+            "aggregation": {
+                m.get("peer", "?"): m["aggregation"]
+                for m in fresh
+                if m.get("aggregation")
+            },
         }, b""
 
 

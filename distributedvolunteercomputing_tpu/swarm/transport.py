@@ -331,6 +331,16 @@ class Transport:
             )
         self._server: Optional[asyncio.base_events.Server] = None
         self._handlers: Dict[str, Handler] = {}
+        # method -> factory(args, payload_len) returning a per-request sink
+        # (or None to buffer normally): the server-side twin of call()'s
+        # chunk_sink. Verified REQUEST chunks stream to the sink as they
+        # arrive instead of assembling in a bytearray — the leader-side
+        # aggregation pipeline consumes contribution chunks this way
+        # (swarm/agg_stream.py). The matching handler then runs with an
+        # empty payload. See register_request_sink.
+        self._stream_factories: Dict[
+            str, Callable[[dict, int], Optional[Callable[[int, int, bytes], None]]]
+        ] = {}
         # ``pooled=False`` restores one-connection-per-call (the v1 wire
         # behavior): the escape hatch, and the baseline arm of
         # experiments/transport_bench.py.
@@ -360,6 +370,38 @@ class Transport:
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
+
+    def register_request_sink(
+        self,
+        method: str,
+        factory: Callable[[dict, int], Optional[Callable[[int, int, bytes], None]]],
+    ) -> None:
+        """Stream ``method``'s chunked REQUEST payloads to a per-request sink.
+
+        ``factory(args, payload_len)`` runs after the header frame is parsed
+        (and, with auth on, after its HMAC is verified — the meta the
+        factory sees is authenticated; the payload bytes are covered only by
+        per-chunk CRC until the trailing payload MAC). Returning None falls
+        back to normal buffering — streaming is an optimization the factory
+        may decline per request. The sink is called ``sink(offset, total,
+        data)`` per verified in-order chunk, then ``sink.close(ok)`` exactly
+        once: ok=True after the whole payload verified (including the MAC
+        trailer when auth is on), ok=False on any abort — bad chunk CRC,
+        framing error, connection death — possibly after some chunks were
+        already delivered. Inline (sub-chunk) payloads never stream. The
+        handler registered for ``method`` runs with an empty payload when
+        the sink consumed it."""
+        self._stream_factories[method] = factory
+
+    def _request_sink(self, meta: dict, payload_len: int):
+        fac = self._stream_factories.get(meta.get("method", ""))
+        if fac is None:
+            return None
+        try:
+            return fac(meta.get("args") or {}, payload_len)
+        except Exception as e:  # noqa: BLE001 — a factory bug must buffer, not kill the conn
+            log.debug("request sink factory failed (%s); buffering", errstr(e))
+            return None
 
     async def start(self) -> Addr:
         self._server = await asyncio.start_server(self._serve_conn, self._host, self._port)
@@ -626,6 +668,7 @@ class Transport:
         reader: asyncio.StreamReader,
         sink_lookup: Optional[Callable[[str], Optional[Callable]]] = None,
         peer: Optional[Addr] = None,
+        req_sinks: bool = False,
     ) -> Tuple[int, dict, bytes]:
         """Read one complete message (header frame + any chunk frames).
 
@@ -693,65 +736,96 @@ class Transport:
             # and the replay/dst checks run on bounded work.
             self._verify_auth(ftype, meta, b"")
         sink = sink_lookup(rid) if sink_lookup is not None else None
+        if sink is None and req_sinks and ftype == TYPE_REQ:
+            # Server-side request streaming (register_request_sink): the
+            # factory sees authenticated meta (header MAC verified above
+            # when auth is on) and may decline by returning None.
+            sink = self._request_sink(meta, payload_len)
+        sink_closed = False
+
+        def _close_sink(ok: bool) -> None:
+            # Exactly-once completion signal for sinks that track a
+            # lifecycle (request sinks do; the client fetch sink doesn't).
+            nonlocal sink_closed
+            if sink is None or sink_closed:
+                return
+            sink_closed = True
+            close = getattr(sink, "close", None)
+            if close is not None:
+                try:
+                    close(ok)
+                except Exception as e:  # noqa: BLE001 — a sink bug must not kill the conn
+                    log.debug("chunk sink close(%s) failed: %s", ok, errstr(e))
+
         mac = (
             self._payload_mac_ctx(ftype, rid) if self._secret is not None else None
         )
         buf: Optional[bytearray] = None if sink is not None else bytearray(payload_len)
         got = 0
         bad: Optional[str] = None
-        for i in range(n_chunks):
-            ch = await reader.readexactly(_CHUNK.size)
-            idx, length, ccrc = _CHUNK.unpack(ch)
-            if length == 0 or got + length > payload_len:
-                # Framing no longer adds up — the incremental size cap. The
-                # stream position past this point is untrustworthy.
+        try:
+            for i in range(n_chunks):
+                ch = await reader.readexactly(_CHUNK.size)
+                idx, length, ccrc = _CHUNK.unpack(ch)
+                if length == 0 or got + length > payload_len:
+                    # Framing no longer adds up — the incremental size cap. The
+                    # stream position past this point is untrustworthy.
+                    self.bytes_received += received
+                    raise RPCError(
+                        f"chunk framing exceeds declared payload "
+                        f"({got}+{length} > {payload_len})"
+                    )
+                data = await reader.readexactly(length)
+                received += _CHUNK.size + length
+                if mac is not None:
+                    mac.update(data)
+                if bad is None and idx != i:
+                    bad = f"chunk index {idx} != expected {i} (duplicated/reordered)"
+                elif bad is None and (zlib.crc32(data) & 0xFFFFFFFF) != ccrc:
+                    bad = f"chunk {i} CRC mismatch (corrupt frame)"
+                if bad is None:
+                    if sink is not None:
+                        try:
+                            # Verified chunk straight to the consumer: decode
+                            # (and leader-side aggregation) starts on the
+                            # FIRST chunk.
+                            sink(got, payload_len, data)
+                        except Exception as e:  # noqa: BLE001 — a sink bug fails the call, not the conn
+                            bad = f"chunk sink rejected payload: {errstr(e)}"
+                    else:
+                        buf[got : got + length] = data
+                got += length
+            if bad is None and got != payload_len:
+                bad = f"chunked payload short of declared total ({got} < {payload_len})"
+            if meta.get("ptrail"):
+                th = await reader.readexactly(_CHUNK.size)
+                t_idx, t_len, t_crc = _CHUNK.unpack(th)
+                if t_idx != n_chunks or t_len != hashlib.sha256().digest_size:
+                    self.bytes_received += received
+                    raise RPCError("malformed payload MAC trailer")
+                digest = await reader.readexactly(t_len)
+                received += _CHUNK.size + t_len
+                if mac is not None and bad is None and not hmac.compare_digest(
+                    digest, mac.digest()
+                ):
+                    self.bytes_received += received
+                    raise RPCError("auth failure (chunked payload MAC mismatch)")
+            elif mac is not None:
                 self.bytes_received += received
-                raise RPCError(
-                    f"chunk framing exceeds declared payload "
-                    f"({got}+{length} > {payload_len})"
-                )
-            data = await reader.readexactly(length)
-            received += _CHUNK.size + length
-            if mac is not None:
-                mac.update(data)
-            if bad is None and idx != i:
-                bad = f"chunk index {idx} != expected {i} (duplicated/reordered)"
-            elif bad is None and (zlib.crc32(data) & 0xFFFFFFFF) != ccrc:
-                bad = f"chunk {i} CRC mismatch (corrupt frame)"
-            if bad is None:
-                if sink is not None:
-                    try:
-                        # Verified chunk straight to the consumer: fetch-side
-                        # decode starts on the FIRST chunk.
-                        sink(got, payload_len, data)
-                    except Exception as e:  # noqa: BLE001 — a sink bug fails the call, not the conn
-                        bad = f"chunk sink rejected payload: {errstr(e)}"
-                else:
-                    buf[got : got + length] = data
-            got += length
-        if bad is None and got != payload_len:
-            bad = f"chunked payload short of declared total ({got} < {payload_len})"
-        if meta.get("ptrail"):
-            th = await reader.readexactly(_CHUNK.size)
-            t_idx, t_len, t_crc = _CHUNK.unpack(th)
-            if t_idx != n_chunks or t_len != hashlib.sha256().digest_size:
-                self.bytes_received += received
-                raise RPCError("malformed payload MAC trailer")
-            digest = await reader.readexactly(t_len)
-            received += _CHUNK.size + t_len
-            if mac is not None and bad is None and not hmac.compare_digest(
-                digest, mac.digest()
-            ):
-                self.bytes_received += received
-                raise RPCError("auth failure (chunked payload MAC mismatch)")
-        elif mac is not None:
-            self.bytes_received += received
-            raise RPCError("auth failure (chunked payload without MAC trailer)")
+                raise RPCError("auth failure (chunked payload without MAC trailer)")
+        except BaseException:
+            # Framing/auth failure or connection death mid-payload: the sink
+            # may have consumed verified chunks already — tell it the stream
+            # died so it can withdraw or quarantine them.
+            _close_sink(False)
+            raise
         self.bytes_received += received
         if peer is not None:
             self._peer(peer).bytes_received += received
         if bad is not None:
+            _close_sink(False)
             raise _PayloadError(rid, bad)
+        _close_sink(True)
         # The assembled bytearray is returned as-is (bytes-like): converting
         # would copy the whole payload — at contribution scale, a real cost.
         return ftype, meta, buf if buf is not None else b""
@@ -802,7 +876,9 @@ class Transport:
         try:
             while True:
                 try:
-                    ftype, meta, payload = await self._read_frame(reader)
+                    ftype, meta, payload = await self._read_frame(
+                        reader, req_sinks=True
+                    )
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
                 except _PayloadError as e:

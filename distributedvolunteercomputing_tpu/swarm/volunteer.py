@@ -704,6 +704,12 @@ class Volunteer:
                     else 0.0,
                     **{k: v for k, v in self.summary.items()},
                 }
+                if self.averager is not None and self.averager._agg_gauges:
+                    # Live leader-aggregation pipeline gauges (peak bytes
+                    # held, early/deadline tiles, busy fraction) — reported
+                    # mid-run so coord.status sees them before the final
+                    # summary lands.
+                    report["aggregation"] = dict(self.averager._agg_gauges)
                 await self.transport.call(caddr, "coord.report", report, timeout=5.0)
             except Exception:
                 # Coordinator reachability is not correctness-critical; with

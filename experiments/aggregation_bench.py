@@ -1,0 +1,235 @@
+"""Leader aggregation bench: streaming tile pipeline vs materialize-then-aggregate.
+
+The committed artifact behind the ISSUE-4 streaming-aggregation rework
+(``experiments/results/aggregation_bench.json``): measures the LEADER's
+peak held bytes and commit latency for the two ways of consuming a round's
+contributions, at the aggregation layer (no sockets — the wire is PR 2's
+job; this isolates what happens to verified chunks after the transport
+hands them over):
+
+- ``materialize`` — the pre-rework path: every peer's contribution is
+  decoded into a dense f32 buffer on arrival and HELD; the deadline commit
+  then either axpy-loops them (mean) or pays a second O(N·D) copy via
+  ``np.stack`` for the robust estimator.
+- ``streaming``   — ``swarm.agg_stream.StreamingAggregator``: each chunk
+  folds on arrival (mean: straight into the O(D) accumulator; window
+  methods: into the in-flight [N, tile] window, aggregated the moment all
+  peers' copies of that tile are in), so the commit only closes the tail.
+
+Chunks are fed round-robin across peers in the transport's wire order —
+the arrival schedule a concurrently-pushing group actually produces.
+
+Peak-held accounting is explicit, not sampled: the materialize arm's peak
+is its held dense buffers plus the stack copy at commit; the streaming
+arm's is the aggregator's own high-water tracking (result buffer included
+for both arms' fairness).
+
+Usage:
+    python experiments/aggregation_bench.py          # full grid + artifact
+    python experiments/aggregation_bench.py --quick  # small sanity run
+
+The default tier-1 suite runs a small-shape smoke of this harness
+(tests/test_agg_stream.py::TestAggregationBenchSmoke), so a regression in
+streaming commit latency or peak-held bytes fails loudly without this
+script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import platform
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedvolunteercomputing_tpu.ops import robust  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.agg_stream import (  # noqa: E402
+    StreamingAggregator,
+    TilePool,
+)
+from distributedvolunteercomputing_tpu import native  # noqa: E402
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+CHUNK_BYTES = 1 << 20  # the transport default: tiles == wire chunks
+
+
+def _contributions(n_peers: int, n_elems: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    weights = rng.uniform(0.5, 2.0, n_peers).astype(np.float64)
+    bufs = rng.standard_normal((n_peers, n_elems)).astype(np.float32)
+    return weights, bufs
+
+
+def _wire_chunks(buf: np.ndarray, chunk_bytes: int):
+    """(offset, bytes) pieces exactly as the transport's chunk framing
+    would deliver them (f32 wire)."""
+    raw = buf.view(np.uint8)
+    return [
+        (off, raw[off : off + chunk_bytes].tobytes())
+        for off in range(0, raw.nbytes, chunk_bytes)
+    ]
+
+
+def bench_materialize(
+    weights: np.ndarray, bufs: np.ndarray, method: str, kw: dict, chunk_bytes: int
+) -> dict:
+    """The pre-rework leader: decode-and-hold per peer, aggregate at commit."""
+    n_peers, n_elems = bufs.shape
+    held = []
+    t_start = time.perf_counter()
+    for p in range(n_peers):  # arrival: decode each contribution, hold it
+        chunks = _wire_chunks(bufs[p], chunk_bytes)
+        dense = np.empty(n_elems, np.float32)
+        raw = dense.view(np.uint8)
+        for off, data in chunks:
+            raw[off : off + len(data)] = np.frombuffer(data, np.uint8)
+        held.append(dense)
+    t_arrived = time.perf_counter()
+    peak = n_peers * n_elems * 4
+    if method == "mean":
+        total_w = float(weights.sum())
+        acc = np.zeros(n_elems, np.float32)
+        for p in range(n_peers):
+            native.weighted_sum_inplace(acc, held[p], float(weights[p]) / total_w)
+        result = acc
+        peak += n_elems * 4  # accumulator alongside the held buffers
+    else:
+        stack = np.stack(held)  # the second O(N·D) copy the rework removes
+        result = robust.aggregate(stack, method, **kw)
+        peak += n_peers * n_elems * 4 + n_elems * 4
+    t_done = time.perf_counter()
+    return {
+        "peak_bytes_held": peak,
+        "commit_s": round(t_done - t_arrived, 6),
+        "wall_s": round(t_done - t_start, 6),
+        "result": result,
+    }
+
+
+async def bench_streaming(
+    weights: np.ndarray, bufs: np.ndarray, method: str, kw: dict, chunk_bytes: int
+) -> dict:
+    """The streaming pipeline: chunks fold as they arrive (round-robin
+    across peers — the concurrent-push arrival order), commit closes the tail."""
+    n_peers, n_elems = bufs.shape
+    peers = [f"p{i}" for i in range(n_peers)]
+    agg = StreamingAggregator(
+        n_elems, peers, method, "f32", chunk_bytes,
+        kw_fn=lambda n, _kw=kw: dict(_kw),
+        pool=TilePool(),  # fresh pool: the bench measures THIS run's peak
+    )
+    sinks = [
+        agg.make_sink(peers[p], float(weights[p]), n_elems * 4)
+        for p in range(n_peers)
+    ]
+    per_peer = [_wire_chunks(bufs[p], chunk_bytes) for p in range(n_peers)]
+    n_chunks = len(per_peer[0])
+    t_start = time.perf_counter()
+    for c in range(n_chunks):  # round-robin arrival across peers
+        for p in range(n_peers):
+            off, data = per_peer[p][c]
+            sinks[p](off, n_elems * 4, data)
+        await asyncio.sleep(0)  # let early tile jobs run, as the loop would
+    for s in sinks:
+        s.close(True)
+    t_arrived = time.perf_counter()
+    agg.freeze()
+    result = await agg.finalize(peers)
+    t_done = time.perf_counter()
+    return {
+        "peak_bytes_held": agg.peak_bytes_held,
+        "commit_s": round(t_done - t_arrived, 6),
+        "wall_s": round(t_done - t_start, 6),
+        "tiles_early": agg.tiles_early,
+        "tiles_deadline": agg.tiles_deadline,
+        "agg_busy_s": round(agg.busy_s, 6),
+        "result": result,
+    }
+
+
+async def run_config(
+    n_peers: int, payload_mb: float, method: str, chunk_bytes: int = CHUNK_BYTES
+) -> dict:
+    n_elems = int(payload_mb * (1 << 20)) // 4
+    weights, bufs = _contributions(n_peers, n_elems)
+    kw = {"trim": max(1, n_peers // 4)} if method == "trimmed_mean" else {}
+    mat = bench_materialize(weights, bufs, method, kw, chunk_bytes)
+    stream = await bench_streaming(weights, bufs, method, kw, chunk_bytes)
+    # Equivalence is part of the bench contract: a fast wrong answer banks
+    # nothing.
+    np.testing.assert_allclose(
+        stream.pop("result"), mat.pop("result"), rtol=2e-5, atol=1e-6
+    )
+    return {
+        "n_peers": n_peers,
+        "payload_mb": payload_mb,
+        "method": method,
+        "materialize": mat,
+        "streaming": stream,
+        "ratios": {
+            "peak_bytes_held": round(
+                mat["peak_bytes_held"] / max(stream["peak_bytes_held"], 1), 2
+            ),
+            "commit_latency": round(
+                mat["commit_s"] / max(stream["commit_s"], 1e-9), 2
+            ),
+        },
+    }
+
+
+async def run_bench(
+    peers=(8, 16), payloads_mb=(8, 64), methods=("mean", "trimmed_mean"),
+    chunk_bytes: int = CHUNK_BYTES,
+) -> dict:
+    rows = []
+    for method in methods:
+        for n_peers in peers:
+            for mb in payloads_mb:
+                row = await run_config(n_peers, mb, method, chunk_bytes)
+                rows.append(row)
+                print(
+                    f"{method:12s} n={n_peers:2d} {mb:3g}MB  "
+                    f"peak {row['materialize']['peak_bytes_held'] >> 20}MB -> "
+                    f"{row['streaming']['peak_bytes_held'] >> 20}MB "
+                    f"({row['ratios']['peak_bytes_held']}x)  "
+                    f"commit {row['materialize']['commit_s'] * 1e3:.1f}ms -> "
+                    f"{row['streaming']['commit_s'] * 1e3:.1f}ms "
+                    f"({row['ratios']['commit_latency']}x)",
+                    flush=True,
+                )
+    return {
+        "bench": "leader_aggregation_streaming_vs_materialize",
+        "host": platform.node(),
+        "python": platform.python_version(),
+        "unix_time": round(time.time(), 1),
+        "chunk_bytes": chunk_bytes,
+        "native_available": native.available(),
+        "rows": rows,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true", help="small sanity run")
+    ap.add_argument("--out", default=os.path.join(RESULTS, "aggregation_bench.json"))
+    args = ap.parse_args()
+    kw = {}
+    if args.quick:
+        kw = dict(peers=(4,), payloads_mb=(2,), chunk_bytes=1 << 18)
+    result = asyncio.run(run_bench(**kw))
+    os.makedirs(RESULTS, exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2)
+        fh.write("\n")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

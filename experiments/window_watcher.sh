@@ -25,8 +25,10 @@
 #   8. gpt2_medium          — second model scale on chip (#5)
 #   9. step_scan_probe.py   — dispatch-vs-compute attribution
 #  10. chip_trace.py        — one jax.profiler trace (#1e)
-#  11. chip_overlap.sh      — hardware overlap criterion, tag-resumable
-#                             (three-round-old r2 directive, #2)
+#  11. chip_overlap.sh      — hardware overlap criterion, tag-resumable.
+#                             PROMOTED: runs right after the probe (and
+#                             retried here) per VERDICT r5 §92 — four
+#                             rounds old, a short window must not starve it
 cd "$(dirname "$0")/.." || exit 1
 R=experiments/results
 LOG=$R/window_watcher.log
@@ -67,6 +69,13 @@ while [ "$LOOPS" -lt 80 ]; do
             timeout 900 python experiments/chip_probe.py >>"$LOG" 2>&1
             echo "$(date +%T) probe rc=$?" >>"$LOG"
         fi
+        # Overlap criterion PROMOTED above the bench arms (VERDICT r5 §92:
+        # four rounds old, last in the agenda meant every short window
+        # sacrificed it — it now runs second, right after the probe).
+        if [ "$(done_tags)" -lt 3 ]; then
+            bash experiments/chip_overlap.sh >>"$LOG" 2>&1
+            echo "$(date +%T) chip_overlap rc=$? tags=$(done_tags)" >>"$LOG"
+        fi
         bench_arm accum4 420 DVC_BENCH_REMAT=0 DVC_BENCH_ACCUM=4 DVC_BENCH_CHILD_DEADLINE=400
         bench_arm ab_flash 300 DVC_BENCH_REMAT=0 DVC_ATTN_IMPL=flash DVC_BENCH_TRY_SPC=0 DVC_BENCH_CHILD_DEADLINE=280
         bench_arm ab_xla 300 DVC_BENCH_REMAT=0 DVC_ATTN_IMPL=xla DVC_BENCH_TRY_SPC=0 DVC_BENCH_CHILD_DEADLINE=280
@@ -93,8 +102,10 @@ while [ "$LOOPS" -lt 80 ]; do
             echo "$(date +%T) chip_trace rc=$?" >>"$LOG"
         fi
         if [ "$(done_tags)" -lt 3 ]; then
+            # Second chance within the same window if the promoted early
+            # run above was cut short.
             bash experiments/chip_overlap.sh >>"$LOG" 2>&1
-            echo "$(date +%T) chip_overlap rc=$? tags=$(done_tags)" >>"$LOG"
+            echo "$(date +%T) chip_overlap retry rc=$? tags=$(done_tags)" >>"$LOG"
         fi
         if [ "$(done_tags)" -ge 3 ] && fresh "$R/bench_accum4.json" \
             && fresh "$R/bench_ab_flash.json" && fresh "$R/bench_ab_xla.json" \

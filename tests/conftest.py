@@ -39,6 +39,12 @@ def pytest_configure(config):
         "smoke) — in the default lane, and selectable on their own with "
         "-m transport",
     )
+    config.addinivalue_line(
+        "markers",
+        "aggregation: streaming leader-aggregation tests (tile pipeline, "
+        "request sinks, streaming<->dense equivalence, bench smoke) — in "
+        "the default lane, and selectable on their own with -m aggregation",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,803 @@
+"""Streaming leader aggregation: tile pipeline, request sinks, equivalence.
+
+Covers the ISSUE-4 rework end to end:
+- property test: the tiled/streaming path matches the dense path for EVERY
+  robust method, across peer counts, interleaved arrival orders, and
+  deadline-committed subsets;
+- transport request-sink plumbing (register_request_sink): chunked request
+  payloads stream to a sink with an exactly-once close(ok) lifecycle, and
+  chunk corruption (via ChaosTransport's deterministic placement) aborts
+  the sink without dropping the connection;
+- a deterministic sync-leader round over real TCP where members' pushes
+  stream tile-by-tile into the armed aggregator (mean, trimmed_mean, bf16),
+  including a corrupted member whose absence leaves an exact subset result;
+- the eager buffer release on skipped rounds;
+- a small-shape smoke of experiments/aggregation_bench.py that fails loudly
+  if streaming peak-held bytes or commit latency regresses.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.ops import robust
+from distributedvolunteercomputing_tpu.swarm.agg_stream import (
+    StreamingAggregator,
+    TilePool,
+)
+from distributedvolunteercomputing_tpu.swarm.averager import STREAMED, SyncAverager
+from distributedvolunteercomputing_tpu.swarm.chaos import ChaosTransport
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.matchmaking import Group
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+
+pytestmark = pytest.mark.aggregation
+
+METHODS = [
+    "mean",
+    "trimmed_mean",
+    "median",
+    "krum",
+    "bulyan",
+    "geometric_median",
+    "centered_clip",
+]
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def _feed_streamed(agg, peer, w, buf, chunk_bytes, order=None):
+    """Deliver ``buf`` through a ContributionSink exactly as the transport
+    frames it: in-order chunk_bytes-sized pieces."""
+    data = np.ascontiguousarray(buf, np.float32).tobytes()
+    sink = agg.make_sink(peer, w, len(data))
+    assert sink is not None
+    for off in range(0, len(data), chunk_bytes):
+        sink(off, len(data), data[off : off + chunk_bytes])
+    sink.close(True)
+
+
+class TestTilePool:
+    def test_reuse_and_cap(self):
+        pool = TilePool(max_bytes=4096)
+        a = pool.get(256)
+        pool.put(a)
+        assert pool.get(256) is a  # warm buffer comes back
+        big = np.empty(4096, np.float32)
+        pool.put(big)  # 16 KB > cap: dropped
+        assert pool.held_bytes <= 4096
+
+    def test_rejects_wrong_dtype(self):
+        pool = TilePool()
+        pool.put(np.empty(8, np.int64))
+        assert pool.held_bytes == 0
+
+
+class TestStreamingEquivalence:
+    """The tiled path must match the dense path for every method."""
+
+    @pytest.mark.parametrize("method", METHODS)
+    @pytest.mark.parametrize("n_peers", [3, 5, 8])
+    def test_full_arrival(self, method, n_peers):
+        rng = np.random.default_rng(n_peers)
+        n_elems = 230  # 4 tiles of 64, last partial
+        cb = 64 * 4
+        peers = [f"p{i}" for i in range(n_peers)]
+        weights = rng.uniform(0.5, 2.0, n_peers)
+        bufs = rng.standard_normal((n_peers, n_elems)).astype(np.float32)
+        kw = {"trim": 1} if method == "trimmed_mean" else {}
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, peers, method, "f32", cb,
+                kw_fn=lambda n: dict(kw), pool=TilePool(),
+            )
+            # Leader-style dense feed for peer 0, streamed for the rest, in
+            # a shuffled per-peer order (arrival order must not matter).
+            agg.add_dense(peers[0], float(weights[0]), bufs[0])
+            for i in rng.permutation(np.arange(1, n_peers)):
+                _feed_streamed(agg, peers[i], float(weights[i]), bufs[i], cb)
+            return await agg.finalize(peers)
+
+        got = run(main())
+        if method == "mean":
+            expect = (bufs * weights[:, None]).sum(0) / weights.sum()
+        else:
+            expect = robust.aggregate(bufs.copy(), method, **kw)
+        np.testing.assert_allclose(got, expect.astype(np.float32), rtol=2e-6, atol=1e-7)
+
+    @pytest.mark.parametrize("method", METHODS)
+    def test_deadline_subset(self, method):
+        """Peers that never arrive: the committed result equals the dense
+        aggregate over exactly the arrived subset."""
+        rng = np.random.default_rng(7)
+        n_peers, n_elems, cb = 6, 230, 64 * 4
+        peers = [f"p{i}" for i in range(n_peers)]
+        weights = rng.uniform(0.5, 2.0, n_peers)
+        bufs = rng.standard_normal((n_peers, n_elems)).astype(np.float32)
+        arrived = [0, 2, 3, 5]  # 1 and 4 miss the deadline entirely
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, peers, method, "f32", cb,
+                kw_fn=lambda n: {"trim": 1} if method == "trimmed_mean" else {},
+                pool=TilePool(),
+            )
+            agg.add_dense(peers[0], float(weights[0]), bufs[0])
+            for i in arrived[1:]:
+                _feed_streamed(agg, peers[i], float(weights[i]), bufs[i], cb)
+            agg.freeze()
+            got = await agg.finalize([peers[i] for i in arrived])
+            return got, agg
+
+        got, agg = run(main())
+        sub_w, sub = weights[arrived], bufs[arrived]
+        if method == "mean":
+            expect = (sub * sub_w[:, None]).sum(0) / sub_w.sum()
+        else:
+            kw = {"trim": 1} if method == "trimmed_mean" else {}
+            expect = robust.aggregate(sub.copy(), method, **kw)
+        np.testing.assert_allclose(got, expect.astype(np.float32), rtol=2e-6, atol=1e-7)
+        assert agg.included_peers() == [peers[i] for i in arrived]
+        if agg.mode == "window":
+            # Absent peers held every window open until the deadline.
+            assert agg.tiles_deadline == 4 and agg.tiles_early == 0
+
+    def test_early_tiles_fire_during_arrival(self):
+        """Window tiles aggregate the moment the LAST peer's copy lands —
+        before finalize is ever called."""
+        n_peers, n_elems, cb = 4, 256, 64 * 4
+        peers = [f"p{i}" for i in range(n_peers)]
+        bufs = np.random.default_rng(1).standard_normal((n_peers, n_elems)).astype(np.float32)
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, peers, "median", "f32", cb,
+                kw_fn=lambda n: {}, pool=TilePool(),
+            )
+            for i in range(n_peers):
+                _feed_streamed(agg, peers[i], 1.0, bufs[i], cb)
+            # Let the spawned tile jobs run before finalize.
+            await asyncio.sleep(0.05)
+            early = agg.tiles_early
+            out = await agg.finalize(peers)
+            return early, agg, out
+
+        early, agg, out = run(main())
+        assert early + agg.tiles_deadline == 4
+        assert agg.tiles_early >= 1  # at least the early-fired ones
+        np.testing.assert_allclose(out, np.median(bufs, axis=0), rtol=1e-6)
+
+    def test_abort_before_commit_is_clean_retry(self):
+        """A stream that dies before any tile commits withdraws fully; the
+        retry succeeds and the result is exact."""
+        n_elems, cb = 256, 64 * 4
+        peers = ["a", "b", "c"]
+        bufs = np.random.default_rng(2).standard_normal((3, n_elems)).astype(np.float32)
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, peers, "median", "f32", cb,
+                kw_fn=lambda n: {}, pool=TilePool(),
+            )
+            _feed_streamed(agg, "a", 1.0, bufs[0], cb)
+            # b's first attempt aborts after one chunk (tile NOT yet
+            # aggregated: a+c rows still missing) -> clean withdrawal.
+            data = bufs[1].tobytes()
+            sink = agg.make_sink("b", 1.0, len(data))
+            sink(0, len(data), data[:cb])
+            sink.close(False)
+            assert not agg.taints("b")
+            _feed_streamed(agg, "b", 1.0, bufs[1], cb)  # retry
+            _feed_streamed(agg, "c", 1.0, bufs[2], cb)
+            return await agg.finalize(peers)
+
+        got = run(main())
+        np.testing.assert_allclose(got, np.median(bufs, axis=0), rtol=1e-6)
+
+    def test_abort_after_commit_taints_mean_slot(self):
+        """Mean folds eagerly, so an abort after sealed tiles taints the
+        slot (no coherent retry) and its mass stays per-tile."""
+        n_elems, cb = 256, 64 * 4
+        peers = ["a", "b"]
+        bufs = np.ones((2, n_elems), np.float32)
+        bufs[1] *= 3.0
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, peers, "mean", "f32", cb,
+                kw_fn=lambda n: {}, pool=TilePool(),
+            )
+            agg.add_dense("a", 1.0, bufs[0])
+            data = bufs[1].tobytes()
+            sink = agg.make_sink("b", 1.0, len(data))
+            sink(0, len(data), data[:cb])  # tile 0 folds immediately
+            sink.close(False)
+            assert agg.taints("b")
+            assert agg.make_sink("b", 1.0, len(data)) is None  # no retry
+            agg.freeze()
+            return await agg.finalize(["a"])
+
+        got = run(main())
+        # Tile 0: (1 + 3) / 2 = 2; tiles 1..3: a alone = 1.
+        np.testing.assert_allclose(got[:64], 2.0)
+        np.testing.assert_allclose(got[64:], 1.0)
+
+    def test_fired_tile_cannot_be_resurrected_by_retry(self):
+        """An abort that fires a tile early marks it done AND committed
+        atomically: the aborting slot is tainted (no retry can reopen the
+        tile and overwrite the full-peer aggregate)."""
+        n_elems, cb = 256, 64 * 4
+        peers = ["a", "b", "c"]
+        bufs = np.random.default_rng(5).standard_normal((3, n_elems)).astype(np.float32)
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, peers, "median", "f32", cb,
+                kw_fn=lambda n: {}, pool=TilePool(),
+            )
+            _feed_streamed(agg, "a", 1.0, bufs[0], cb)
+            _feed_streamed(agg, "c", 1.0, bufs[2], cb)
+            # b delivers tile 0 then dies: its abort drops active to 2,
+            # which FIRES tiles 0..3 over {a, c} — b's tile-0 row included.
+            data = bufs[1].tobytes()
+            sink = agg.make_sink("b", 1.0, len(data))
+            sink(0, len(data), data[:cb])
+            sink.close(False)
+            assert agg.taints("b")  # tile 0 fired with b's row committed
+            assert agg.make_sink("b", 1.0, len(data)) is None
+            return await agg.finalize(["a", "c"])
+
+        got = run(main())
+        # Tile 0 aggregated over all three rows; later tiles over {a, c}.
+        np.testing.assert_allclose(got[:64], np.median(bufs[:, :64], axis=0), rtol=1e-6)
+        np.testing.assert_allclose(
+            got[64:], np.median(bufs[[0, 2], 64:], axis=0), rtol=1e-6
+        )
+
+    def test_tile_job_failure_fails_finalize(self):
+        """A tile aggregation job that raises must fail the round, never
+        commit a silently-zeroed tile."""
+        n_elems, cb = 256, 64 * 4
+        bufs = np.ones((2, n_elems), np.float32)
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, ["a", "b"], "trimmed_mean", "f32", cb,
+                # trim=1 with 2 rows: robust.trimmed_mean raises ValueError.
+                kw_fn=lambda n: {"trim": 1}, pool=TilePool(),
+            )
+            for i, p in enumerate(("a", "b")):
+                _feed_streamed(agg, p, 1.0, bufs[i], cb)
+            with pytest.raises((RuntimeError, ValueError)):
+                await agg.finalize(["a", "b"])
+
+        run(main())
+
+    def test_freeze_adopts_fully_delivered_unclosed_stream(self):
+        """Every chunk folded but close() hasn't run when the deadline
+        freezes the round: the mass is in the aggregate, so the peer must
+        be reported included, not absent."""
+        n_elems, cb = 256, 64 * 4
+        agg = StreamingAggregator(
+            n_elems, ["a", "b"], "mean", "f32", cb,
+            kw_fn=lambda n: {}, pool=TilePool(),
+        )
+        agg.add_dense("a", 1.0, np.ones(n_elems, np.float32))
+        data = np.full(n_elems, 3.0, np.float32).tobytes()
+        sink = agg.make_sink("b", 1.0, len(data))
+        for off in range(0, len(data), cb):
+            sink(off, len(data), data[off : off + cb])
+        # No close(True) yet — the commit interleaved before the trailer.
+        agg.freeze()
+        assert agg.included_peers() == ["a", "b"]
+        assert agg.weight_of("b") == 1.0
+
+    def test_successful_round_returns_rows_to_pool(self):
+        """d2_dense rounds must hand their dense rows back to the pool at
+        finalize, not hold them until the round sweep."""
+        n_elems, cb = 256, 64 * 4
+        pool = TilePool()
+        bufs = np.random.default_rng(6).standard_normal((4, n_elems)).astype(np.float32)
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, [f"p{i}" for i in range(4)], "krum", "f32", cb,
+                kw_fn=lambda n: {}, pool=pool,
+            )
+            for i in range(4):
+                _feed_streamed(agg, f"p{i}", 1.0, bufs[i], cb)
+            await agg.finalize([f"p{i}" for i in range(4)])
+
+        run(main())
+        assert pool.held_bytes == 4 * n_elems * 4  # all four rows returned
+
+    def test_precomputed_d2_matches(self):
+        """krum/bulyan selection from tile-accumulated d² == from scratch."""
+        rng = np.random.default_rng(3)
+        stack = rng.standard_normal((8, 100)).astype(np.float32)
+        d2 = robust.pairwise_sq_dists(stack)
+        for method in ("krum", "bulyan"):
+            a = robust.aggregate(stack.copy(), method)
+            b = robust.aggregate(stack.copy(), method, d2=d2.copy())
+            np.testing.assert_allclose(a, b)
+
+
+class TestRequestSink:
+    """Transport-level: register_request_sink streams chunked REQUEST
+    payloads with an exactly-once close(ok) lifecycle."""
+
+    def _factory(self, record):
+        def factory(args, total):
+            state = {"chunks": [], "closed": None, "args": args, "total": total}
+            record.append(state)
+
+            def sink(off, tot, data):
+                state["chunks"].append((off, len(data)))
+
+            def close(ok):
+                assert state["closed"] is None, "close must run exactly once"
+                state["closed"] = ok
+
+            sink.close = close
+            return sink
+
+        return factory
+
+    def test_streamed_request_reaches_sink_and_handler(self):
+        async def main():
+            record = []
+            server = Transport(chunk_bytes=4096)
+            seen = {}
+
+            async def handler(args, payload):
+                seen["payload_len"] = len(payload)
+                return {"ok": True}, b""
+
+            server.register("blob.put", handler)
+            server.register_request_sink("blob.put", self._factory(record))
+            await server.start()
+            client = Transport(chunk_bytes=4096)
+            try:
+                payload = bytes(range(256)) * 64  # 16 KiB -> 4 chunks
+                await client.call(server.addr, "blob.put", {"k": 1}, payload)
+                return record, seen
+            finally:
+                await client.close()
+                await server.close()
+
+        record, seen = run(main())
+        assert len(record) == 1
+        st = record[0]
+        assert st["closed"] is True
+        assert st["total"] == 16384 and st["args"] == {"k": 1}
+        assert [o for o, _ in st["chunks"]] == [0, 4096, 8192, 12288]
+        assert seen["payload_len"] == 0  # the sink consumed it
+
+    def test_inline_payload_never_streams(self):
+        async def main():
+            record = []
+            server = Transport(chunk_bytes=4096)
+
+            async def handler(args, payload):
+                return {"n": len(payload)}, b""
+
+            server.register("blob.put", handler)
+            server.register_request_sink("blob.put", self._factory(record))
+            await server.start()
+            client = Transport(chunk_bytes=4096)
+            try:
+                ret, _ = await client.call(server.addr, "blob.put", {}, b"x" * 100)
+                return record, ret
+            finally:
+                await client.close()
+                await server.close()
+
+        record, ret = run(main())
+        assert record == [] and ret["n"] == 100
+
+    def test_factory_decline_falls_back_to_buffering(self):
+        async def main():
+            server = Transport(chunk_bytes=4096)
+            got = {}
+
+            async def handler(args, payload):
+                got["n"] = len(payload)
+                return {"ok": True}, b""
+
+            server.register("blob.put", handler)
+            server.register_request_sink("blob.put", lambda args, total: None)
+            await server.start()
+            client = Transport(chunk_bytes=4096)
+            try:
+                await client.call(server.addr, "blob.put", {}, b"y" * 9000)
+                return got
+            finally:
+                await client.close()
+                await server.close()
+
+        assert run(main())["n"] == 9000
+
+    def test_corrupt_chunk_aborts_sink_but_not_connection(self):
+        """ChaosTransport corrupts the middle of the payload: chunks before
+        the corruption reach the sink, close(False) fires, the call fails
+        attributably, and the SAME connection serves the next call."""
+
+        async def main():
+            record = []
+            server = Transport(chunk_bytes=4096)
+
+            async def handler(args, payload):
+                return {"ok": True}, b""
+
+            server.register("blob.put", handler)
+            server.register_request_sink("blob.put", self._factory(record))
+            await server.start()
+            client = ChaosTransport(
+                chunk_bytes=4096, corrupt_rate=1.0, corrupt_at_frac=0.6
+            )
+            try:
+                with pytest.raises(RPCError):
+                    await client.call(server.addr, "blob.put", {}, b"z" * 16384)
+                client.corrupt_rate = 0.0
+                await client.call(server.addr, "blob.put", {}, b"z" * 16384)
+                return record, client.connects
+            finally:
+                await client.close()
+                await server.close()
+
+        record, connects = run(main())
+        assert connects == 1  # pooled conn survived the corrupt frame
+        aborted = record[0]
+        assert aborted["closed"] is False
+        # Corruption at 0.6 * 16384 ~ chunk 2: chunks 0 and 1 were delivered.
+        assert [o for o, _ in aborted["chunks"]] == [0, 4096]
+        assert record[1]["closed"] is True
+
+    def test_reordered_and_dup_chunks_abort_sink_not_conn(self):
+        """Duplicated/reordered chunk indices through the request sink:
+        chunks before the bad index were delivered, close(False) fires, the
+        rejection is attributable, and the SAME raw connection then streams
+        a clean request fully."""
+        import json as _json
+        import zlib as _zlib
+
+        from distributedvolunteercomputing_tpu.swarm.transport import (
+            _CHUNK, _HEADER, MAGIC, TYPE_ERR, TYPE_RESP, TYPE_REQ, VERSION,
+        )
+
+        def frames(rid, payload, chunk, mutate=None):
+            pieces = [payload[i : i + chunk] for i in range(0, len(payload), chunk)]
+            meta = {
+                "rid": rid, "method": "blob.put", "args": {},
+                "chunks": len(pieces),
+            }
+            meta_b = _json.dumps(meta).encode()
+            out = [
+                _HEADER.pack(MAGIC, VERSION, TYPE_REQ, len(meta_b), len(payload), 0),
+                meta_b,
+            ]
+            for i, data in enumerate(pieces):
+                idx, crc = i, _zlib.crc32(data) & 0xFFFFFFFF
+                if mutate is not None:
+                    idx, data, crc = mutate(i, idx, data, crc)
+                out.append(_CHUNK.pack(idx, len(data), crc))
+                out.append(bytes(data))
+            return b"".join(out)
+
+        def dup(i, idx, data, crc):
+            return (1 if i == 2 else idx), data, crc
+
+        def reorder(i, idx, data, crc):
+            return ({1: 2, 2: 1}.get(i, idx)), data, crc
+
+        async def main():
+            record = []
+            server = Transport(chunk_bytes=4096)
+
+            async def handler(args, payload):
+                return {"ok": True}, b""
+
+            server.register("blob.put", handler)
+            server.register_request_sink("blob.put", self._factory(record))
+            addr = await server.start()
+            probe = Transport()  # parses response frames for us
+            payload = bytes(range(256)) * 64  # 16 KiB -> 4 chunks
+            try:
+                for name, mutate, delivered in (
+                    ("dup", dup, [0, 4096]),
+                    ("reorder", reorder, [0]),
+                ):
+                    reader, writer = await asyncio.open_connection(*addr)
+                    try:
+                        writer.write(frames(f"rid-{name}", payload, 4096, mutate))
+                        await writer.drain()
+                        ftype, meta, _ = await asyncio.wait_for(
+                            probe._read_frame(reader), timeout=5
+                        )
+                        assert ftype == TYPE_ERR
+                        assert "duplicated/reordered" in meta.get("error", "")
+                        st = record.pop(0)
+                        assert st["closed"] is False
+                        assert [o for o, _ in st["chunks"]] == delivered, (name, st)
+                        # Same connection, clean retry: streams end to end.
+                        writer.write(frames(f"rid-{name}-ok", payload, 4096))
+                        await writer.drain()
+                        ftype, meta, _ = await asyncio.wait_for(
+                            probe._read_frame(reader), timeout=5
+                        )
+                        assert ftype == TYPE_RESP
+                        st = record.pop(0)
+                        assert st["closed"] is True and len(st["chunks"]) == 4
+                    finally:
+                        writer.close()
+            finally:
+                await server.close()
+
+        run(main())
+
+    def test_aggregator_refuses_offset_gaps(self):
+        """Defense in depth below the transport: a sink fed a non-monotonic
+        offset (which verified framing never produces) aborts the slot
+        instead of folding bytes at the wrong coordinates."""
+        n_elems, cb = 256, 64 * 4
+        agg = StreamingAggregator(
+            n_elems, ["a", "b"], "mean", "f32", cb,
+            kw_fn=lambda n: {}, pool=TilePool(),
+        )
+        data = np.ones(n_elems, np.float32).tobytes()
+        sink = agg.make_sink("a", 1.0, len(data))
+        sink(0, len(data), data[:cb])
+        sink(2 * cb, len(data), data[2 * cb : 3 * cb])  # skipped chunk 1
+        assert agg.seal_slot(agg.slot_index["a"]) is False
+
+    def test_streamed_request_with_auth(self):
+        """Header MAC gates the factory; the payload MAC trailer closes the
+        sink ok=True only after it verifies."""
+
+        async def main():
+            record = []
+            secret = b"agg-stream-secret"
+            server = Transport(chunk_bytes=4096, secret=secret)
+
+            async def handler(args, payload):
+                return {"ok": True}, b""
+
+            server.register("blob.put", handler)
+            server.register_request_sink("blob.put", self._factory(record))
+            await server.start()
+            client = Transport(chunk_bytes=4096, secret=secret)
+            try:
+                await client.call(server.addr, "blob.put", {}, b"s" * 10000)
+                return record
+            finally:
+                await client.close()
+                await server.close()
+
+        record = run(main())
+        assert record[0]["closed"] is True
+        assert sum(n for _, n in record[0]["chunks"]) == 10000
+
+
+def _make_node(peer_id, *, chaos=None, **avg_kw):
+    """One in-process node (transport + dht + membership + SyncAverager)
+    WITHOUT joining matchmaking — the deterministic round tests drive
+    _lead_round / sync.contribute directly."""
+    t = chaos if chaos is not None else Transport(chunk_bytes=4096)
+    dht = DHTNode(t)
+    mem = SwarmMembership(dht, peer_id, ttl=10.0)
+    avg = SyncAverager(t, dht, mem, **avg_kw)
+    return t, avg
+
+
+class TestSyncStreamingRound:
+    """Deterministic leader rounds over real TCP: the leader arms first,
+    then members push chunked payloads that stream into the aggregator."""
+
+    N = 5000  # 20 000 B payload -> 5 chunks at chunk_bytes=4096
+
+    def _tree(self, value):
+        return {"w": np.full((self.N,), np.float32(value))}
+
+    async def _run_round(
+        self, method="mean", wire="f32", member_values=(1.0, 2.0),
+        member_chaos=(None, None), budget=2.5, min_group=2,
+        member_delay=0.15,
+    ):
+        leader_t, leader = _make_node(
+            "leader", method=method, wire=wire, min_group=min_group,
+            gather_timeout=6.0,
+        )
+        await leader_t.start()
+        members = []
+        for i, chaos in enumerate(member_chaos):
+            t, avg = _make_node(f"m{i}", chaos=chaos, method=method, wire=wire)
+            await t.start()
+            members.append((t, avg))
+        try:
+            tree = self._tree(0.0)
+            buf = leader._pack(tree)
+            # Like the matchmaker's begin: the token table covers EVERY
+            # member, the leader's own included.
+            tokens = {"leader": "ltok"}
+            tokens.update({f"m{i}": f"tok{i}" for i in range(len(members))})
+            group = Group(
+                epoch="round-1",
+                members=[("leader", leader_t.addr)]
+                + [(f"m{i}", members[i][0].addr) for i in range(len(members))],
+                my_index=0,
+                token="ltok",
+                member_tokens=tokens,
+                deadline=time.time() + budget,
+                budget=budget,
+            )
+            lead_task = asyncio.create_task(leader._lead_round(group, buf, 1.0))
+            await asyncio.sleep(member_delay)  # leader is armed by now
+
+            async def push(i):
+                t, avg = members[i]
+                mbuf = avg._pack(self._tree(member_values[i]))
+                payload = avg._wire_stream(mbuf)
+                await t.call(
+                    leader_t.addr, "sync.contribute",
+                    {
+                        "epoch": "round-1", "peer": f"m{i}",
+                        "weight": 1.0, "schema": leader._schema,
+                        "token": f"tok{i}",
+                    },
+                    payload, timeout=5.0,
+                )
+
+            pushes = await asyncio.gather(
+                *(push(i) for i in range(len(members))), return_exceptions=True
+            )
+            result = await asyncio.wait_for(lead_task, timeout=budget + 30)
+            return leader, result, pushes
+        finally:
+            await leader_t.close()
+            for t, _ in members:
+                await t.close()
+
+    def test_mean_streams_members(self):
+        leader, result, pushes = run(self._run_round(method="mean"))
+        assert all(not isinstance(p, Exception) for p in pushes)
+        np.testing.assert_allclose(result["w"], 1.0, rtol=1e-6)  # (0+1+2)/3
+        g = leader._agg_gauges
+        assert g["streamed_contribs"] == 2 and g["dense_contribs"] == 1
+        assert g["tiles_early"] == 10  # 2 streamed members x 5 chunks
+        assert g["peak_bytes_held"] == self.N * 4  # O(D): accumulator only
+        assert leader.stats()["aggregation"]["streamed_contribs"] == 2
+
+    def test_trimmed_mean_streams_members(self):
+        leader, result, pushes = run(
+            self._run_round(method="trimmed_mean", member_values=(1.0, 50.0))
+        )
+        assert all(not isinstance(p, Exception) for p in pushes)
+        # n=3 derived trim=1: median of (0, 1, 50) = 1.
+        np.testing.assert_allclose(result["w"], 1.0, rtol=1e-6)
+        g = leader._agg_gauges
+        assert g["mode"] == "window" and g["streamed_contribs"] == 2
+        assert g["tiles_early"] + g["tiles_deadline"] == 5
+        # Structural bound: result buffer + in-flight [n_slots, tile]
+        # windows (the leader's dense contribution rides as a borrowed
+        # reference, never a per-window materialization). The memory RATIO
+        # claim is carried by the deterministic bench smoke below.
+        window_bytes = 3 * 1024 * 4
+        assert g["peak_bytes_held"] <= self.N * 4 + 5 * window_bytes
+
+    def test_bf16_wire_streams(self):
+        leader, result, pushes = run(self._run_round(method="mean", wire="bf16"))
+        assert all(not isinstance(p, Exception) for p in pushes)
+        np.testing.assert_allclose(result["w"], 1.0, rtol=1e-2)
+        assert leader._agg_gauges["streamed_contribs"] == 2
+
+    def test_corrupt_first_chunk_excludes_member_exactly(self):
+        """Corruption at the FIRST chunk: zero tiles sealed, the member is
+        cleanly absent, and the committed mean is EXACTLY the remaining
+        subset's — the per-tile blend only appears for mid-stream deaths."""
+        chaos = ChaosTransport(
+            chunk_bytes=4096, corrupt_rate=1.0, corrupt_at_frac=0.0
+        )
+        leader, result, pushes = run(
+            self._run_round(member_chaos=(None, chaos), budget=2.0)
+        )
+        assert isinstance(pushes[1], Exception)  # the corrupt push failed
+        np.testing.assert_allclose(result["w"], 0.5, rtol=1e-6)  # (0+1)/2
+        g = leader._agg_gauges
+        assert g["aborted_contribs"] == 1 and g["streamed_contribs"] == 1
+
+    def test_corrupt_late_chunk_blends_per_tile(self):
+        """Mid-stream death: sealed tiles keep the dying member's mass
+        (per-tile participation), later tiles exclude it — every coordinate
+        is still a convex combination of honest inputs."""
+        chaos = ChaosTransport(
+            chunk_bytes=4096, corrupt_rate=1.0, corrupt_at_frac=0.9
+        )
+        leader, result, pushes = run(
+            self._run_round(member_values=(1.0, 4.0), member_chaos=(None, chaos),
+                            budget=2.0)
+        )
+        assert isinstance(pushes[1], Exception)
+        w = result["w"]
+        # Chunk 4 (elements 4096..4999) carries the corruption: the first 4
+        # tiles sealed -> (0 + 1 + 4)/3; the last tile excludes m1 -> (0+1)/2.
+        np.testing.assert_allclose(w[:4096], 5.0 / 3.0, rtol=1e-6)
+        np.testing.assert_allclose(w[4096:], 0.5, rtol=1e-6)
+        assert leader._agg_gauges["aborted_contribs"] == 1
+
+    def test_skipped_round_releases_buffers_eagerly(self):
+        """min_group unmet at the deadline: contribution buffers are freed
+        at the skip, not at the 5 s sweep."""
+
+        async def main():
+            leader_t, leader = _make_node(
+                "leader", method="mean", min_group=3, gather_timeout=4.0
+            )
+            await leader_t.start()
+            try:
+                buf = leader._pack(self._tree(0.0))
+                group = Group(
+                    epoch="round-skip",
+                    members=[("leader", leader_t.addr), ("ghost", ("127.0.0.1", 1))],
+                    my_index=0,
+                    token="ltok",
+                    member_tokens={"ghost": "gtok"},
+                    deadline=time.time() + 0.8,
+                    budget=0.8,
+                )
+                result = await leader._lead_round(group, buf, 1.0)
+                st = leader._rounds.get("round-skip")
+                return result, st
+            finally:
+                await leader_t.close()
+
+        result, st = run(main())
+        assert result is None
+        assert st is not None and st.result_ready.is_set()
+        assert st.contribs == {} and st.payloads == {}  # eager release
+
+    def test_streamed_sentinel_repr(self):
+        assert repr(STREAMED) == "<streamed>"
+
+
+class TestAggregationBenchSmoke:
+    """Small-shape regression guard over the bench harness: streaming must
+    hold at most half the materialize arm's peak bytes and commit no
+    slower. Runs in ~a second; the full grid lives in
+    experiments/results/aggregation_bench.json."""
+
+    def test_streaming_beats_materialize(self):
+        from experiments.aggregation_bench import run_config
+
+        async def main():
+            # Best-of-2 on the latency comparison: single-core CI boxes jitter.
+            rows = [
+                await run_config(4, 1.0, "trimmed_mean", chunk_bytes=1 << 16)
+                for _ in range(2)
+            ]
+            return rows
+
+        rows = run(main(), timeout=120)
+        peak_ratio = max(r["ratios"]["peak_bytes_held"] for r in rows)
+        commit_ratio = max(r["ratios"]["commit_latency"] for r in rows)
+        assert peak_ratio >= 2.0, (
+            f"streaming peak-held bytes regressed: only {peak_ratio}x below "
+            f"materialize (need >= 2x) — {rows[-1]}"
+        )
+        assert commit_ratio >= 1.0, (
+            f"streaming commit latency regressed: {commit_ratio}x vs "
+            f"materialize (need >= 1x) — {rows[-1]}"
+        )
+
+    def test_mean_peak_is_o_d(self):
+        from experiments.aggregation_bench import run_config
+
+        row = run(run_config(6, 0.5, "mean", chunk_bytes=1 << 16), timeout=120)
+        # Mean holds the O(D) accumulator only: peak == payload bytes.
+        assert row["streaming"]["peak_bytes_held"] == int(0.5 * (1 << 20))
+        assert row["ratios"]["peak_bytes_held"] >= 2.0
