@@ -312,17 +312,24 @@ class StreamingAggregator:
     def add_chunk(self, slot: int, weight: float, off: int, data: bytes) -> None:
         """Fold one verified wire chunk (``off`` in wire-byte space, always
         chunk-aligned by the transport's framing) for ``slot``."""
-        if off % self.chunk_bytes or len(data) % self.esz:
-            # Framing the transport never produces: poison this slot rather
-            # than fold misaligned bytes.
+        total = self.n_elems * self.esz
+        if (
+            not data
+            or off % self.chunk_bytes
+            or len(data) != min(self.chunk_bytes, total - off)
+        ):
+            # Exact-length contract: a sender whose chunk_bytes differs from
+            # this aggregator's (version skew, custom embedding) would
+            # otherwise fold data across tile boundaries while crediting
+            # weight to one tile — silent corruption. Chunk size is a
+            # per-Transport constant, never negotiated on the wire, so the
+            # only safe response to a mismatch is to poison the slot BEFORE
+            # anything folds.
             self.abort_slot(slot)
             return
         tile = off // self.chunk_bytes
         e0 = tile * self.tile_elems
         n = len(data) // self.esz
-        if tile >= self.n_tiles or e0 + n > self.n_elems:
-            self.abort_slot(slot)
-            return
         fire: List[tuple] = []
         with self._lock:
             if self.frozen or slot in self._aborted or slot in self._tainted:
@@ -389,8 +396,7 @@ class StreamingAggregator:
                     win.mask[slot] = True
                     win.count += 1
                     if win.count >= self._active_slots():
-                        del self._windows[tile]
-                        fire.append((tile, win))
+                        fire.append(self._fire_locked(tile, win, early=True))
             else:
                 row = self._row_buffer(slot)
                 row[:] = buf
@@ -560,16 +566,22 @@ class StreamingAggregator:
         """Tile-wise pairwise squared-distance accumulation (krum/bulyan):
         d² is a plain sum over coordinates, so each sealed tile adds its
         partial distances against every slot that already sealed the same
-        tile. Caller holds the lock."""
+        tile. Caller holds the lock.
+
+        Streamed chunks run this inline on the event loop — ms-scale per
+        chunk (one tile × already-sealed peers), amortized across arrival,
+        and a deferred job could race abort's row-withdrawal/pool-reuse.
+        The O(n·D) dense feeds land via asyncio.to_thread at the call
+        sites, so the loop never eats a whole contribution's d² at once."""
         peers = self._tile_sealed.setdefault(tile, [])
-        a = self._rows[slot][e0:e1]
+        a64 = self._rows[slot][e0:e1].astype(np.float64)
         for other in peers:
             if other == slot:
                 continue
             b_row = self._rows.get(other)
             if b_row is None:
                 continue
-            d = a.astype(np.float64) - b_row[e0:e1]
+            d = a64 - b_row[e0:e1]
             v = float(np.dot(d, d))
             self._d2[slot, other] += v
             self._d2[other, slot] += v

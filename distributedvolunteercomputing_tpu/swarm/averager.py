@@ -1237,8 +1237,14 @@ class SyncAverager(AveragerBase):
         }
         # The estimator is fixed at ARMING (not commit): streamed tiles
         # aggregate while contributions are still arriving, so the method
-        # must be known before the first chunk lands. Same policy input the
-        # commit-time call consulted — only the moment moved.
+        # must be known before the first chunk lands. Safe to fix early
+        # because the METHOD choice is count-insensitive — _effective_method
+        # picks it from resilience.recommend_method(self.method), which
+        # never sees the peer count — so members dropping between arming
+        # and commit cannot change it. Only the kwargs depend on row count,
+        # and those ARE recomputed per arrived count via kw_fn below. What
+        # did move is the escalation-state read: a resilience state change
+        # mid-round is seen one round later than the commit-time call saw it.
         method, _ = self._effective_method(len(member_ids))
         kw_cache: Dict[int, dict] = {}
 

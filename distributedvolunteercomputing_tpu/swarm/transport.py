@@ -378,19 +378,22 @@ class Transport:
     ) -> None:
         """Stream ``method``'s chunked REQUEST payloads to a per-request sink.
 
-        ``factory(args, payload_len)`` runs after the header frame is parsed
-        (and, with auth on, after its HMAC is verified — the meta the
-        factory sees is authenticated; the payload bytes are covered only by
-        per-chunk CRC until the trailing payload MAC). Returning None falls
-        back to normal buffering — streaming is an optimization the factory
-        may decline per request. The sink is called ``sink(offset, total,
-        data)`` per verified in-order chunk, then ``sink.close(ok)`` exactly
-        once: ok=True after the whole payload verified (including the MAC
-        trailer when auth is on), ok=False on any abort — bad chunk CRC,
-        framing error, connection death — possibly after some chunks were
-        already delivered. Inline (sub-chunk) payloads never stream. The
-        handler registered for ``method`` runs with an empty payload when
-        the sink consumed it."""
+        Only active when the transport has NO shared secret: chunks reach
+        the sink after per-chunk CRC32 only, which is unkeyed, and sinks
+        may consume irreversibly — with auth on the transport buffers the
+        whole payload and verifies the HMAC trailer before the handler
+        sees a byte, so tampered payloads are discarded whole.
+
+        ``factory(args, payload_len)`` runs after the header frame is
+        parsed. Returning None falls back to normal buffering — streaming
+        is an optimization the factory may decline per request. The sink is
+        called ``sink(offset, total, data)`` per verified in-order chunk,
+        then ``sink.close(ok)`` exactly once: ok=True after the whole
+        payload verified, ok=False on any abort — bad chunk CRC, framing
+        error, connection death — possibly after some chunks were already
+        delivered. Inline (sub-chunk) payloads never stream. The handler
+        registered for ``method`` runs with an empty payload when the sink
+        consumed it."""
         self._stream_factories[method] = factory
 
     def _request_sink(self, meta: dict, payload_len: int):
@@ -736,10 +739,17 @@ class Transport:
             # and the replay/dst checks run on bounded work.
             self._verify_auth(ftype, meta, b"")
         sink = sink_lookup(rid) if sink_lookup is not None else None
-        if sink is None and req_sinks and ftype == TYPE_REQ:
-            # Server-side request streaming (register_request_sink): the
-            # factory sees authenticated meta (header MAC verified above
-            # when auth is on) and may decline by returning None.
+        if sink is None and req_sinks and ftype == TYPE_REQ and self._secret is None:
+            # Server-side request streaming (register_request_sink). Only
+            # without auth: a streamed chunk reaches the sink after its
+            # CRC32 — an unkeyed check — but BEFORE the payload HMAC
+            # trailer, and request sinks may consume irreversibly (the
+            # leader axpy-folds mean-mode chunks into the aggregate). With
+            # a secret set we buffer instead, so a MAC-failing payload is
+            # discarded whole and never touches the consumer — the same
+            # integrity guarantee the pre-streaming path gave. (The CLIENT
+            # fetch sink stays streamed under auth: it fills a staging
+            # buffer the caller drops when the call errors.)
             sink = self._request_sink(meta, payload_len)
         sink_closed = False
 

@@ -173,6 +173,40 @@ class TestStreamingEquivalence:
         assert agg.tiles_early >= 1  # at least the early-fired ones
         np.testing.assert_allclose(out, np.median(bufs, axis=0), rtol=1e-6)
 
+    def test_dense_feed_completes_open_windows(self):
+        """Streamed peers arrive FIRST, leaving every window exactly one
+        row short; the leader's own add_dense then completes and fires
+        them. This is the ordering _prepare_lead_round creates in
+        production — pre-armed members stream while the leader is still
+        packing — and it must go through _fire_locked (done flag, committed
+        rows, early/deadline tallies), not crash the spawn loop."""
+        n_peers, n_elems, cb = 4, 230, 64 * 4  # 4 tiles, last partial
+        peers = [f"p{i}" for i in range(n_peers)]
+        bufs = np.random.default_rng(3).standard_normal((n_peers, n_elems)).astype(np.float32)
+
+        async def main():
+            agg = StreamingAggregator(
+                n_elems, peers, "median", "f32", cb,
+                kw_fn=lambda n: {}, pool=TilePool(),
+            )
+            assert agg.mode == "window"
+            for i in range(1, n_peers):
+                _feed_streamed(agg, peers[i], 1.0, bufs[i], cb)
+            assert agg.tiles_early == 0  # every window held open for p0
+            assert agg.add_dense(peers[0], 1.0, bufs[0]) is True
+            # Let the spawned tile jobs run before finalize.
+            await asyncio.sleep(0.05)
+            early = agg.tiles_early
+            out = await agg.finalize(peers)
+            return early, agg, out
+
+        early, agg, out = run(main())
+        assert early == agg.n_tiles and agg.tiles_deadline == 0
+        # _fire_locked bookkeeping ran for the dense-triggered closures.
+        assert all(agg._win_done)
+        assert [int(c) for c in agg._committed_tiles] == [agg.n_tiles] * n_peers
+        np.testing.assert_allclose(out, np.median(bufs, axis=0), rtol=1e-6, atol=1e-7)
+
     def test_abort_before_commit_is_clean_retry(self):
         """A stream that dies before any tile commits withdraws fully; the
         retry succeeds and the result is exact."""
@@ -541,6 +575,26 @@ class TestRequestSink:
 
         run(main())
 
+    def test_aggregator_refuses_mismatched_chunk_size(self):
+        """A sender whose transport chunk_bytes differs from the leader's
+        (version skew / custom embedding — chunk size is never negotiated
+        on the wire) must poison the slot BEFORE anything folds, not
+        silently spread data across tile boundaries or bias a partially
+        filled tile that got full weight credit."""
+        n_elems, cb = 256, 64 * 4
+        for bad_cb in (cb * 2, cb // 2):  # oversized and undersized sender
+            agg = StreamingAggregator(
+                n_elems, ["a", "b"], "mean", "f32", cb,
+                kw_fn=lambda n: {}, pool=TilePool(),
+            )
+            data = np.ones(n_elems, np.float32).tobytes()
+            sink = agg.make_sink("a", 1.0, len(data))
+            sink(0, len(data), data[:bad_cb])  # first chunk, wrong size
+            slot = agg.slot_index["a"]
+            assert slot in agg._aborted, bad_cb
+            assert not agg._tile_w.any(), bad_cb  # nothing folded
+            assert agg.seal_slot(slot) is False, bad_cb
+
     def test_aggregator_refuses_offset_gaps(self):
         """Defense in depth below the transport: a sink fed a non-monotonic
         offset (which verified framing never produces) aborts the slot
@@ -556,16 +610,21 @@ class TestRequestSink:
         sink(2 * cb, len(data), data[2 * cb : 3 * cb])  # skipped chunk 1
         assert agg.seal_slot(agg.slot_index["a"]) is False
 
-    def test_streamed_request_with_auth(self):
-        """Header MAC gates the factory; the payload MAC trailer closes the
-        sink ok=True only after it verifies."""
+    def test_auth_buffers_request_instead_of_streaming(self):
+        """With a shared secret, request-sink streaming is DECLINED: chunks
+        would reach the sink on per-chunk CRC alone (unkeyed), before the
+        payload MAC trailer verifies, and sinks may consume irreversibly.
+        The transport buffers instead — the factory is never consulted and
+        the handler sees the fully MAC-verified payload."""
 
         async def main():
             record = []
             secret = b"agg-stream-secret"
             server = Transport(chunk_bytes=4096, secret=secret)
+            seen = {}
 
             async def handler(args, payload):
+                seen["payload_len"] = len(payload)
                 return {"ok": True}, b""
 
             server.register("blob.put", handler)
@@ -574,14 +633,97 @@ class TestRequestSink:
             client = Transport(chunk_bytes=4096, secret=secret)
             try:
                 await client.call(server.addr, "blob.put", {}, b"s" * 10000)
-                return record
+                return record, seen
             finally:
                 await client.close()
                 await server.close()
 
-        record = run(main())
-        assert record[0]["closed"] is True
-        assert sum(n for _, n in record[0]["chunks"]) == 10000
+        record, seen = run(main())
+        assert record == []  # factory never consulted under auth
+        assert seen["payload_len"] == 10000  # buffered, MAC-verified delivery
+
+    def test_auth_rejects_crc_valid_tampered_chunk_before_consumer(self):
+        """The attack the no-streaming-under-auth rule closes: a wire
+        attacker flips payload bytes and fixes up the unkeyed per-chunk
+        CRC32. Only the payload MAC trailer catches it — and with auth on
+        nothing (sink OR handler) may consume a byte before that check."""
+        import json as _json
+        import time as _time
+        import zlib as _zlib
+
+        from distributedvolunteercomputing_tpu.swarm.transport import (
+            _CHUNK, _HEADER, MAGIC, TYPE_ERR, TYPE_REQ, VERSION,
+        )
+
+        secret = b"agg-stream-secret"
+
+        def tampered_frames(signer, port, payload, chunk):
+            pieces = [payload[i : i + chunk] for i in range(0, len(payload), chunk)]
+            meta = {
+                "rid": "rid-tamper", "method": "blob.put", "args": {},
+                "dst": ["127.0.0.1", port], "chunks": len(pieces),
+                "ptrail": True, "ts": round(_time.time(), 3),
+            }
+            meta["auth"] = signer._mac(TYPE_REQ, meta, b"")
+            meta_b = _json.dumps(meta).encode()
+            out = [
+                _HEADER.pack(MAGIC, VERSION, TYPE_REQ, len(meta_b), len(payload), 0),
+                meta_b,
+            ]
+            # The honest sender MACs the TRUE payload; the attacker then
+            # flips a byte in chunk 1 and recomputes its CRC so framing
+            # checks all pass.
+            mac = signer._payload_mac_ctx(TYPE_REQ, "rid-tamper")
+            for i, data in enumerate(pieces):
+                mac.update(data)
+                if i == 1:
+                    bad = bytearray(data)
+                    bad[0] ^= 0xFF
+                    data = bytes(bad)
+                out.append(_CHUNK.pack(i, len(data), _zlib.crc32(data) & 0xFFFFFFFF))
+                out.append(data)
+            digest = mac.digest()
+            out.append(
+                _CHUNK.pack(len(pieces), len(digest), _zlib.crc32(digest) & 0xFFFFFFFF)
+            )
+            out.append(digest)
+            return b"".join(out)
+
+        async def main():
+            record = []
+            server = Transport(chunk_bytes=4096, secret=secret)
+            seen = {}
+
+            async def handler(args, payload):
+                seen["payload_len"] = len(payload)
+                return {"ok": True}, b""
+
+            server.register("blob.put", handler)
+            server.register_request_sink("blob.put", self._factory(record))
+            addr = await server.start()
+            signer = Transport(secret=secret)  # MAC helpers only; never started
+            probe = Transport()  # parses the error frame for us
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                try:
+                    writer.write(
+                        tampered_frames(signer, addr[1], bytes(range(256)) * 64, 4096)
+                    )
+                    await writer.drain()
+                    ftype, meta, _ = await asyncio.wait_for(
+                        probe._read_frame(reader), timeout=5
+                    )
+                finally:
+                    writer.close()
+                return ftype, meta, record, seen
+            finally:
+                await server.close()
+
+        ftype, meta, record, seen = run(main())
+        assert ftype == TYPE_ERR
+        assert "payload MAC mismatch" in meta.get("error", "")
+        assert record == []  # no sink ever saw a tampered byte
+        assert seen == {}  # and the handler never ran
 
 
 def _make_node(peer_id, *, chaos=None, **avg_kw):
