@@ -248,6 +248,12 @@ class StreamingAggregator:
         self.streamed_contribs = 0
         self.dense_contribs = 0
         self.aborted_contribs = 0
+        # Leader-failover fencing: True once this aggregator was superseded
+        # by a newer round generation (fence()). Chunks that still arrive —
+        # a stale sink flushing after its round was deposed — are counted,
+        # never folded.
+        self.fenced = False
+        self.chunks_after_fence = 0
         self._held = self._out.nbytes
         self.peak_bytes_held = self._held
 
@@ -332,6 +338,9 @@ class StreamingAggregator:
         n = len(data) // self.esz
         fire: List[tuple] = []
         with self._lock:
+            if self.fenced:
+                self.chunks_after_fence += 1
+                return
             if self.frozen or slot in self._aborted or slot in self._tainted:
                 return
             if self._filled[slot] != e0:
@@ -622,6 +631,26 @@ class StreamingAggregator:
                     self._sealed.add(slot)
                     self.streamed_contribs += 1
 
+    def fence(self) -> None:
+        """Supersede this aggregator under a newer round generation (leader
+        failover re-arm over the same epoch): freeze, return every
+        transient buffer to the pool, and from here on COUNT — never fold —
+        any chunk a stale sink still delivers. The partially-committed
+        tiles this aggregator holds are abandoned with it; the recovery
+        round re-collects the same contributions into a fresh aggregator,
+        so no half-folded mass from the deposed generation can leak into
+        the recovered result."""
+        with self._lock:
+            self.fenced = True
+        self.release()
+
+    def progress(self) -> Dict[str, int]:
+        """Per-peer elements received so far (streamed or dense) — the
+        mid-round visibility probe failover phase instrumentation and the
+        chaos campaign read to tell 'pre-arm' from 'mid-stream'."""
+        with self._lock:
+            return {p: int(self._filled[i]) for i, p in enumerate(self.slots)}
+
     def weight_of(self, peer: str) -> float:
         """The weight a peer's contribution was folded with (0.0 if it
         never fed this round)."""
@@ -740,4 +769,6 @@ class StreamingAggregator:
             "streamed_contribs": int(self.streamed_contribs),
             "dense_contribs": int(self.dense_contribs),
             "aborted_contribs": int(self.aborted_contribs),
+            "fenced": bool(self.fenced),
+            "chunks_after_fence": int(self.chunks_after_fence),
         }

@@ -29,13 +29,32 @@ its deadline. Paired with the phi-accrual failure detector
 (swarm/failure_detector.py) and the adaptive resilience policy
 (swarm/resilience.py), which set the budget and pre-exclude likely
 stragglers from formation in the first place.
+
+Leader failover (sync mode): the gather leader used to be the round's last
+single point of failure — a dead leader failed everyone's fetch and the
+round was skipped, discarding every member's streamed contribution. Sync
+rounds now carry a FENCING GENERATION alongside the matchmaking epoch
+(Group.gen; 0 for the original leader). A member that observes the leader
+die at the connection level (refused dial, reset socket), lose its round
+state, or trip phi-accrual suspicion mid-fetch DEPOSES it: the
+deterministic successor — the next live member in epoch order, skipping
+peers the local policy suspects — re-leads a RECOVERY round over the same
+epoch at generation+1, re-collecting the contributions members retained in
+compressed wire form (nothing is recompressed, so error-feedback state
+cannot double-apply). Handlers check the generation on every
+sync.contribute/sync.fetch, so a deposed or partitioned ex-leader's late
+serve — and a member's stale push — is rejected instead of mixing into the
+newer round (Moshpit's restructure-around-the-failure applied to the
+leader itself; see docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
 
 import asyncio
 import hashlib
+import os
 import random
+import signal
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -77,6 +96,13 @@ class _Streamed:
 
 
 STREAMED = _Streamed()
+
+
+class _LeaderDown(Exception):
+    """Member-side verdict that the round's leader is gone: connection-level
+    failure on the push/fetch leg, lost round state, or phi-accrual
+    suspicion mid-fetch. Internal control flow only — `_member_round`
+    converts it into a recovery attempt, never lets it escape."""
 
 
 class _Round:
@@ -121,6 +147,10 @@ class _Round:
         # folded COMPLETELY into the stream (its close(ok=True) ran); the
         # contribute handler and the commit adopt these into ``contribs``.
         self.stream_done: Dict[Any, float] = {}
+        # Fencing generation this round state serves (Group.gen): 0 for the
+        # original leader, bumped per failover recovery. Armed handlers
+        # reject contribute/fetch traffic carrying any other generation.
+        self.gen = 0
         self.t0 = time.monotonic()
 
 
@@ -257,8 +287,15 @@ class AveragerBase:
             exclude = failure_detector.suspect
         else:
             exclude = None
+        # Leaders this node deposed via failover recovery (peer -> mono
+        # time, TTL'd): consulted by the matchmaker's LEADERSHIP exclusion —
+        # a peer that just crashed out of the lead is not handed it again
+        # the moment it reappears — and by sync members refusing to join a
+        # round such a peer leads while the strike is fresh.
+        self._deposed_leaders: Dict[str, float] = {}
         self.matchmaker = Matchmaker(
-            transport, dht, self.peer_id, clock=self.clock, exclude=exclude
+            transport, dht, self.peer_id, clock=self.clock, exclude=exclude,
+            lead_exclude=self._lead_excluded,
         )
         self.min_group = min_group
         self.max_group = max_group
@@ -422,6 +459,40 @@ class AveragerBase:
             degraded=self._round_degraded,
             **detail,
         )
+
+    # -- leader failover bookkeeping ---------------------------------------
+
+    # How long a deposed-leader strike keeps a peer out of the lead (and,
+    # for sync members, out of rounds it leads). Long enough to cover a
+    # crash-loop's restart, short enough that a genuinely-healed peer gets
+    # the lead back within a few formation cadences.
+    DEPOSED_LEADER_TTL_S = 90.0
+
+    def _recently_deposed(self, pid: str) -> bool:
+        t = self._deposed_leaders.get(pid)
+        if t is None:
+            return False
+        if time.monotonic() - t > self.DEPOSED_LEADER_TTL_S:
+            del self._deposed_leaders[pid]
+            return False
+        return True
+
+    def _lead_excluded(self, pid: str) -> bool:
+        """Leadership-exclusion predicate handed to the matchmaker: a
+        recently-deposed ex-leader, a policy-pre-excluded straggler, or a
+        phi/connection-suspected peer should not self-elect (from THIS
+        node's vantage; divergent views cost one underfilled round, never
+        mixed tensors — see Matchmaker._pick_leader)."""
+        if self._recently_deposed(pid):
+            return True
+        try:
+            if self.resilience is not None and self.resilience.should_preexclude(pid):
+                return True
+            if self.failure_detector is not None and self.failure_detector.suspect(pid):
+                return True
+        except Exception:  # noqa: BLE001 — a policy bug must not kill rounds
+            pass
+        return False
 
     def _effective_method(self, n_peers: int) -> Tuple[str, dict]:
         """(method, kwargs) to aggregate with THIS round. Consults the
@@ -974,23 +1045,110 @@ class SyncAverager(AveragerBase):
 
     The inter-slice half of the synchronous GradientAverager (config 2). At
     reference swarm scale (2-8 slices) a leader-gather round is one RTT
-    cheaper than a ring and trivially churn-safe: missing contributions are
-    dropped at the deadline, a dead leader fails everyone's fetch -> skip.
+    cheaper than a ring and churn-safe on both sides: missing contributions
+    are dropped at the deadline, and a DEAD LEADER is deposed mid-round —
+    the deterministic successor re-leads a fenced recovery round over the
+    same retained contributions (generation bump on the epoch), so one
+    crashed volunteer costs the group its contribution, not everyone's
+    streamed work (see the module doc's leader-failover section).
     """
 
     mode = "sync"
+
+    # Longest a member waits for a successor's recovery begin after
+    # deposing the leader. The successor detects the same death on its own
+    # push/fetch leg, so the lag between depositions is connection-error
+    # scale (seconds), not deadline scale.
+    RECOVERY_BEGIN_WAIT_S = 6.0
+    # TTL for a recovery begin that arrived before its member started
+    # waiting (the successor can depose faster than a slow member).
+    RECOVER_PARKED_TTL_S = 8.0
+    # Fencing generations accepted per epoch: one original round plus a
+    # bounded failover chain — a runaway (or malicious) recovery cascade
+    # stops here.
+    MAX_RECOVERY_GEN = 3
+    # Bound on per-epoch generation records a remote peer can allocate.
+    MAX_EPOCH_GENS = 256
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
         self._rounds: Dict[str, _Round] = {}
         self.transport.register("sync.contribute", self._rpc_contribute)
         self.transport.register("sync.fetch", self._rpc_fetch)
+        # Leader-failover recovery plumbing: recovery begins land here
+        # (future when a member is already waiting, parked otherwise —
+        # the matchmaking begin pattern), and _epoch_gen fences each epoch
+        # at the highest generation this node accepted.
+        self.transport.register("sync.recover", self._rpc_recover)
+        self._recover_futs: Dict[str, asyncio.Future] = {}
+        self._recover_parked: Dict[str, Tuple[float, dict]] = {}
+        self._epoch_gen: Dict[str, Tuple[float, int]] = {}
+        # Failover observability (stats()["failover"], volunteer report,
+        # coord.status): depositions this node decided, rounds whose result
+        # arrived via a recovery generation, failed recovery attempts, and
+        # deposition->recovered-result latency.
+        self.leaders_deposed = 0
+        self.rounds_recovered = 0
+        self.recoveries_failed = 0
+        self._recovery_lat_last: Optional[float] = None
+        self._recovery_lat_ewma: Optional[float] = None
+        # Test/chaos instrumentation: named leader-round phase points fire
+        # these hooks (chaos campaigns kill/partition the leader at exact
+        # protocol points) and honor DVC_CHAOS_LEADER_DIE_PHASE (subprocess
+        # e2e: the leader SIGKILLs itself at the named phase). Production
+        # leaves both empty/unset.
+        self._phase_hooks: Dict[str, Callable[[], Any]] = {}
         # Streaming leader aggregation: chunked contribute payloads decode
         # and fold into the round's aggregator AS THEY ARRIVE instead of
         # buffering per-peer dense vectors (swarm/agg_stream.py).
         self.transport.register_request_sink(
             "sync.contribute", self._contribute_stream_factory
         )
+
+    # The four instrumented leader-round phases, in protocol order (the
+    # kill-at-phase chaos matrix iterates these).
+    LEADER_PHASES = ("pre_arm", "mid_stream", "post_partial_commit", "pre_fetch")
+
+    def _phase_armed(self, name: str) -> bool:
+        return (
+            name in self._phase_hooks
+            or os.environ.get("DVC_CHAOS_LEADER_DIE_PHASE") == name
+        )
+
+    async def _phase(self, name: str) -> None:
+        """Fire the instrumentation hook for a leader-round phase point.
+        No-op in production (no hooks registered, env unset)."""
+        hook = self._phase_hooks.get(name)
+        if hook is not None:
+            res = hook()
+            if asyncio.iscoroutine(res):
+                await res
+        if os.environ.get("DVC_CHAOS_LEADER_DIE_PHASE") == name:
+            # Subprocess e2e chaos: die EXACTLY like a preempted/crashed
+            # volunteer — no cleanup, no tombstone, sockets reset by the
+            # kernel. Test-only; unset in production.
+            log.warning("chaos: leader dying at phase %r (SIGKILL)", name)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def failover_stats(self) -> dict:
+        return {
+            "leaders_deposed": self.leaders_deposed,
+            "rounds_recovered": self.rounds_recovered,
+            "recoveries_failed": self.recoveries_failed,
+            "recovery_latency_s_last": (
+                round(self._recovery_lat_last, 3)
+                if self._recovery_lat_last is not None else None
+            ),
+            "recovery_latency_s_ewma": (
+                round(self._recovery_lat_ewma, 3)
+                if self._recovery_lat_ewma is not None else None
+            ),
+        }
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["failover"] = self.failover_stats()
+        return out
 
     def _contribute_stream_factory(self, args: dict, total: int):
         """Per-request sink for a member's chunked contribution, or None to
@@ -1004,6 +1162,8 @@ class SyncAverager(AveragerBase):
         st = self._rounds.get(epoch) if isinstance(epoch, str) else None
         if st is None or st.stream is None or st.result_ready.is_set():
             return None
+        if self._fence_of(args) != st.gen:
+            return None  # stale generation: the buffered handler rejects it loudly
         if not self._check_schema(args):
             return None
         peer = args.get("peer")
@@ -1027,12 +1187,33 @@ class SyncAverager(AveragerBase):
 
         return st.stream.make_sink(peer, weight, total, on_done=on_done)
 
+    @staticmethod
+    def _fence_of(args: dict) -> int:
+        """The fencing generation a request carries (0 for legacy/original
+        traffic; malformed values read as -1, matching no round)."""
+        fence = args.get("fence", 0)
+        try:
+            return int(fence)
+        except (TypeError, ValueError):
+            return -1
+
     async def _rpc_contribute(self, args: dict, payload: bytes):
         if not self._check_schema(args):
             raise RPCError("schema mismatch")
         # Members can push before the leader enters its round: park it
         # (swept + capped against fabricated-epoch flooding).
         st = self._get_or_park_round(self._rounds, args["epoch"])
+        if st.tokens is not None and self._fence_of(args) != st.gen:
+            # Epoch fencing: this (armed) round state serves generation
+            # st.gen; a push stamped with any other generation is a stale
+            # member (or a deposed ex-leader's relayed traffic) and must
+            # not mix into this round. Unarmed (parked) rounds skip the
+            # check — their entries are re-filtered against the token
+            # table at arming anyway.
+            raise RPCError(
+                f"fencing mismatch: round epoch is at generation {st.gen}, "
+                f"push carries {self._fence_of(args)} (deposed/stale)"
+            )
         # Keyed by (peer, token): a push can neither OVERWRITE another entry
         # (no displacement of an honest contribution by a later forgery) nor
         # PRE-BLOCK one (an early forgery under peer P doesn't stop P's real
@@ -1134,6 +1315,16 @@ class SyncAverager(AveragerBase):
         st = self._rounds.get(args["epoch"])
         if st is None:
             raise RPCError("unknown or finished round epoch")
+        if self._fence_of(args) != st.gen:
+            # Epoch fencing, BEFORE parking on result_ready: a revived
+            # ex-leader (this node, if it was partitioned and healed) must
+            # refuse to serve its stale generation-(st.gen) result to a
+            # member that has moved on — and refuse fast, not after the
+            # gather-deadline wait below.
+            raise RPCError(
+                f"fencing mismatch: round epoch is at generation {st.gen}, "
+                f"fetch asks for {self._fence_of(args)} (leader deposed?)"
+            )
         # Must outwait the leader's own gather deadline plus its off-loop
         # aggregation, or a member's fetch races the result and loses.
         await asyncio.wait_for(
@@ -1164,6 +1355,19 @@ class SyncAverager(AveragerBase):
             self.rounds_skipped += 1
             self._last_outcomes = None
             return None
+        if group.my_index != 0 and self._recently_deposed(group.leader_id):
+            # Leadership strike (tentpole part 3): this peer crashed out of
+            # the lead within the TTL — don't hand it our contribution (or
+            # gate our round on its fetch) again yet. Our own _pick_leader
+            # already prefers someone else; this covers the race where the
+            # flaky peer's begin still won.
+            log.info(
+                "sync round: refusing round led by recently-deposed %s",
+                group.leader_id,
+            )
+            self.rounds_skipped += 1
+            self._last_outcomes = None
+            return None
         if group.my_index == 0 and self._specs is not None:
             # Arm the streaming round BEFORE packing our own contribution:
             # members push the instant formation completes, and the pack at
@@ -1186,7 +1390,7 @@ class SyncAverager(AveragerBase):
                     group, await asyncio.to_thread(sent), weight, wire_bytes
                 )
             else:
-                result = await self._member_round(group, weight, wire_bytes)
+                result = await self._member_round(group, weight, wire_bytes, sent)
         except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
             log.info("sync round %d failed (%s); continuing local", round_no, errstr(e))
             self.rounds_skipped += 1
@@ -1222,7 +1426,9 @@ class SyncAverager(AveragerBase):
             st = self._rounds[group.epoch] = _Round([])
         if st.armed:
             return st
+        await self._phase("pre_arm")
         st.armed = True
+        st.gen = group.gen
         member_ids = [pid for pid, _ in group.members]
         st.expected = set(member_ids)
         tokens = group.member_tokens or {}
@@ -1303,6 +1509,15 @@ class SyncAverager(AveragerBase):
                 st.contribs[(self.peer_id, group.token)] = (weight, STREAMED)
         if {p for p, _ in st.contribs} >= st.expected:
             st.full.set()
+        if self._phase_armed("mid_stream"):
+            # Chaos instrumentation: "mid_stream" means member data has
+            # started arriving — wait (bounded) for the first remote
+            # contribution bytes before firing, so the kill really lands
+            # mid-gather and not in the pre-arm window.
+            await self._await_remote_contribution(
+                st, timeout=min(5.0, self._deadline_wait(group))
+            )
+            await self._phase("mid_stream")
         try:
             try:
                 # The group DEADLINE bounds the gather: begin fan-out time
@@ -1313,6 +1528,7 @@ class SyncAverager(AveragerBase):
                 )
             except asyncio.TimeoutError:
                 self._round_degraded = True  # deadline commit: not an observation
+            await self._phase("post_partial_commit")
             # Resolve pre-schema-parked powersgd payloads now that our own
             # pack fixed the specs (exact-size-capped decode).
             await self._decode_deferred(st)
@@ -1461,6 +1677,7 @@ class SyncAverager(AveragerBase):
                 st.result_wire = self._wire_stream(st.result)
             else:
                 st.result_wire = await self._encode_wire(st.result)
+            await self._phase("pre_fetch")
             st.result_ready.set()
             self.rounds_ok += 1
             # Keep state around long enough for members to fetch.
@@ -1484,14 +1701,42 @@ class SyncAverager(AveragerBase):
         if st.stream is not None:
             st.stream.release()
 
-    async def _member_round(self, group: Group, weight: float, wire_bytes: bytes):
-        leader_addr = group.members[0][1]
+    async def _member_round(
+        self,
+        group: Group,
+        weight: float,
+        wire_bytes,
+        dense_fn: Optional[Callable[[], np.ndarray]] = None,
+    ):
+        """Push to the leader, fetch the result — and if the leader dies
+        under us, recover instead of skipping: the wire form is RETAINED
+        (``wire_bytes`` stays referenced until a commit is acknowledged, and
+        a StreamPayload's factory re-iterates) so the recovery round
+        re-pushes exactly the bytes this round compressed, with no second
+        error-feedback staging."""
+        leader_id, leader_addr = group.members[0]
+        try:
+            await self._push_contribution(leader_addr, group, weight, wire_bytes)
+            return await self._fetch_round_result(leader_addr, leader_id, group)
+        except _LeaderDown as e:
+            log.warning(
+                "sync round: leader %s down (%s); attempting failover recovery",
+                leader_id, e,
+            )
+            return await self._recover_round(
+                group, weight, wire_bytes, dense_fn, reason=str(e)
+            )
+
+    async def _push_contribution(
+        self, leader_addr, group: Group, weight: float, wire_bytes
+    ) -> None:
         args = {
             "epoch": group.epoch,
             "peer": self.peer_id,
             "weight": weight,
             "schema": self._schema,
             "token": group.token,
+            "fence": group.gen,
         }
         # The push must land BEFORE the group deadline or the leader commits
         # without it — spending more than the remaining budget on it would
@@ -1499,23 +1744,93 @@ class SyncAverager(AveragerBase):
         # record_latency=False on the payload legs: bulk-transfer (and, for
         # the fetch, deliberately-parked) durations must not poison the
         # control-plane latency EWMA the failure detector suspects on.
-        await self.transport.call(
-            leader_addr, "sync.contribute", args, wire_bytes,
-            timeout=self._deadline_wait(group, floor=1.0),
-            record_latency=False,
-        )
+        try:
+            await self.transport.call(
+                leader_addr, "sync.contribute", args, wire_bytes,
+                timeout=self._deadline_wait(group, floor=1.0),
+                record_latency=False,
+            )
+        except (asyncio.TimeoutError, TimeoutError):
+            # A timed-out push is a SLOW gather, not a dead leader — and on
+            # Python >= 3.11 asyncio.TimeoutError IS builtins.TimeoutError,
+            # an OSError subclass: without this clause the handler below
+            # would depose a merely-slow leader (same trap the transport's
+            # retry path documents).
+            raise
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            # Hard connection-level failure (refused dial, reset socket):
+            # the leader process is GONE — distinct from a timeout (which
+            # may just be a slow gather) and grounds for immediate
+            # deposition rather than outwaiting the round budget.
+            raise _LeaderDown(
+                f"contribution push failed at connection level: {errstr(e)}"
+            ) from e
+
+    async def _fetch_round_result(self, leader_addr, leader_id: str, group: Group):
         # Decode-on-arrival (f32/bf16): verified result chunks land straight
         # in the final f32 buffer while later chunks are still in flight.
         sink, sink_state = self._result_sink()
-        ret, payload = await self.transport.call(
-            leader_addr, "sync.fetch", {"epoch": group.epoch},
-            # Outwait the leader's own commit point (the deadline) plus its
-            # off-loop aggregation headroom plus transfer margin.
-            timeout=self._deadline_wait(group, floor=1.0)
-            + self.AGGREGATION_HEADROOM + 6.0,
-            chunk_sink=sink,
-            record_latency=False,
+        call = asyncio.ensure_future(
+            self.transport.call(
+                leader_addr, "sync.fetch",
+                {"epoch": group.epoch, "fence": group.gen},
+                # Outwait the leader's own commit point (the deadline) plus
+                # its off-loop aggregation headroom plus transfer margin.
+                timeout=self._deadline_wait(group, floor=1.0)
+                + self.AGGREGATION_HEADROOM + 6.0,
+                chunk_sink=sink,
+                record_latency=False,
+            )
         )
+        try:
+            if self.failure_detector is not None:
+                # Mid-fetch leader suspicion: the fetch deliberately parks
+                # on the leader until its commit point, which is exactly
+                # the window a silently-dead leader wastes. Poll the
+                # phi-accrual verdict while parked and depose instead of
+                # outwaiting the full budget.
+                while True:
+                    done, _ = await asyncio.wait({call}, timeout=0.5)
+                    if done:
+                        break
+                    if self.failure_detector.suspect(leader_id):
+                        call.cancel()
+                        try:
+                            await call
+                        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                            pass
+                        raise _LeaderDown(
+                            "failure detector suspects the leader mid-fetch"
+                        )
+            ret, payload = await call
+        except (asyncio.TimeoutError, TimeoutError):
+            # Timeout != death (see _push_contribution): deposing here
+            # would punish every slow commit; the deadline machinery
+            # already bounds what a slow leader can cost.
+            raise
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise _LeaderDown(
+                f"result fetch failed at connection level: {errstr(e)}"
+            ) from e
+        except RPCError as e:
+            if "unknown or finished round epoch" in str(e) and (
+                time.monotonic() - group.formed_mono < self.gather_timeout
+            ):
+                # EARLY unknown-epoch — well inside the round's lifetime,
+                # long before the leader's post-commit retention window
+                # (2x gather_timeout) could have swept it — means the
+                # leader restarted and lost its round state mid-round:
+                # death for this round's purposes. A LATE unknown-epoch is
+                # this member stalling past the retention window of a
+                # round the leader already served everyone else; deposing
+                # a healthy leader for our own slowness would hand out
+                # suspicion holds swarm-wide, so that stays a plain
+                # failed fetch.
+                raise _LeaderDown(f"leader lost round state ({e})") from e
+            raise
+        finally:
+            if not call.done():
+                call.cancel()
         # Older leaders don't report the included set; treat absence as
         # included (the pre-existing behavior) rather than stalling EF.
         included = ret.get("included")
@@ -1542,6 +1857,335 @@ class SyncAverager(AveragerBase):
         return await asyncio.to_thread(
             lambda: self._unpack(self._buf_from_payload(payload))
         )
+
+    # -- leader failover recovery ------------------------------------------
+
+    def _note_deposed(self, leader_id: str, leader_addr, reason: str) -> None:
+        """Record the deposition evidence that is sound from a SINGLE
+        observer's vantage: the gauge, the detector's connection-failure
+        hold (cleared by the peer's next observed heartbeat), and retiring
+        the pooled connection so nothing retries against the corpse. The
+        leadership STRIKE — refusing the peer the lead, and its rounds,
+        for DEPOSED_LEADER_TTL_S — is recorded separately by
+        _recover_round once recovery is actually viable: one member's own
+        flaky outbound link (a dropped call in a 2-peer swarm) must not
+        blacklist a healthy leader for the whole strike window."""
+        log.warning("sync round: deposing leader %s (%s)", leader_id, reason)
+        self.leaders_deposed += 1
+        if self.failure_detector is not None:
+            self.failure_detector.report_failure(leader_id)
+        self.transport.drop_peer(leader_addr)
+
+    def _strike_deposed(self, leader_id: str) -> None:
+        self._deposed_leaders[leader_id] = time.monotonic()
+        if self.resilience is not None:
+            self.resilience.note_leader_deposed(leader_id)
+
+    def _successor(self, survivors: List[Tuple[str, Any]]) -> Optional[str]:
+        """Deterministic successor: the first survivor in epoch (sorted-id)
+        order the local policy does not currently suspect — never skipping
+        ourselves, and falling back to the plain first survivor when every
+        candidate is suspected. Views can diverge across members (suspicion
+        is local); the recovery begin is what re-synchronizes them — a
+        member follows whichever valid begin arrives, and a second
+        self-promoted successor's round simply underfills and skips."""
+        for pid, _ in survivors:
+            if pid == self.peer_id:
+                return pid
+            if self.resilience is not None and self.resilience.should_preexclude(pid):
+                continue
+            if self.failure_detector is not None and self.failure_detector.suspect(pid):
+                continue
+            return pid
+        return survivors[0][0] if survivors else None
+
+    async def _recover_round(
+        self,
+        group: Group,
+        weight: float,
+        wire_bytes,
+        dense_fn: Optional[Callable[[], np.ndarray]],
+        reason: str,
+    ):
+        """Re-lead (or follow) a recovery round over the SAME epoch at
+        generation+1 after deposing the leader. One generation bump per
+        round from this node's vantage: if the successor dies too, the
+        round fails — cascading multi-death inside a single round is rarer
+        than the stall a recovery chain would risk."""
+        t_rec = time.monotonic()
+        deposed_id, deposed_addr = group.members[0]
+        self._note_deposed(deposed_id, deposed_addr, reason)
+        if group.gen >= self.MAX_RECOVERY_GEN:
+            self.recoveries_failed += 1
+            raise RPCError(
+                f"recovery generation cap ({self.MAX_RECOVERY_GEN}) reached "
+                f"for epoch {group.epoch}"
+            )
+        survivors = [(p, a) for p, a in group.members if p != deposed_id]
+        if len(survivors) < self.min_group:
+            self.recoveries_failed += 1
+            raise RPCError(
+                f"leader down and only {len(survivors)} survivors "
+                f"(min_group {self.min_group}): round unrecoverable"
+            )
+        gen = group.gen + 1
+        # Recovery is viable: the group genuinely moves on without this
+        # leader — NOW the leadership strike is warranted.
+        self._strike_deposed(deposed_id)
+        successor = self._successor(survivors)
+        try:
+            if successor == self.peer_id:
+                result = await self._lead_recovery(
+                    group, survivors, gen, weight, wire_bytes, dense_fn
+                )
+            else:
+                result = await self._follow_recovery(
+                    group, survivors, gen, weight, wire_bytes, successor
+                )
+        except _LeaderDown as e:
+            self.recoveries_failed += 1
+            raise RPCError(f"recovery round failed: {e}") from e
+        except (RPCError, OSError, ValueError, asyncio.TimeoutError):
+            self.recoveries_failed += 1
+            raise
+        if result is None:
+            self.recoveries_failed += 1
+            return None
+        dt = time.monotonic() - t_rec
+        self.rounds_recovered += 1
+        self._recovery_lat_last = dt
+        self._recovery_lat_ewma = (
+            dt if self._recovery_lat_ewma is None
+            else self._recovery_lat_ewma + 0.25 * (dt - self._recovery_lat_ewma)
+        )
+        log.info(
+            "sync round recovered at generation %d in %.2fs (deposed %s, "
+            "successor %s)", gen, dt, deposed_id, successor,
+        )
+        return result
+
+    async def _lead_recovery(
+        self,
+        group: Group,
+        survivors: List[Tuple[str, Any]],
+        gen: int,
+        weight: float,
+        wire_bytes,
+        dense_fn: Optional[Callable[[], np.ndarray]],
+    ):
+        """This node is the successor: mint fresh per-member tokens (the
+        deposed leader's table died with it), fan out the recovery begin,
+        and re-lead the gather over the retained contributions through the
+        ordinary _lead_round machinery — fenced at ``gen``."""
+        if dense_fn is None:
+            raise RPCError("recovery round: no dense contribution available")
+        me = self.peer_id
+        my_addr = next(a for p, a in survivors if p == me)
+        others = [(p, a) for p, a in survivors if p != me]
+        tokens = {pid: uuid.uuid4().hex for pid, _ in survivors}
+        budget = self._round_budget()
+        deadline = self.clock() + budget
+        rgroup = Group(
+            epoch=group.epoch,
+            members=[(me, my_addr)] + others,
+            my_index=0,
+            token=tokens[me],
+            member_tokens=tokens,
+            deadline=deadline,
+            budget=budget,
+            gen=gen,
+        )
+        self._record_epoch_gen(group.epoch, gen)
+        # Abort/re-arm: whatever round state the deposed generation left
+        # under this epoch (parked pushes keyed by dead tokens, half-filled
+        # streaming tiles) is fenced off and released — the recovery round
+        # re-collects from scratch, so no half-folded mass from the old
+        # generation can leak into the recovered result.
+        old = self._rounds.pop(group.epoch, None)
+        if old is not None:
+            if old.stream is not None:
+                old.stream.fence()
+            self._release_round(old)
+        begin = {
+            "epoch": group.epoch,
+            "gen": gen,
+            "members": [[p, list(a)] for p, a in rgroup.members],
+            "deadline": deadline,
+            "budget": budget,
+            "schema": self._schema,
+        }
+        reached = 0
+        for pid, addr in others:
+            try:
+                await self.transport.call(
+                    addr, "sync.recover", {**begin, "token": tokens[pid]},
+                    timeout=5.0, connect_timeout=3.0,
+                )
+                reached += 1
+            except Exception as e:  # noqa: BLE001 — per-member fan-out containment
+                log.warning("recovery begin to %s failed: %s", pid, errstr(e))
+        if reached + 1 < self.min_group:
+            raise RPCError(
+                f"recovery round: only {reached + 1} reachable survivors "
+                f"(min_group {self.min_group})"
+            )
+        buf = await asyncio.to_thread(dense_fn)
+        return await self._lead_round(rgroup, buf, weight, wire_bytes)
+
+    async def _follow_recovery(
+        self,
+        group: Group,
+        survivors: List[Tuple[str, Any]],
+        gen: int,
+        weight: float,
+        wire_bytes,
+        successor: Optional[str],
+    ):
+        """This node expects another survivor to take over: wait (bounded)
+        for its recovery begin, validate it against the ORIGINAL membership
+        (the begin may only shrink the group, never smuggle outsiders in or
+        resurrect the deposed leader), then re-push the retained wire form
+        and fetch under the new generation."""
+        begin = await self._await_recover_begin(group.epoch)
+        if begin is None:
+            raise RPCError(
+                f"no recovery begin arrived for epoch {group.epoch} "
+                f"(expected successor {successor})"
+            )
+        try:
+            rgen = int(begin.get("gen", 0))
+            members = [
+                (str(pid), (str(a[0]), int(a[1])))
+                for pid, a in begin.get("members", [])
+            ]
+        except (TypeError, ValueError, IndexError):
+            raise RPCError("malformed recovery begin") from None
+        ids = [p for p, _ in members]
+        orig = {p for p, _ in group.members}
+        if (
+            rgen <= group.gen
+            or rgen > self.MAX_RECOVERY_GEN
+            or not members
+            or not set(ids) <= orig
+            or group.leader_id in ids
+            or self.peer_id not in ids
+            or ids[0] == self.peer_id
+        ):
+            raise RPCError("invalid recovery begin (membership/generation)")
+        self._record_epoch_gen(group.epoch, rgen)
+        deadline = begin.get("deadline")
+        budget = begin.get("budget")
+        rgroup = Group(
+            epoch=group.epoch,
+            members=members,
+            my_index=ids.index(self.peer_id),
+            token=str(begin.get("token", "")),
+            deadline=float(deadline) if isinstance(deadline, (int, float)) else None,
+            budget=float(budget) if isinstance(budget, (int, float)) else None,
+            gen=rgen,
+        )
+        new_leader_id, new_leader_addr = members[0]
+        await self._push_contribution(new_leader_addr, rgroup, weight, wire_bytes)
+        return await self._fetch_round_result(new_leader_addr, new_leader_id, rgroup)
+
+    async def _await_recover_begin(self, epoch: str) -> Optional[dict]:
+        parked = self._recover_parked.pop(epoch, None)
+        if (
+            parked is not None
+            and time.monotonic() - parked[0] <= self.RECOVER_PARKED_TTL_S
+        ):
+            return parked[1]
+        fut = self._recover_futs.get(epoch)
+        if fut is None or fut.done():
+            fut = self._recover_futs[epoch] = (
+                asyncio.get_running_loop().create_future()
+            )
+        try:
+            return await asyncio.wait_for(
+                asyncio.shield(fut), timeout=self.RECOVERY_BEGIN_WAIT_S
+            )
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            if self._recover_futs.get(epoch) is fut:
+                self._recover_futs.pop(epoch, None)
+
+    def _sweep_epoch_gens(self) -> None:
+        cutoff = time.monotonic() - (self.gather_timeout * 3 + 60.0)
+        for k in [k for k, (ts, _) in self._epoch_gen.items() if ts < cutoff]:
+            del self._epoch_gen[k]
+
+    def _record_epoch_gen(self, epoch: str, gen: int) -> None:
+        """Record an ACCEPTED recovery generation for an epoch (validated
+        follow, or our own lead) — the state the sync.recover handler's
+        only-advance fence checks against."""
+        self._sweep_epoch_gens()
+        if epoch in self._epoch_gen or len(self._epoch_gen) < self.MAX_EPOCH_GENS:
+            self._epoch_gen[epoch] = (time.monotonic(), gen)
+
+    async def _rpc_recover(self, args: dict, payload: bytes):
+        """A successor's recovery begin. Membership proof is knowledge of
+        the epoch — a 16-hex digest delivered only inside the original
+        round's private begin messages (plus the transport HMAC when the
+        swarm runs authenticated); the follower re-validates the proposed
+        member list against its own original group before acting on it.
+        Generations only ever advance per epoch, so a replayed or
+        second-guessing begin for an already-recovered round is refused."""
+        epoch = args.get("epoch")
+        gen = args.get("gen")
+        if (
+            not isinstance(epoch, str)
+            or not epoch
+            or not isinstance(gen, int)
+            or isinstance(gen, bool)
+            or gen < 1
+            or gen > self.MAX_RECOVERY_GEN
+        ):
+            raise RPCError("malformed recovery begin")
+        self._sweep_epoch_gens()
+        known = self._epoch_gen.get(epoch, (0.0, 0))[1]
+        if gen <= known:
+            raise RPCError(
+                f"stale recovery begin (generation {gen} <= accepted {known})"
+            )
+        # NOT recorded here: _epoch_gen advances only when a begin is
+        # ACCEPTED — validated against the original membership in
+        # _follow_recovery (or minted by our own _lead_recovery). Recording
+        # an unvalidated begin would let one shape-valid forgery at the
+        # generation cap permanently consume the epoch's budget and block
+        # the genuine successor.
+        fut = self._recover_futs.get(epoch)
+        if fut is not None and not fut.done():
+            fut.set_result(args)
+        else:
+            now = time.monotonic()
+            for k in [
+                k for k, (ts, _) in self._recover_parked.items()
+                if now - ts > self.RECOVER_PARKED_TTL_S
+            ]:
+                del self._recover_parked[k]
+            if (
+                epoch not in self._recover_parked
+                and len(self._recover_parked) >= 64
+            ):
+                raise RPCError("parked recovery begin cap reached")
+            self._recover_parked[epoch] = (now, args)
+        return {"ok": True}, b""
+
+    async def _await_remote_contribution(self, st: _Round, timeout: float) -> None:
+        """Block (bounded) until at least one REMOTE contribution has
+        started arriving — chunks folding into the stream, a parked dense
+        buffer, or a completed sink. Chaos instrumentation only (the
+        'mid_stream' phase point must fire mid-gather, not pre-arm)."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while time.monotonic() < deadline:
+            if st.stream_done or any(p != self.peer_id for p, _ in st.contribs):
+                return
+            if st.stream is not None and any(
+                n for p, n in st.stream.progress().items() if p != self.peer_id
+            ):
+                return
+            await asyncio.sleep(0.05)
 
 
 class GossipAverager(AveragerBase):

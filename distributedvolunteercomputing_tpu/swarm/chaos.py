@@ -34,7 +34,7 @@ import contextvars
 import dataclasses
 import random
 import time
-from typing import Iterable, Optional, Sequence, Tuple
+from typing import Iterable, Optional, Sequence, Set, Tuple
 
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, Transport
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
@@ -160,6 +160,18 @@ _corrupt_this_call: contextvars.ContextVar[bool] = contextvars.ContextVar(
 
 
 class ChaosTransport(Transport):
+    # Process-wide blackholed peer pairs, shared by every ChaosTransport in
+    # the process: entering (a, b) here makes calls between those two
+    # addresses fail like a severed link, in BOTH directions provided both
+    # endpoints run ChaosTransports (each side refuses its own outbound
+    # half). Class-level on purpose — a partition is a property of the
+    # network between two nodes, not of one endpoint — so a scenario script
+    # can cut an edge with one call on any instance. Tests/campaigns must
+    # ``heal()`` in teardown. Composes with the constant rates, the
+    # corrupt-offset hook, and any attached FaultSchedule: the partition
+    # check runs first (a cut link delivers nothing to delay or corrupt).
+    _partitions: Set[frozenset] = set()
+
     def __init__(
         self,
         *args,
@@ -205,6 +217,43 @@ class ChaosTransport(Transport):
             return pos
         return None
 
+    # -- scriptable partitions --------------------------------------------
+
+    @staticmethod
+    def _pair(peer_a, peer_b) -> frozenset:
+        return frozenset(
+            ((str(peer_a[0]), int(peer_a[1])), (str(peer_b[0]), int(peer_b[1])))
+        )
+
+    def partition(self, peer_a, peer_b) -> None:
+        """Blackhole traffic between two peer addresses: every call either
+        of them makes to the other fails with OSError before touching the
+        network (both endpoints must run ChaosTransports for both
+        directions to be cut). Unlike a scheduled ``partition`` FaultEvent
+        this is imperative — a scenario script cuts and heals edges at
+        exact protocol points instead of wall-clock windows."""
+        ChaosTransport._partitions.add(self._pair(peer_a, peer_b))
+        log.debug("chaos: partitioned %s <-> %s", tuple(peer_a), tuple(peer_b))
+
+    def heal(self, peer_a=None, peer_b=None) -> None:
+        """Remove one blackholed pair; with a single peer, every partition
+        touching that peer; with no arguments, every partition (scenario
+        teardown)."""
+        if peer_a is None:
+            ChaosTransport._partitions.clear()
+        elif peer_b is None:
+            pa = (str(peer_a[0]), int(peer_a[1]))
+            ChaosTransport._partitions = {
+                p for p in ChaosTransport._partitions if pa not in p
+            }
+        else:
+            ChaosTransport._partitions.discard(self._pair(peer_a, peer_b))
+
+    def _partitioned(self, addr: Addr) -> bool:
+        if not ChaosTransport._partitions:
+            return False
+        return self._pair(self.addr, addr) in ChaosTransport._partitions
+
     async def call(
         self,
         addr: Addr,
@@ -214,6 +263,11 @@ class ChaosTransport(Transport):
         timeout: float = 30.0,
         **kw,
     ):
+        if self._partitioned((str(addr[0]), int(addr[1]))):
+            raise OSError(
+                f"chaos: partitioned link {self.addr} <-> {tuple(addr)} "
+                f"(call {method} dropped)"
+            )
         if self.drop_rate and self._chaos.random() < self.drop_rate:
             raise OSError(f"chaos: dropped call {method} to {addr}")
         if self.delay_s:

@@ -92,6 +92,14 @@ class Coordinator:
                 for m in fresh
                 if m.get("aggregation")
             },
+            # Per-volunteer leader-failover gauges (leaders deposed, rounds
+            # recovered by a successor, recovery latency) — empty until a
+            # volunteer has lived through a leader death.
+            "failover": {
+                m.get("peer", "?"): m["failover"]
+                for m in fresh
+                if m.get("failover")
+            },
         }, b""
 
 

@@ -76,6 +76,9 @@ class PhiAccrualDetector:
     # Latency-EWMA suspicion gates (see class docstring).
     LAT_FACTOR = 8.0
     LAT_FLOOR_S = 1.0
+    # How long a directly-reported connection failure keeps a peer
+    # suspected regardless of phi (see report_failure).
+    FAILURE_HOLD_S = 30.0
 
     def __init__(
         self,
@@ -99,12 +102,18 @@ class PhiAccrualDetector:
         self._gaps: Dict[str, deque] = {}
         # peer -> (current latency EWMA, slow baseline) — see observe_latency.
         self._lat: Dict[str, Tuple[float, float]] = {}
+        # peer -> suspicion-hold expiry from a reported connection failure.
+        self._failed: Dict[str, float] = {}
 
     # -- feeding -----------------------------------------------------------
 
     def heartbeat(self, peer: str, t: Optional[float] = None) -> None:
         """Record one heartbeat ARRIVAL for ``peer`` (local monotonic time)."""
         now = self.clock() if t is None else float(t)
+        # A fresh heartbeat is positive liveness evidence: it clears any
+        # reported-failure hold (the peer restarted/healed) so a recovered
+        # node isn't shut out of formation for the rest of the hold window.
+        self._failed.pop(peer, None)
         last = self._last.get(peer)
         self._last[peer] = now
         if last is None:
@@ -139,6 +148,29 @@ class PhiAccrualDetector:
         fast, slow = entry
         return fast > max(self.LAT_FACTOR * slow, self.LAT_FLOOR_S)
 
+    def report_failure(self, peer: str, hold_s: Optional[float] = None) -> None:
+        """Direct connection-level failure evidence (refused dial, reset
+        socket mid-RPC) — the tertiary suspicion signal. Heartbeats ride
+        the DHT at multi-second cadence, so phi takes seconds to accrue on
+        a peer that just dropped dead; a member that watched the peer's
+        TCP connection die KNOWS, now. Holds the peer suspected for
+        ``hold_s`` (default FAILURE_HOLD_S) regardless of phi, so successor
+        election and formation pre-exclusion see the failure immediately.
+        Cleared early by the next observed heartbeat (the peer healed)."""
+        hold = self.FAILURE_HOLD_S if hold_s is None else float(hold_s)
+        self._failed[peer] = self.clock() + hold
+
+    def failure_reported(self, peer: str, now: Optional[float] = None) -> bool:
+        """Is ``peer`` inside a reported-failure suspicion hold?"""
+        expiry = self._failed.get(peer)
+        if expiry is None:
+            return False
+        now = self.clock() if now is None else float(now)
+        if now >= expiry:
+            del self._failed[peer]
+            return False
+        return True
+
     def forget(self, peer: str) -> None:
         """Drop a peer's history (graceful leave / tombstone): a rejoiner
         starts with a clean distribution instead of inheriting the silence
@@ -146,6 +178,7 @@ class PhiAccrualDetector:
         self._last.pop(peer, None)
         self._gaps.pop(peer, None)
         self._lat.pop(peer, None)
+        self._failed.pop(peer, None)
 
     # -- scoring -----------------------------------------------------------
 
@@ -178,7 +211,11 @@ class PhiAccrualDetector:
         return -math.log10(p_later)
 
     def suspect(self, peer: str, now: Optional[float] = None) -> bool:
-        return self.phi(peer, now) >= self.threshold or self.latency_suspect(peer)
+        return (
+            self.phi(peer, now) >= self.threshold
+            or self.latency_suspect(peer)
+            or self.failure_reported(peer, now)
+        )
 
     def suspected(self, now: Optional[float] = None) -> Dict[str, float]:
         """{peer: phi} for every peer at/above the threshold right now."""
@@ -204,5 +241,6 @@ class PhiAccrualDetector:
                 "mean_gap_s": round(mean, 4) if mean is not None else None,
                 "lat_ewma_ms": round(lat[0] * 1e3, 3) if lat else None,
                 "lat_suspect": self.latency_suspect(peer),
+                "failure_reported": self.failure_reported(peer, now),
             }
         return out

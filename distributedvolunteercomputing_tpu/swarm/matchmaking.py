@@ -57,6 +57,12 @@ class Group:
     # round as already expired (see AveragerBase._deadline_wait).
     budget: Optional[float] = None
     formed_mono: float = dataclasses.field(default_factory=time.monotonic)
+    # Round GENERATION — the fencing token. 0 for the round the matchmaking
+    # leader began; each leader-failover recovery over the same epoch bumps
+    # it. Every sync.contribute/sync.fetch carries it, and handlers reject a
+    # mismatch, so a deposed or partitioned ex-leader's late serve (or a
+    # member's stale push) can never mix into a newer generation's round.
+    gen: int = 0
 
     @property
     def leader_id(self) -> str:
@@ -82,6 +88,7 @@ class Matchmaker:
         *,
         clock: Callable[[], float] = time.time,
         exclude: Optional[Callable[[str], bool]] = None,
+        lead_exclude: Optional[Callable[[str], bool]] = None,
     ):
         self.transport = transport
         self.dht = dht
@@ -91,9 +98,14 @@ class Matchmaker:
         # straggler pre-exclusion predicate (resilience policy / phi
         # detector): a LEADER drops candidates it returns True for when
         # freezing the member list — they stay in the swarm and retry next
-        # round, they just don't gate THIS round.
+        # round, they just don't gate THIS round. ``lead_exclude`` is the
+        # LEADERSHIP exclusion predicate: candidates it flags (recently
+        # deposed as leader, currently suspected) are passed over when
+        # deciding who self-elects, so a flaky peer is not handed the lead
+        # again the moment it reappears.
         self.clock = clock
         self.exclude = exclude
+        self.lead_exclude = lead_exclude
         # Peers dropped from the last led round's member list (stats/tests).
         self.last_preexcluded: List[str] = []
         self._begin_futures: Dict[str, asyncio.Future] = {}
@@ -185,7 +197,9 @@ class Matchmaker:
                 stable = stable_since is not None and time.monotonic() - stable_since >= settle
                 full = len(members) >= max_group
                 if enough and (stable or full):
-                    if members[0][0] == self.peer_id:
+                    # Elect over the same [:max_group] window _lead will
+                    # freeze, so the winner is always in its own group.
+                    if self._pick_leader(members[:max_group]) == self.peer_id:
                         return await self._lead(
                             round_key, members[:max_group],
                             min_group=min_group, round_budget_s=round_budget_s,
@@ -205,6 +219,24 @@ class Matchmaker:
             return None
         finally:
             self._begin_futures.pop(round_key, None)
+
+    def _pick_leader(self, members: List[Tuple[str, Addr]]) -> str:
+        """Who should self-elect for this candidate set: the smallest
+        peer_id the local ``lead_exclude`` predicate does NOT flag, falling
+        back to the plain smallest when every candidate is flagged (a round
+        with a suspect leader beats no round). Purely local and advisory:
+        peers with divergent suspicion may elect different leaders, which
+        yields two distinct epochs (never mixed tensors) and one
+        underfilled round — the members' begin-wins rule resolves it."""
+        if self.lead_exclude is not None:
+            for pid, _ in members:
+                try:
+                    flagged = bool(self.lead_exclude(pid))
+                except Exception:  # noqa: BLE001 — a policy bug must not kill rounds
+                    flagged = False
+                if not flagged:
+                    return pid
+        return members[0][0]
 
     def _group_from_begin(self, begin: dict, round_key: str) -> Optional[Group]:
         members = [(pid, tuple(addr)) for pid, addr in begin["members"]]
@@ -236,6 +268,18 @@ class Matchmaker:
         import uuid
 
         members = self._preexclude(members, min_group)
+        # The protocol's leader slot IS members[0] (Group.leader_id; the
+        # averagers take the leader path iff my_index == 0): rotate
+        # ourselves to the front — we are the one leading — so a
+        # _pick_leader winner that is not the plain smallest id still
+        # produces a coherent group. The rest keep sorted (epoch) order,
+        # which successor election depends on. The epoch hash is computed
+        # over this exact order and travels in the begin, so every member
+        # sees the same rotated list.
+        members = (
+            [m for m in members if m[0] == self.peer_id]
+            + [m for m in members if m[0] != self.peer_id]
+        )
         ids = [pid for pid, _ in members]
         nonce = uuid.uuid4().hex[:8]
         epoch = self._epoch(round_key, ids, nonce)

@@ -102,6 +102,12 @@ class ResiliencePolicy:
         self._consecutive_failures = 0
         self.rounds_seen = 0
         self.rounds_degraded = 0
+        # Leader depositions this node participated in (failover recovery):
+        # each one also counts as an absent outcome against the deposed
+        # peer, so a crash-prone leader accrues the same miss-streak
+        # evidence a straggler does and pre-exclusion (and the matchmaker's
+        # leadership exclusion) fires on it.
+        self.leaders_deposed = 0
         self._method_level = 0
         # One slow round must count ONCE: a peer whose push lands after the
         # commit is seen twice (absent in the commit batch, late on the RPC
@@ -234,6 +240,18 @@ class ResiliencePolicy:
         st.miss_streak += 1
         self._late_noted.add(peer)
 
+    def note_leader_deposed(self, peer: str) -> None:
+        """A round this node was a member of deposed ``peer`` as its leader
+        (connection-level death / suspicion mid-round, recovered by a
+        successor). Counts one absent outcome and advances the miss streak
+        — the same evidence trail any other failure leaves — so
+        ``should_preexclude`` (and the matchmaker's leadership exclusion,
+        which consults it) keeps a crash-looping leader out of the lead."""
+        self.leaders_deposed += 1
+        st = self._peer(peer)
+        st.absent += 1.0
+        st.miss_streak += 1
+
     def record_rejection(self, peer: str) -> None:
         """A contribution dropped at aggregation (bad size/schema/token, or
         flagged as an outlier row by the robust estimator)."""
@@ -288,6 +306,7 @@ class ResiliencePolicy:
             "deadline_s": round(self._deadline, 3),
             "rounds_seen": self.rounds_seen,
             "rounds_degraded": self.rounds_degraded,
+            "leaders_deposed": self.leaders_deposed,
             "consecutive_failures": self._consecutive_failures,
             "method_level": _METHOD_LADDER[self._method_level],
             "peers": {

@@ -990,6 +990,24 @@ class Transport:
         if self._conns.get(addr) is conn:
             del self._conns[addr]
 
+    def drop_peer(self, addr) -> None:
+        """Proactively retire the pooled connection (or in-flight dial) to
+        ``addr``. Failover support: a deposed round leader's socket must
+        stop being a transparent-retry target the instant the deposition is
+        decided — every RPC still multiplexed on it fails NOW with a
+        connection error instead of discovering the corpse one timeout at a
+        time. A later call to the same address dials fresh."""
+        try:
+            addr = (str(addr[0]), int(addr[1]))
+        except (TypeError, ValueError, IndexError):
+            return
+        entry = self._conns.get(addr)
+        if isinstance(entry, _Conn):
+            entry.close()
+        elif isinstance(entry, asyncio.Task):
+            entry.cancel()
+            self._conns.pop(addr, None)
+
     async def _dial(self, addr: Addr, connect_timeout: float) -> "_Conn":
         try:
             reader, writer = await asyncio.wait_for(

@@ -710,6 +710,19 @@ class Volunteer:
                     # mid-run so coord.status sees them before the final
                     # summary lands.
                     report["aggregation"] = dict(self.averager._agg_gauges)
+                failover_stats = getattr(self.averager, "failover_stats", None)
+                if failover_stats is not None:
+                    fo = failover_stats()
+                    if (
+                        fo["leaders_deposed"]
+                        or fo["rounds_recovered"]
+                        or fo["recoveries_failed"]
+                    ):
+                        # Leader-failover gauges (depositions, recovered
+                        # rounds, recovery latency): reported mid-run —
+                        # recovery is exactly the event an operator wants
+                        # to see from coord.status while it happens.
+                        report["failover"] = fo
                 await self.transport.call(caddr, "coord.report", report, timeout=5.0)
             except Exception:
                 # Coordinator reachability is not correctness-critical; with

@@ -1,5 +1,8 @@
 #!/usr/bin/env python
-"""Chaos soak: deadline-bounded averaging vs a x10-delayed straggler.
+"""Chaos soak: deadline-bounded averaging vs a x10-delayed straggler,
+plus the leader-FAILOVER arm (``--failover``): the sync leader killed at
+each instrumented round phase, survivors committing via epoch-fenced
+recovery (ISSUE 5 acceptance).
 
 The resilience layer's proving ground (ISSUE 1 acceptance): a 4-volunteer
 swarm with ONE peer delayed x10 under a seeded fault schedule must
@@ -31,10 +34,22 @@ real matchmaking — the same stack tests/test_averaging.py drives):
 Artifact: experiments/results/chaos_soak.json (committed — the numbers
 quoted in docs/resilience.md come from it).
 
+Failover arm (``--failover``, artifact experiments/results/chaos_failover.json):
+a 4-volunteer swarm (+ a dedicated bootstrap node that never leads) where the
+LEADER is killed — transport torn down mid-round, round task aborted — at each
+of the four instrumented phases (pre_arm, mid_stream, post_partial_commit,
+pre_fetch), N rounds per phase. Survivors must commit via the epoch-fenced
+recovery round (>= 95%), no survivor may stall past 2x the learned deadline
+(+ formation/detection overhead), and a fencing scenario proves a revived
+ex-leader's stale generation-0 serve — and a stale generation-0 push to the
+successor — is rejected.
+
 Usage:
     python experiments/chaos_soak.py                  # full campaign + training
     python experiments/chaos_soak.py --quick          # short campaign, no training
     python experiments/chaos_soak.py --no-train       # campaign only
+    python experiments/chaos_soak.py --failover       # leader-failover campaign
+    python experiments/chaos_soak.py --failover --quick
 """
 
 from __future__ import annotations
@@ -73,7 +88,10 @@ from distributedvolunteercomputing_tpu.swarm.membership import (  # noqa: E402
 from distributedvolunteercomputing_tpu.swarm.resilience import (  # noqa: E402
     ResiliencePolicy,
 )
-from distributedvolunteercomputing_tpu.swarm.transport import Transport  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.transport import (  # noqa: E402
+    RPCError,
+    Transport,
+)
 
 STRAGGLER = "v3"  # sorts last: v0 always leads
 
@@ -309,6 +327,249 @@ async def campaign(args):
     return out
 
 
+# -- leader-failover campaign (ISSUE 5 acceptance) -------------------------
+
+PHASES = ("pre_arm", "mid_stream", "post_partial_commit", "pre_fetch")
+
+
+async def build_failover_swarm(gather_timeout: float):
+    """Bootstrap node (bare DHT, never averages — killing the leader must
+    not take the rendezvous down with it) + 4 volunteers with detector and
+    policy attached, mirroring --resilience production wiring. v0 sorts
+    first and leads every round it joins."""
+    boot_t = Transport()
+    boot_dht = DHTNode(boot_t)
+    await boot_dht.start(bootstrap=None)
+    vols = []
+    for i in range(4):
+        pid = f"v{i}"
+        t = Transport()
+        dht = DHTNode(t)
+        await dht.start(bootstrap=[boot_t.addr])
+        fd = PhiAccrualDetector(bootstrap_s=2.0)
+        policy = ResiliencePolicy(
+            max_deadline_s=gather_timeout, min_deadline_s=1.0,
+            preexclude_misses=3, failure_detector=fd,
+        )
+        mem = SwarmMembership(dht, pid, ttl=10.0, failure_detector=fd)
+        await mem.join()
+        avg = SyncAverager(
+            t, dht, mem,
+            min_group=2, max_group=4,
+            join_timeout=8.0, gather_timeout=gather_timeout,
+            resilience=policy, failure_detector=fd,
+        )
+        vols.append({
+            "pid": pid, "t": t, "dht": dht, "mem": mem, "avg": avg,
+            "fd": fd, "policy": policy,
+        })
+    return (boot_t, boot_dht), vols
+
+
+def _install_kill(vol, phase):
+    async def die():
+        await vol["t"].close()
+        raise RuntimeError("chaos: leader killed")
+
+    vol["avg"]._phase_hooks[phase] = die
+
+
+async def _revive_leader(vols):
+    """Bring v0 back for the next kill round: transport re-opened on the
+    same port, stale round state discarded, and — campaign-only — the
+    survivors' deposition strikes cleared so v0 is handed the lead again
+    (in production the DEPOSED_LEADER_TTL_S strike is exactly what this
+    campaign must bypass to kill the same leader 20 times)."""
+    leader = vols[0]
+    leader["avg"]._phase_hooks.clear()
+    for st in leader["avg"]._rounds.values():
+        if st.stream is not None:
+            st.stream.fence()
+    leader["avg"]._rounds.clear()
+    await leader["t"].start()
+    await leader["mem"].join()  # immediate re-announce
+    for v in vols[1:]:
+        v["avg"]._deposed_leaders.pop("v0", None)
+        v["fd"]._failed.pop("v0", None)
+        v["policy"].peers.pop("v0", None)
+
+
+async def _timed_average(v, i, r):
+    t0 = time.monotonic()
+    try:
+        res = await asyncio.wait_for(
+            v["avg"].average(tree_for(i), round_no=r), timeout=90.0
+        )
+    except BaseException as e:  # noqa: BLE001 — campaign records, never raises
+        return time.monotonic() - t0, e
+    return time.monotonic() - t0, res
+
+
+async def failover_campaign(args):
+    gather_timeout = 8.0
+    out = {
+        "seed": args.seed,
+        "rounds_per_phase": args.failover_rounds,
+        "phases": {},
+    }
+    for phase in PHASES:
+        boot, vols = await build_failover_swarm(gather_timeout)
+        recs = []
+        try:
+            # Healthy warmup: learn deadlines + formation overhead.
+            warm_dts = []
+            for r in range(2):
+                dts = await asyncio.gather(
+                    *(_timed_average(v, i, r) for i, v in enumerate(vols))
+                )
+                assert all(
+                    not isinstance(res, BaseException) and res is not None
+                    for _, res in dts
+                ), f"healthy warmup round {r} failed in phase {phase}"
+                warm_dts.append(max(dt for dt, _ in dts))
+            # Formation + deposition-detection allowance on top of the
+            # 2x-deadline stall bound: matchmaking settle/fan-out rides in
+            # every round, and a follower waits RECOVERY_BEGIN_WAIT_S for
+            # the successor's begin in the worst case.
+            overhead = max(max(warm_dts), 1.0) + SyncAverager.RECOVERY_BEGIN_WAIT_S
+            for k in range(args.failover_rounds):
+                r = 100 + k
+                budget = vols[1]["avg"]._round_budget()
+                rec_before = [v["avg"].rounds_recovered for v in vols[1:]]
+                _install_kill(vols[0], phase)
+                results = await asyncio.gather(
+                    *(_timed_average(v, i, r) for i, v in enumerate(vols))
+                )
+                surv = results[1:]
+                surv_ok = [
+                    res is not None and not isinstance(res, BaseException)
+                    for _, res in surv
+                ]
+                recovered = [
+                    v["avg"].rounds_recovered - b
+                    for v, b in zip(vols[1:], rec_before)
+                ]
+                max_dt = max(dt for dt, _ in surv)
+                recs.append({
+                    "round": k,
+                    "budget_s": round(budget, 3),
+                    "survivors_committed": sum(surv_ok),
+                    "recovered": sum(1 for x in recovered if x > 0),
+                    "committed_via_recovery": all(surv_ok)
+                    and all(x > 0 for x in recovered),
+                    "max_survivor_dt_s": round(max_dt, 3),
+                    "within_stall_bound": max_dt <= 2.0 * budget + overhead,
+                })
+                await _revive_leader(vols)
+                await asyncio.sleep(0.3)  # let the re-announce settle
+        finally:
+            for v in vols:
+                try:
+                    await v["mem"].leave()
+                except Exception:
+                    pass
+                try:
+                    await v["dht"].stop()
+                except Exception:
+                    pass
+                try:
+                    await v["t"].close()
+                except Exception:
+                    pass
+            try:
+                await boot[1].stop()
+            except Exception:
+                pass
+            await boot[0].close()
+        ok = [r for r in recs if r["committed_via_recovery"]]
+        within = [r for r in recs if r["within_stall_bound"]]
+        out["phases"][phase] = {
+            "rounds": len(recs),
+            "committed_via_recovery": len(ok),
+            "recovery_frac": round(len(ok) / max(len(recs), 1), 4),
+            "within_stall_bound": len(within),
+            "overhead_allowance_s": round(overhead, 3),
+            "per_round": recs,
+        }
+        print(f"[failover/{phase}] {len(ok)}/{len(recs)} rounds committed "
+              f"via recovery, {len(within)}/{len(recs)} within stall bound")
+
+    out["fencing"] = await fencing_scenario()
+    print(f"[failover/fencing] stale serve rejected: "
+          f"{out['fencing']['stale_serve_rejected']}, stale push rejected: "
+          f"{out['fencing']['stale_push_rejected']}")
+    return out
+
+
+async def fencing_scenario():
+    """The acceptance fencing proof: leader becomes unreachable mid-round
+    (process alive — it commits a stale generation-0 round), survivors
+    recover at generation 1, the ex-leader heals, and both its stale SERVE
+    and a stale generation-0 PUSH to the successor are rejected."""
+    boot, vols = await build_failover_swarm(8.0)
+    res = {
+        "survivors_recovered": False,
+        "stale_serve_rejected": False,
+        "stale_push_rejected": False,
+    }
+    try:
+        leader = vols[0]
+
+        async def sever():
+            await leader["t"].close()  # unreachable, NOT killed
+
+        leader["avg"]._phase_hooks["mid_stream"] = sever
+        results = await asyncio.gather(
+            *(_timed_average(v, i, 1) for i, v in enumerate(vols))
+        )
+        res["survivors_recovered"] = all(
+            r is not None and not isinstance(r, BaseException)
+            for _, r in results[1:]
+        ) and all(v["avg"].rounds_recovered >= 1 for v in vols[1:])
+        await leader["t"].start()  # heal
+        stale = [e for e, st in leader["avg"]._rounds.items() if st.gen == 0]
+        successor = vols[1]
+        cur = [e for e, st in successor["avg"]._rounds.items() if st.gen == 1]
+        if stale:
+            try:
+                await vols[2]["t"].call(
+                    leader["t"].addr, "sync.fetch",
+                    {"epoch": stale[0], "fence": 1}, timeout=10.0,
+                )
+            except RPCError as e:
+                res["stale_serve_rejected"] = "fencing mismatch" in str(e)
+        if cur:
+            try:
+                await vols[2]["t"].call(
+                    successor["t"].addr, "sync.contribute",
+                    {"epoch": cur[0], "fence": 0, "peer": "v2", "weight": 1.0,
+                     "token": "stale", "schema": successor["avg"]._schema},
+                    b"\x00" * 8, timeout=10.0,
+                )
+            except RPCError as e:
+                res["stale_push_rejected"] = "fencing mismatch" in str(e)
+    finally:
+        for v in vols:
+            try:
+                await v["mem"].leave()
+            except Exception:
+                pass
+            try:
+                await v["dht"].stop()
+            except Exception:
+                pass
+            try:
+                await v["t"].close()
+            except Exception:
+                pass
+        try:
+            await boot[1].stop()
+        except Exception:
+            pass
+        await boot[0].close()
+    return res
+
+
 # -- training phase (subprocess volunteers, real entrypoints) --------------
 
 
@@ -401,14 +662,49 @@ def main():
     ap.add_argument("--no-train", action="store_true")
     ap.add_argument("--quick", action="store_true",
                     help="short campaign, no training phase")
-    ap.add_argument("--out", default=os.path.join(
-        REPO, "experiments", "results", "chaos_soak.json"))
+    ap.add_argument("--failover", action="store_true",
+                    help="run the leader-failover arm instead (kill-at-phase "
+                         "matrix + fencing scenario)")
+    ap.add_argument("--failover-rounds", type=int, default=20,
+                    help="kill rounds per phase in the failover arm")
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    if args.out is None:
+        args.out = os.path.join(
+            REPO, "experiments", "results",
+            "chaos_failover.json" if args.failover else "chaos_soak.json",
+        )
     if args.quick:
         args.warmup_rounds = 6
         args.faulted_rounds = 10
         args.blocking_rounds = 3
+        args.failover_rounds = 5
         args.no_train = True
+
+    if args.failover:
+        result = {"failover_campaign": asyncio.run(failover_campaign(args))}
+        fc = result["failover_campaign"]
+        fracs = [p["recovery_frac"] for p in fc["phases"].values()]
+        stall_ok = all(
+            p["within_stall_bound"] == p["rounds"] for p in fc["phases"].values()
+        )
+        result["verdict"] = {
+            "recovery_frac_min": min(fracs),
+            "pass_95pct_recovery": min(fracs) >= 0.95,
+            "pass_stall_bound": stall_ok,
+            "pass_fencing": (
+                fc["fencing"]["survivors_recovered"]
+                and fc["fencing"]["stale_serve_rejected"]
+                and fc["fencing"]["stale_push_rejected"]
+            ),
+        }
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[done] artifact -> {args.out}")
+        print(json.dumps(result["verdict"], indent=2))
+        ok = all(v for k, v in result["verdict"].items() if k.startswith("pass_"))
+        sys.exit(0 if ok else 1)
 
     result = {"campaign": asyncio.run(campaign(args))}
     if not args.no_train:

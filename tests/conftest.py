@@ -45,6 +45,13 @@ def pytest_configure(config):
         "request sinks, streaming<->dense equivalence, bench smoke) — in "
         "the default lane, and selectable on their own with -m aggregation",
     )
+    config.addinivalue_line(
+        "markers",
+        "failover: leader-failover tests (epoch fencing, successor "
+        "election, recovery rounds, kill-at-phase matrix, leader-kill "
+        "chaos smoke) — in the default lane, and selectable on their own "
+        "with -m failover",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

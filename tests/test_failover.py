@@ -1,0 +1,491 @@
+"""Leader failover tests: epoch fencing, deterministic successor election,
+recovery rounds over retained contributions, dead-leader fast-fail latency,
+scriptable partitions, and the default-suite leader-kill chaos smoke.
+
+In-process swarms over real localhost TCP (the test_averaging.py harness
+shape); "kill" = abruptly closing the leader's transport mid-round — every
+socket it owns resets and its own round task dies where it stands, the
+in-process twin of SIGKILL (the subprocess SIGKILL matrix lives in
+tests/test_failover_e2e.py, slow lane).
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu import native
+from distributedvolunteercomputing_tpu.swarm.agg_stream import StreamingAggregator
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+from distributedvolunteercomputing_tpu.swarm.chaos import ChaosTransport
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.failure_detector import PhiAccrualDetector
+from distributedvolunteercomputing_tpu.swarm.matchmaking import Matchmaker
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer
+
+pytestmark = pytest.mark.failover
+
+
+def run(coro, timeout=90):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def make_tree(value: float):
+    return {"w": np.full((64,), value, np.float32)}
+
+
+async def spawn(n, *, with_detector=False, transport_cls=Transport, **avg_kw):
+    """n in-process volunteers; vol0 is the DHT bootstrap (and, sorting
+    first, the leader of every round it joins)."""
+    vols = []
+    boot = None
+    kw = {"join_timeout": 6.0, "gather_timeout": 8.0, "min_group": 2, **avg_kw}
+    for i in range(n):
+        t = transport_cls()
+        dht = DHTNode(t)
+        await dht.start(bootstrap=[boot] if boot else None)
+        if boot is None:
+            boot = t.addr
+        mem = SwarmMembership(dht, f"vol{i}", ttl=10.0)
+        await mem.join()
+        fd = PhiAccrualDetector(bootstrap_s=2.0) if with_detector else None
+        avg = SyncAverager(t, dht, mem, failure_detector=fd, **kw)
+        vols.append({"t": t, "dht": dht, "mem": mem, "avg": avg, "fd": fd})
+    return vols
+
+
+async def teardown(vols):
+    for v in vols:
+        try:
+            await v["mem"].leave()
+        except Exception:
+            pass
+        try:
+            await v["t"].close()
+        except Exception:
+            pass
+
+
+def install_kill(vol, phase):
+    """Leader dies at the named round phase: transport torn down (sockets
+    reset, parked member fetches fail) and its round task aborted."""
+
+    async def die():
+        await vol["t"].close()
+        raise RuntimeError("chaos: leader killed")
+
+    vol["avg"]._phase_hooks[phase] = die
+
+
+async def kill_round(vols, round_no=1, trees=None):
+    if trees is None:
+        trees = [make_tree(float(i)) for i in range(len(vols))]
+    return await asyncio.gather(
+        *(
+            v["avg"].average(trees[i], round_no=round_no)
+            for i, v in enumerate(vols)
+        ),
+        return_exceptions=True,
+    )
+
+
+class TestKillAtPhase:
+    @pytest.mark.parametrize("phase", SyncAverager.LEADER_PHASES)
+    def test_survivors_commit_via_recovery(self, phase):
+        """The full matrix: leader killed at each round phase; survivors
+        must depose it, promote the deterministic successor, and commit a
+        recovery round over their retained contributions."""
+
+        async def main():
+            vols = await spawn(3)
+            install_kill(vols[0], phase)
+            try:
+                results = await kill_round(vols)
+            finally:
+                await teardown(vols)
+            return vols, results
+
+        vols, results = run(main())
+        assert isinstance(results[0], RuntimeError)  # the kill itself
+        for i in (1, 2):
+            r = results[i]
+            assert not isinstance(r, BaseException), f"vol{i}: {r!r}"
+            assert r is not None, f"vol{i} skipped instead of recovering"
+            # Recovery re-aggregates over the SURVIVORS only (the dead
+            # leader's contribution never re-pushes): mean(1.0, 2.0).
+            np.testing.assert_allclose(r["w"], 1.5, rtol=1e-6)
+            fo = vols[i]["avg"].failover_stats()
+            assert fo["leaders_deposed"] == 1
+            assert fo["rounds_recovered"] == 1
+            assert fo["recoveries_failed"] == 0
+            assert fo["recovery_latency_s_last"] is not None
+            assert "failover" in vols[i]["avg"].stats()
+            # Leadership strike: the deposed leader is excluded from the
+            # lead (and from rounds it would lead) while the strike is hot.
+            assert vols[i]["avg"]._recently_deposed("vol0")
+            assert vols[i]["avg"]._lead_excluded("vol0")
+
+    def test_ef_residual_bitwise_across_recovered_round(self):
+        """EF-state integrity across a recovered round (topk wire): the
+        recovery re-pushes the RETAINED wire bytes — no recompression — so
+        the committed residual must be bit-identical to
+        (local grad) - (what the retained wire shipped), staged exactly
+        once."""
+
+        async def main():
+            vols = await spawn(3, wire="topk", topk_frac=0.25)
+            install_kill(vols[0], "mid_stream")
+            trees = [make_tree(float(i) + 0.5) for i in range(3)]
+            # Varied magnitudes so top-k support is deterministic-by-value.
+            for i, tr in enumerate(trees):
+                tr["w"] *= np.linspace(1.0, 2.0, tr["w"].size, dtype=np.float32)
+            try:
+                results = await kill_round(vols, trees=trees)
+            finally:
+                await teardown(vols)
+            return vols, trees, results
+
+        vols, trees, results = run(main())
+        for i in (1, 2):
+            assert results[i] is not None and not isinstance(
+                results[i], BaseException
+            )
+            avg = vols[i]["avg"]
+            assert avg.rounds_recovered == 1
+            buf, _, _ = flatten_to_buffer(trees[i])
+            wire = native.topk_encode(buf, frac=0.25)
+            sent = native.topk_decode(wire, max_floats=buf.size)
+            expected_residual = buf - sent
+            assert avg._ef_residual is not None
+            assert np.array_equal(avg._ef_residual, expected_residual)
+
+    @pytest.mark.chaos
+    def test_leader_kill_smoke(self):
+        """Default-suite chaos smoke (the transport/aggregation bench-smoke
+        pattern): ONE seeded leader-kill round must commit via recovery —
+        fails loudly on hang (outer wait_for) or non-recovery."""
+
+        async def main():
+            vols = await spawn(3)
+            install_kill(vols[0], "mid_stream")
+            try:
+                results = await kill_round(vols)
+            finally:
+                await teardown(vols)
+            return vols, results
+
+        vols, results = run(main(), timeout=60)
+        survivors_ok = [
+            r for r in results[1:]
+            if r is not None and not isinstance(r, BaseException)
+        ]
+        assert len(survivors_ok) == 2, f"non-recovery: {results!r}"
+        assert all(v["avg"].rounds_recovered == 1 for v in vols[1:])
+
+
+class TestFencing:
+    def test_stale_generation_push_and_fetch_rejected(self):
+        """After a recovery, the successor's round state is fenced at
+        generation 1: a push or fetch still carrying generation 0 (a stale
+        member, or traffic meant for the deposed leader) is rejected."""
+
+        async def main():
+            vols = await spawn(3)
+            install_kill(vols[0], "pre_fetch")
+            results = await kill_round(vols)
+            assert all(
+                r is not None and not isinstance(r, BaseException)
+                for r in results[1:]
+            )
+            successor = vols[1]["avg"]
+            epoch = next(iter(successor._rounds))
+            assert successor._rounds[epoch].gen == 1
+            probe = vols[2]["t"]
+            with pytest.raises(RPCError, match="fencing mismatch"):
+                await probe.call(
+                    vols[1]["t"].addr, "sync.fetch",
+                    {"epoch": epoch, "fence": 0}, timeout=5.0,
+                )
+            with pytest.raises(RPCError, match="fencing mismatch"):
+                await probe.call(
+                    vols[1]["t"].addr, "sync.contribute",
+                    {"epoch": epoch, "fence": 0, "peer": "vol2",
+                     "weight": 1.0, "token": "whatever",
+                     "schema": successor._schema},
+                    b"\x00" * 8, timeout=5.0,
+                )
+            await teardown(vols)
+
+        run(main())
+
+    def test_revived_ex_leader_stale_serve_rejected(self):
+        """The acceptance fencing scenario: the leader becomes unreachable
+        mid-round (its transport torn down) but its PROCESS keeps running —
+        it commits its own generation-0 round over whatever arrived — while
+        the survivors depose it and recover at generation 1. Once the
+        ex-leader heals (transport re-opened on the same port, stale round
+        state intact), its stale serve for the old generation is rejected,
+        never adopted."""
+
+        async def main():
+            vols = await spawn(3)
+            leader, v1, v2 = vols
+
+            async def sever():
+                # Unreachable, NOT killed: no exception — the ex-leader's
+                # round runs on to a stale generation-0 commit.
+                await leader["t"].close()
+
+            leader["avg"]._phase_hooks["mid_stream"] = sever
+            try:
+                results = await kill_round(vols)
+                # Survivors recovered at generation 1; the ex-leader
+                # committed its own stale round (result or None, either is
+                # fine — nobody can fetch it).
+                for i in (1, 2):
+                    assert results[i] is not None and not isinstance(
+                        results[i], BaseException
+                    ), f"vol{i}: {results[i]!r}"
+                    assert vols[i]["avg"].rounds_recovered == 1
+                # Heal: same port, same averager, same stale round state.
+                await leader["t"].start()
+                epoch = next(iter(leader["avg"]._rounds))
+                assert leader["avg"]._rounds[epoch].gen == 0
+                t0 = time.monotonic()
+                with pytest.raises(RPCError, match="fencing mismatch"):
+                    await v2["t"].call(
+                        leader["t"].addr, "sync.fetch",
+                        {"epoch": epoch, "fence": 1}, timeout=10.0,
+                    )
+                assert time.monotonic() - t0 < 5.0  # no result_ready parking
+            finally:
+                await teardown(vols)
+
+        run(main())
+
+    def test_recover_begin_generations_only_advance(self):
+        """Per epoch, ACCEPTED generations only ever advance: an
+        unvalidated begin parks without consuming the epoch's generation
+        budget (a shape-valid forgery at the cap must not block the
+        genuine successor — review fix), while begins at or below an
+        accepted generation, and begins past the cap, are refused."""
+
+        async def main():
+            vols = await spawn(2)
+            avg = vols[1]["avg"]
+            try:
+                ok, _ = await vols[0]["t"].call(
+                    vols[1]["t"].addr, "sync.recover",
+                    {"epoch": "e1",
+                     "gen": SyncAverager.MAX_RECOVERY_GEN,
+                     "members": [], "token": "t"},
+                )
+                assert ok["ok"]
+                # Parked, NOT accepted: the fence state is untouched, so
+                # the real successor's lower generation can still land.
+                assert "e1" not in avg._epoch_gen
+                ok, _ = await vols[0]["t"].call(
+                    vols[1]["t"].addr, "sync.recover",
+                    {"epoch": "e1", "gen": 1, "members": [], "token": "t"},
+                )
+                assert ok["ok"]
+                # Once a generation IS accepted (validated follow / own
+                # lead), older-or-equal begins are refused.
+                avg._record_epoch_gen("e1", 2)
+                for stale_gen in (1, 2):
+                    with pytest.raises(RPCError, match="stale recovery begin"):
+                        await vols[0]["t"].call(
+                            vols[1]["t"].addr, "sync.recover",
+                            {"epoch": "e1", "gen": stale_gen,
+                             "members": [], "token": "t"},
+                        )
+                with pytest.raises(RPCError, match="malformed recovery begin"):
+                    await vols[0]["t"].call(
+                        vols[1]["t"].addr, "sync.recover",
+                        {"epoch": "e2",
+                         "gen": SyncAverager.MAX_RECOVERY_GEN + 1,
+                         "members": [], "token": "t"},
+                    )
+            finally:
+                await teardown(vols)
+
+        run(main())
+
+
+class TestFastFail:
+    def test_dead_leader_fast_fail_latency(self):
+        """Satellite regression: a member whose leader's connection is
+        refused outright must fail (or recover) in connection-error time —
+        NOT outwait the gather deadline plus the off-loop aggregation
+        grace (8 + 30 + 6 s here)."""
+
+        async def main():
+            # 2 volunteers: after the leader dies there is 1 survivor <
+            # min_group, so recovery correctly refuses and the round fails
+            # — the point is how FAST it fails.
+            vols = await spawn(2)
+            install_kill(vols[0], "pre_arm")
+            t0 = time.monotonic()
+            results = await kill_round(vols)
+            dt = time.monotonic() - t0
+            await teardown(vols)
+            return vols, results, dt
+
+        vols, results, dt = run(main())
+        assert results[1] is None  # skipped, not hung
+        # Formation (~1s) + connection-refused (+one transparent redial)
+        # + unrecoverable-verdict: well under the old worst case of
+        # deadline_wait + AGGREGATION_HEADROOM + margin (> 40 s).
+        assert dt < 15.0, f"dead-leader skip took {dt:.1f}s"
+        fo = vols[1]["avg"].failover_stats()
+        assert fo["leaders_deposed"] == 1
+        assert fo["recoveries_failed"] == 1
+        assert fo["rounds_recovered"] == 0
+
+
+class TestElection:
+    def test_successor_order_skips_suspected(self):
+        """Deterministic successor: next live member in epoch (sorted-id)
+        order, skipping locally-suspected peers, never skipping self."""
+        fd = PhiAccrualDetector()
+        t = Transport()
+        dht = DHTNode(t)
+        mem = SwarmMembership(dht, "z9")
+        avg = SyncAverager(t, dht, mem, failure_detector=fd)
+        survivors = [("a1", ("h", 1)), ("b2", ("h", 2)), ("z9", ("h", 3))]
+        assert avg._successor(survivors) == "a1"
+        fd.report_failure("a1")
+        assert avg._successor(survivors) == "b2"
+        fd.report_failure("b2")
+        assert avg._successor(survivors) == "z9"  # self: never skipped
+        # Self not in the list and everyone suspected: plain first survivor.
+        assert avg._successor(survivors[:2]) == "a1"
+
+    def test_matchmaker_pick_leader_consults_exclusion(self):
+        flagged = {"a1"}
+        t = Transport()
+        dht = DHTNode(t)
+        mm = Matchmaker(t, dht, "b2", lead_exclude=lambda pid: pid in flagged)
+        members = [("a1", ("h", 1)), ("b2", ("h", 2)), ("c3", ("h", 3))]
+        assert mm._pick_leader(members) == "b2"
+        flagged.update({"b2", "c3"})
+        # Every candidate flagged: fall back to the plain smallest (a round
+        # with a suspect leader beats no round).
+        assert mm._pick_leader(members) == "a1"
+        t2 = Transport()
+        mm_plain = Matchmaker(t2, DHTNode(t2), "b2")
+        assert mm_plain._pick_leader(members) == "a1"
+
+    def test_elected_leader_rotates_to_front(self):
+        """When exclusion elects a non-smallest leader, the frozen group
+        puts the WINNER at members[0] — the protocol's leader slot — on
+        both sides (review fix: without the rotation the winner took the
+        member path and pushed to the very peer it had excluded)."""
+
+        async def main():
+            ta, tb = Transport(), Transport()
+            await ta.start()
+            await tb.start()
+            dhta, dhtb = DHTNode(ta), DHTNode(tb)
+            await dhta.start(bootstrap=None)
+            await dhtb.start(bootstrap=[ta.addr])
+            # Both sides flag 'mA' (the plain-smallest id) for leadership.
+            ma = Matchmaker(ta, dhta, "mA", lead_exclude=lambda p: p == "mA")
+            mb = Matchmaker(tb, dhtb, "mB", lead_exclude=lambda p: p == "mA")
+            try:
+                ga, gb = await asyncio.gather(
+                    ma.form_group("avg/rot", 2, 4, join_timeout=8.0),
+                    mb.form_group("avg/rot", 2, 4, join_timeout=8.0),
+                )
+                assert ga is not None and gb is not None
+                for g in (ga, gb):
+                    assert g.leader_id == "mB"
+                    assert [p for p, _ in g.members] == ["mB", "mA"]
+                assert gb.my_index == 0 and ga.my_index == 1
+                assert ga.epoch == gb.epoch
+            finally:
+                await dhta.stop()
+                await dhtb.stop()
+                await ta.close()
+                await tb.close()
+
+        run(main())
+
+    def test_deposed_strike_expires(self):
+        t = Transport()
+        dht = DHTNode(t)
+        mem = SwarmMembership(dht, "me")
+        avg = SyncAverager(t, dht, mem)
+        avg._deposed_leaders["flaky"] = time.monotonic() - (
+            avg.DEPOSED_LEADER_TTL_S + 1.0
+        )
+        assert not avg._recently_deposed("flaky")
+        assert "flaky" not in avg._deposed_leaders  # lazily evicted
+
+
+class TestPartitionHelpers:
+    def test_partition_and_heal(self):
+        """ChaosTransport.partition/heal blackholes exactly the named pair,
+        both directions, and composes with the rest of the chaos hooks."""
+
+        async def main():
+            a, b, c = ChaosTransport(), ChaosTransport(), ChaosTransport()
+            for t in (a, b, c):
+                await t.start()
+
+                async def echo(args, payload):
+                    return {"ok": True}, payload
+
+                t.register("echo", echo)
+            try:
+                _, pl = await a.call(b.addr, "echo", {}, b"hi")
+                assert bytes(pl) == b"hi"
+                a.partition(a.addr, b.addr)
+                with pytest.raises(OSError, match="partitioned"):
+                    await a.call(b.addr, "echo", {}, b"hi", timeout=3.0)
+                # Symmetric: b's outbound half of the same edge is cut too.
+                with pytest.raises(OSError, match="partitioned"):
+                    await b.call(a.addr, "echo", {}, b"yo", timeout=3.0)
+                # Other edges unaffected.
+                _, pl = await a.call(c.addr, "echo", {}, b"ok")
+                assert bytes(pl) == b"ok"
+                a.heal(a.addr, b.addr)
+                _, pl = await a.call(b.addr, "echo", {}, b"again")
+                assert bytes(pl) == b"again"
+                # One-arg heal: every partition touching that peer.
+                a.partition(a.addr, b.addr)
+                a.partition(a.addr, c.addr)
+                a.heal(a.addr)
+                _, pl = await a.call(b.addr, "echo", {}, b"1")
+                assert bytes(pl) == b"1"
+                _, pl = await a.call(c.addr, "echo", {}, b"2")
+                assert bytes(pl) == b"2"
+            finally:
+                a.heal()
+                for t in (a, b, c):
+                    await t.close()
+
+        run(main())
+
+
+class TestAggregatorFence:
+    def test_fence_drops_late_chunks(self):
+        """A fenced (superseded-generation) aggregator counts late chunks
+        instead of folding them — stale sinks flushing after a failover
+        re-arm cannot corrupt anything."""
+        agg = StreamingAggregator(
+            n_elems=1024, slots=["a", "b"], method="mean", wire="f32",
+            chunk_bytes=1024,
+        )
+        data = np.arange(256, dtype=np.float32).tobytes()
+        agg.add_chunk(0, 1.0, 0, data)
+        assert agg.progress() == {"a": 256, "b": 0}
+        agg.fence()
+        agg.add_chunk(0, 1.0, 1024, data)
+        g = agg.gauges()
+        assert g["fenced"] is True
+        assert g["chunks_after_fence"] == 1
+        assert agg.progress() == {"a": 256, "b": 0}  # nothing folded late
