@@ -72,10 +72,15 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from distributedvolunteercomputing_tpu import native
+from distributedvolunteercomputing_tpu.ops import mesh_codec as mesh_codec_mod
 from distributedvolunteercomputing_tpu.ops import robust
 from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
+
+# Sentinel job queued alongside window-closure tuples: "flush the mesh
+# mean folder's staged chunks on a worker" (see _spawn_jobs).
+_FLUSH = object()
 
 
 class TilePool:
@@ -191,6 +196,7 @@ class StreamingAggregator:
         chunk_bytes: int,
         kw_fn: Optional[Callable[[int], dict]] = None,
         pool: Optional[TilePool] = None,
+        codec: Optional[mesh_codec_mod.MeshCodec] = None,
     ):
         if wire not in ("f32", "bf16"):
             raise ValueError(f"streaming aggregation needs an elementwise wire, got {wire!r}")
@@ -221,8 +227,6 @@ class StreamingAggregator:
         self._committed_tiles = np.zeros(n, np.int64)  # tiles folded per slot
         self._tasks: List[asyncio.Task] = []
 
-        # The committed/result buffer is O(D) and exists in every mode.
-        self._out = np.zeros(self.n_elems, np.float32)
         self._tile_w: Optional[np.ndarray] = None
         self._windows: Dict[int, _Window] = {}
         self._win_done = np.zeros(self.n_tiles, bool)
@@ -235,10 +239,32 @@ class StreamingAggregator:
         self._rows: Dict[int, np.ndarray] = {}
         self._d2: Optional[np.ndarray] = None
         self._tile_sealed: Dict[int, List[int]] = {}
+        # On-mesh data path: window folds and the mean accumulator run on
+        # the volunteer's local device mesh when the codec is active; the
+        # host numpy path is both the default (CPU platform) and the
+        # degraded-slice fallback (ops.mesh_codec module doc).
+        self.codec = codec if codec is not None else mesh_codec_mod.get_default()
+        self._folder: Optional[mesh_codec_mod.MeshMeanFolder] = None
+        self.folder_flushes = 0
+        # Folder staged-bytes high-water, captured before the folder is
+        # dropped (summed into the peak gauge: staged raw chunks are real
+        # resident memory beside the accumulator).
+        self._folder_staged_peak = 0
         if self.mode == "mean":
             self._tile_w = np.zeros(self.n_tiles, np.float64)
+            self._folder = self.codec.mean_folder(
+                self.n_elems, self.tile_elems, self.n_tiles, wire
+            )
         elif self.mode == "d2_dense":
             self._d2 = np.zeros((n, n), np.float64)
+        # The committed/result buffer is O(D) — except in mean+folder mode,
+        # where the DEVICE accumulator plays that role until finalize pulls
+        # it: an eager host zeros there would be O(D) counted-but-never-
+        # written memory.
+        self._out = (
+            np.zeros(0, np.float32) if self._folder is not None
+            else np.zeros(self.n_elems, np.float32)
+        )
 
         # -- gauges (surfaced via Averager.stats()/volunteer summary) ------
         self.t0 = time.monotonic()
@@ -255,6 +281,10 @@ class StreamingAggregator:
         self.fenced = False
         self.chunks_after_fence = 0
         self._held = self._out.nbytes
+        if self._folder is not None:
+            # The device-resident accumulator counts against the round's
+            # held bytes like any other O(D) state.
+            self._held += self._folder.device_bytes
         self.peak_bytes_held = self._held
 
     # -- memory accounting --------------------------------------------------
@@ -353,8 +383,15 @@ class StreamingAggregator:
             self._filled[slot] = e0 + n
             t0 = time.perf_counter()
             if self.mode == "mean":
-                x = self._decode(data)
-                native.weighted_sum_inplace(self._out[e0 : e0 + n], x, weight)
+                if self._folder is not None:
+                    # On-mesh: stage the RAW wire bytes (no decode on the
+                    # frame-reader thread); a worker flushes staged batches
+                    # through one fused device decode+scatter-add.
+                    if self._folder.add(tile, weight, data):
+                        fire.append(_FLUSH)
+                else:
+                    x = self._decode(data)
+                    native.weighted_sum_inplace(self._out[e0 : e0 + n], x, weight)
                 self._tile_w[tile] += weight
                 self._committed_tiles[slot] += 1
                 self.tiles_early += 1  # folded while the push was in flight
@@ -367,8 +404,20 @@ class StreamingAggregator:
                 if self.mode == "d2_dense":
                     self._accumulate_d2(slot, tile, e0, e0 + n)
             self.busy_s += time.perf_counter() - t0
-        for t, w, r in fire:
-            self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
+        self._spawn_jobs(fire)
+
+    def _spawn_jobs(self, fire: List) -> None:
+        """Spawn queued aggregation work OUTSIDE the lock: window-closure
+        tuples from _fire_locked, or the _FLUSH sentinel for the mesh mean
+        folder."""
+        folder = self._folder
+        for job in fire:
+            if job is _FLUSH:
+                if folder is not None:  # raced a release(): nothing to flush
+                    self._spawn(folder.flush)
+            else:
+                t, w, r = job
+                self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
 
     def add_dense(self, peer: str, weight: float, buf: np.ndarray) -> bool:
         """Fold a complete dense contribution (the leader's own, a parked
@@ -384,7 +433,12 @@ class StreamingAggregator:
                 return False
             t0 = time.perf_counter()
             if self.mode == "mean":
-                native.weighted_sum_inplace(self._out, np.ascontiguousarray(buf, np.float32), w)
+                if self._folder is not None:
+                    self._folder.add_dense(buf, w)
+                else:
+                    native.weighted_sum_inplace(
+                        self._out, np.ascontiguousarray(buf, np.float32), w
+                    )
                 self._tile_w += w
                 self._committed_tiles[slot] += self.n_tiles
             elif self.mode == "window":
@@ -421,8 +475,7 @@ class StreamingAggregator:
             self._sealed.add(slot)
             self._weights[slot] = w
             self.dense_contribs += 1
-        for t, w, r in fire:
-            self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
+        self._spawn_jobs(fire)
         return True
 
     def seal_slot(self, slot: int) -> bool:
@@ -480,8 +533,7 @@ class StreamingAggregator:
                 if self._d2 is not None:
                     self._d2[slot, :] = 0.0
                     self._d2[:, slot] = 0.0
-        for t, w, r in fire:
-            self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
+        self._spawn_jobs(fire)
 
     # -- internals ------------------------------------------------------------
 
@@ -562,7 +614,9 @@ class StreamingAggregator:
                     len(self.slots), self.tile_elems
                 )[rows, :n]
                 kw = self._kw_fn(rows.size)
-                self._out[e0 : e0 + n] = robust.aggregate(
+                # On-mesh window fold when the codec is active (sorting
+                # network over the peer axis); ops.robust numpy otherwise.
+                self._out[e0 : e0 + n] = self.codec.aggregate(
                     np.ascontiguousarray(stack), self.method, **kw
                 )
         finally:
@@ -679,8 +733,7 @@ class StreamingAggregator:
                     self._windows.pop(tile, None)
                     self._note_free(win.buf.nbytes)
                     self.pool.put(win.buf)
-        for t, w, r in leftovers:
-            self._spawn(lambda tt=t, ww=w, rr=r: self._aggregate_window(tt, ww, rr))
+        self._spawn_jobs(leftovers)
         if self._tasks:
             results = await asyncio.gather(*self._tasks, return_exceptions=True)
             self._tasks.clear()
@@ -695,6 +748,13 @@ class StreamingAggregator:
         t0 = time.perf_counter()
         try:
             if self.mode == "mean":
+                if self._folder is not None:
+                    # Pull the device accumulator (tail chunks flushed);
+                    # re-normalization below is shared with the host path.
+                    self._out = np.ascontiguousarray(
+                        self._folder.result(), np.float32
+                    )
+                    self.folder_flushes = self._folder.flushes
                 # Per-tile re-normalization by the weight that ARRIVED: the
                 # deadline-commit re-weighting, applied at tile granularity.
                 for tile in range(self.n_tiles):
@@ -718,7 +778,7 @@ class StreamingAggregator:
                         stack = np.stack(
                             [self._resident[s][e0 : e0 + n] for s in rows]
                         )
-                        self._out[e0 : e0 + n] = robust.aggregate(
+                        self._out[e0 : e0 + n] = self.codec.aggregate(
                             stack, self.method, **self._kw_fn(len(rows))
                         )
                         self._win_done[tile] = True
@@ -738,7 +798,7 @@ class StreamingAggregator:
             kw = self._kw_fn(len(slots))
             if self.mode == "d2_dense" and self._d2 is not None:
                 kw = dict(kw, d2=self._d2[np.ix_(slots, slots)].astype(np.float32))
-            self._out = robust.aggregate(stack, self.method, **kw)
+            self._out = self.codec.aggregate(stack, self.method, **kw)
             return self._out
         finally:
             self.busy_s += time.perf_counter() - t0
@@ -756,12 +816,28 @@ class StreamingAggregator:
                 self.pool.put(row)
             self._rows.clear()
             self._resident.clear()  # borrowed references: just drop them
+            if self._folder is not None:
+                # Device accumulator freed with the round (committed rounds
+                # already pulled result(); failed/fenced ones abandon it).
+                self._folder_staged_peak = max(
+                    self._folder_staged_peak, self._folder.peak_staged_bytes
+                )
+                self._note_free(self._folder.device_bytes)
+                self._folder = None
 
     def gauges(self) -> dict:
         wall = max(time.monotonic() - self.t0, 1e-9)
+        folder = self._folder
+        staged_peak = max(
+            self._folder_staged_peak,
+            folder.peak_staged_bytes if folder is not None else 0,
+        )
         return {
             "mode": self.mode,
-            "peak_bytes_held": int(self.peak_bytes_held),
+            # Accumulator/window/row high-water PLUS the mesh folder's
+            # staged raw-chunk high-water (summed peaks: a slight
+            # over-count of the true concurrent peak, never an under-count).
+            "peak_bytes_held": int(self.peak_bytes_held + staged_peak),
             "tiles_early": int(self.tiles_early),
             "tiles_deadline": int(self.tiles_deadline),
             "agg_busy_s": round(self.busy_s, 6),
@@ -771,4 +847,8 @@ class StreamingAggregator:
             "aborted_contribs": int(self.aborted_contribs),
             "fenced": bool(self.fenced),
             "chunks_after_fence": int(self.chunks_after_fence),
+            # On-mesh data path: which backend folded this round (may read
+            # "host" after a mid-round degrade — that IS the signal).
+            "codec_backend": self.codec.backend,
+            "folder_flushes": int(self.folder_flushes),
         }

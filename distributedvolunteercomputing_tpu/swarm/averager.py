@@ -62,6 +62,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from distributedvolunteercomputing_tpu import native
+from distributedvolunteercomputing_tpu.ops import mesh_codec as mesh_codec_mod
 from distributedvolunteercomputing_tpu.ops import robust
 from distributedvolunteercomputing_tpu.swarm.agg_stream import StreamingAggregator
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
@@ -181,6 +182,7 @@ class AveragerBase:
         round_deadline_s: Optional[float] = None,
         resilience=None,
         failure_detector=None,
+        mesh_codec=None,
     ):
         if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -310,6 +312,12 @@ class AveragerBase:
         # the schema hash, so mixed-wire swarms reject each other's rounds
         # instead of mis-decoding bytes.
         self.wire = wire
+        # On-mesh data path (ops.mesh_codec): bf16 pack/unpack, PowerSGD
+        # matmuls, and the leader's tile folds run on this volunteer's
+        # local device mesh when the codec is active; None = the process
+        # default, selected once at volunteer startup and surfaced in
+        # stats()["mesh_codec"].
+        self._mesh_codec = mesh_codec
         self._specs = None
         self._treedef = None
         self._schema: Optional[str] = None
@@ -657,6 +665,14 @@ class AveragerBase:
         # early-arriving contribution from a faster peer is normal).
         return self._schema is None or args.get("schema") == self._schema
 
+    @property
+    def mesh_codec(self) -> mesh_codec_mod.MeshCodec:
+        """This averager's on-mesh codec: the injected one, or the process
+        default (resolved LAZILY so a volunteer that configures the default
+        after constructing its averager is still honored)."""
+        mc = self._mesh_codec
+        return mc if mc is not None else mesh_codec_mod.get_default()
+
     def _psgd(self):
         """The PowerSGD codec for this averager's buffers (lazy: the plan
         needs ``_specs``, which exist after the first ``_pack``)."""
@@ -664,13 +680,14 @@ class AveragerBase:
             from distributedvolunteercomputing_tpu.swarm import powersgd
 
             self._psgd_codec = powersgd.PowerSGDCodec(
-                self._specs, rank=self.powersgd_rank
+                self._specs, rank=self.powersgd_rank,
+                mesh_codec=self.mesh_codec,
             )
         return self._psgd_codec
 
     def _to_wire(self, buf: np.ndarray) -> bytes:
         if self.wire == "bf16":
-            return native.f32_to_bf16(buf).tobytes()
+            return self.mesh_codec.encode_bf16(buf).tobytes()
         if self.wire == "q8":
             return native.q8_encode(buf)
         if self.wire == "topk":
@@ -736,7 +753,9 @@ class AveragerBase:
             wire = self._psgd().encode(buf)
             # Own round-trip: the exact size is known — don't let the
             # anti-abuse default cap reject a legitimately huge model.
-            sent = powersgd.decode(wire, max_floats=buf.size)
+            sent = powersgd.decode(
+                wire, max_floats=buf.size, mesh_codec=self.mesh_codec
+            )
         elif self.wire == "sign":
             wire = native.sign_encode(buf)
             sent = native.sign_decode(wire, max_floats=buf.size)
@@ -805,7 +824,8 @@ class AveragerBase:
         codec (a round-trip of already-codec'd values is exact: bf16 by
         representability, q8 because the per-chunk scale reconstructs)."""
         if self.wire == "bf16":
-            return native.bf16_to_f32(native.f32_to_bf16(buf))
+            mc = self.mesh_codec
+            return mc.decode_bf16(mc.encode_bf16(buf))
         if self.wire == "q8":
             return native.q8_decode(native.q8_encode(buf))
         if self.wire == "topk":
@@ -817,7 +837,7 @@ class AveragerBase:
 
     def _buf_from_payload(self, payload: bytes) -> Optional[np.ndarray]:
         if self.wire == "bf16":
-            return native.bf16_to_f32(np.frombuffer(payload, np.uint16))
+            return self.mesh_codec.decode_bf16(np.frombuffer(payload, np.uint16))
         if self.wire == "q8":
             return native.q8_decode(payload)
         if self.wire == "topk":
@@ -862,7 +882,8 @@ class AveragerBase:
             from distributedvolunteercomputing_tpu.swarm import powersgd
 
             return powersgd.decode(
-                payload, max_floats=sum(s.size for s in self._specs)
+                payload, max_floats=sum(s.size for s in self._specs),
+                mesh_codec=self.mesh_codec,
             )
         return np.frombuffer(payload, np.float32).copy()
 
@@ -940,6 +961,16 @@ class AveragerBase:
             step = cb // 2
 
             def gen(b=buf, step=step):
+                mc = self.mesh_codec
+                if mc.active:
+                    # One whole-buffer device encode, chunks sliced from the
+                    # result: the pack is 4-5x the per-chunk host encode, so
+                    # paying it up front still beats the chunk cadence, and
+                    # the first chunk is ready after one kernel.
+                    bits = mc.encode_bf16(b)
+                    for i in range(0, bits.size, step):
+                        yield bits[i : i + step].tobytes()
+                    return
                 for i in range(0, b.size, step):
                     yield native.f32_to_bf16(b[i : i + step]).tobytes()
 
@@ -1014,6 +1045,11 @@ class AveragerBase:
             # count, latency EWMA): the WAN-tier evidence operators and
             # experiments read off the volunteer summary.
             "transport": self.transport.stats(),
+            # Which data-path backend this volunteer selected at startup
+            # (mesh = codec+folds on the local device mesh; host = numpy),
+            # plus degrade evidence — the per-volunteer selection the
+            # ROADMAP item calls for.
+            "mesh_codec": self.mesh_codec.stats(),
         }
         if self._agg_gauges:
             out["aggregation"] = dict(self._agg_gauges)
@@ -1033,9 +1069,10 @@ class AveragerBase:
         agg["peak_bytes_held"] = max(agg.get("peak_bytes_held", 0), g["peak_bytes_held"])
         for k in (
             "tiles_early", "tiles_deadline", "streamed_contribs",
-            "dense_contribs", "aborted_contribs",
+            "dense_contribs", "aborted_contribs", "folder_flushes",
         ):
             agg[k] = agg.get(k, 0) + g[k]
+        agg["codec_backend"] = g["codec_backend"]
         agg["agg_busy_s"] = round(agg.get("agg_busy_s", 0.0) + g["agg_busy_s"], 6)
         agg["last_busy_frac"] = g["agg_busy_frac"]
 
@@ -1472,6 +1509,7 @@ class SyncAverager(AveragerBase):
             st.stream = StreamingAggregator(
                 n_elems, member_ids, method, self.wire,
                 self.transport.chunk_bytes, kw_fn=kw_fn,
+                codec=self.mesh_codec,
             )
             # Fold every pre-arming parked buffer; fed entries drop their
             # dense copy — the aggregator owns that mass now.
@@ -1627,7 +1665,7 @@ class SyncAverager(AveragerBase):
                         native.weighted_sum_inplace(acc, buf_p, w_p / total_w)
                     return acc
                 stack = np.stack([good[p][1] for p in peers])
-                return robust.aggregate(stack, method, **method_kw)
+                return self.mesh_codec.aggregate(stack, method, **method_kw)
 
             if st.stream is not None:
                 # The pipeline already decoded and (for mean/window methods)
@@ -1658,7 +1696,11 @@ class SyncAverager(AveragerBase):
                         pairs = [
                             (st.contribs[k][0], st.payloads[k]) for k in good_keys
                         ]
-                        return powersgd.merge(pairs)
+                        # Cap each payload's dense-reconstruction work at
+                        # the schema size: merge may densify low-rank
+                        # entries, and a crafted container must not buy a
+                        # bigger allocation than a legitimate dense one.
+                        return powersgd.merge(pairs, max_floats=st.result.size)
                     except (KeyError, ValueError):
                         # Missing payload (parked before this round) or a
                         # crafted container whose entry split disagrees with
@@ -2688,7 +2730,7 @@ class ByzantineAverager(AveragerBase):
         stack = np.stack([received[p][1] for p in peers])
 
         def _aggregate_and_flag():
-            out = robust.aggregate(stack, method, **kw)
+            out = self.mesh_codec.aggregate(stack, method, **kw)
             if method != "mean" and len(peers) >= 3:
                 # Estimator-rejection feedback for the policy: rows far from
                 # the robust aggregate (>3x the median row distance) were

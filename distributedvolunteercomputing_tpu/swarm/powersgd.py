@@ -62,11 +62,15 @@ class PowerSGDCodec:
     low-rank wouldn't save bytes (1D leaves, tiny matrices) ship dense.
     """
 
-    def __init__(self, specs: Sequence, rank: int = 4, seed: int = 0):
+    def __init__(self, specs: Sequence, rank: int = 4, seed: int = 0, mesh_codec=None):
         if rank < 1:
             raise ValueError(f"powersgd rank must be >= 1, got {rank}")
         self.rank = int(rank)
         self.seed = int(seed)
+        # On-mesh power iteration (ops.mesh_codec): the per-tensor
+        # QR(M·Q) / MᵀP matmuls run on the volunteer's local device mesh
+        # when the codec is active; None/inactive keeps host BLAS.
+        self.mesh_codec = mesh_codec
         # Per-leaf plan: (offset, size, (n, m, r_eff) | None). A leaf is
         # compressed as [n=prod(shape[:-1]), m=shape[-1]] when that strictly
         # saves floats at its effective rank.
@@ -110,8 +114,12 @@ class PowerSGDCodec:
             n, m, r = lowrank
             mat = chunk.reshape(n, m)
             q = self._init_q(idx, m, r)
-            p = _orthonormalize(mat @ q)  # [n, r]
-            q_new = mat.T @ p  # [m, r] — NOT orthonormalized (carries scale)
+            mc = self.mesh_codec
+            if mc is not None and mc.active:
+                p, q_new = mc.low_rank_iterate(mat, q)
+            else:
+                p = _orthonormalize(mat @ q)  # [n, r]
+                q_new = mat.T @ p  # [m, r] — NOT orthonormalized (carries scale)
             self._warm_q[idx] = q_new
             parts.append(struct.pack("<BIIH", _LOWRANK, n, m, r))
             parts.append(p.tobytes())
@@ -135,16 +143,27 @@ class PowerSGDCodec:
         )
 
 
-def _parse_entries(payload: bytes) -> List[Tuple[int, tuple]]:
+def _parse_entries(
+    payload: bytes, max_floats: Optional[int] = None
+) -> List[Tuple[int, tuple]]:
     """[(kind, data)] per entry: dense -> (values,), lowrank -> (n, m, r, P, Q).
 
     Raises ValueError on ANY malformation (including short reads, which
     struct/numpy report as their own exception types) — the averagers'
     round error containment catches ValueError, and a malicious payload
-    must never escape it."""
+    must never escape it.
+
+    ``max_floats`` bounds the CUMULATIVE dense-reconstruction size of the
+    parsed entries — a low-rank entry counts as its n·m expansion, not its
+    (n+m)·r wire floats — and it is enforced HERE, per entry as the walk
+    advances, so every consumer of the parse (decode's reconstruction,
+    merge's Q·Rᵀ densification) inherits the same resource-exhaustion
+    guard. A hostile entry past the cap is rejected before any n·m
+    intermediate exists."""
     if len(payload) < 8 or payload[:4] != MAGIC:
         raise ValueError("not a powersgd payload (bad magic)")
     out: List[Tuple[int, tuple]] = []
+    total = 0
     try:
         (count,) = struct.unpack_from("<I", payload, 4)
         off = 8
@@ -153,6 +172,12 @@ def _parse_entries(payload: bytes) -> List[Tuple[int, tuple]]:
             if kind == _DENSE:
                 (size,) = struct.unpack_from("<I", payload, off + 1)
                 off += 5
+                total += size
+                if max_floats is not None and total > max_floats:
+                    raise ValueError(
+                        f"powersgd payload reconstructs to >{max_floats} floats "
+                        f"(resource-exhaustion guard)"
+                    )
                 out.append(
                     (kind, (np.frombuffer(payload, np.float32, count=size, offset=off),))
                 )
@@ -160,6 +185,12 @@ def _parse_entries(payload: bytes) -> List[Tuple[int, tuple]]:
             elif kind == _LOWRANK:
                 n, m, r = struct.unpack_from("<IIH", payload, off + 1)
                 off += 11
+                total += n * m
+                if max_floats is not None and total > max_floats:
+                    raise ValueError(
+                        f"powersgd payload reconstructs to >{max_floats} floats "
+                        f"(resource-exhaustion guard)"
+                    )
                 p = np.frombuffer(payload, np.float32, count=n * r, offset=off).reshape(n, r)
                 off += n * r * 4
                 q = np.frombuffer(payload, np.float32, count=m * r, offset=off).reshape(m, r)
@@ -184,7 +215,9 @@ def _parse_entries(payload: bytes) -> List[Tuple[int, tuple]]:
 MAX_DECODE_FLOATS = 1 << 29
 
 
-def decode(payload: bytes, max_floats: int = MAX_DECODE_FLOATS) -> np.ndarray:
+def decode(
+    payload: bytes, max_floats: int = MAX_DECODE_FLOATS, mesh_codec=None
+) -> np.ndarray:
     """Reconstruct the flat f32 buffer. Self-describing: no specs needed,
     so receivers can decode contributions that arrive before their own
     first pack (the averager accepts early pushes by design).
@@ -192,27 +225,28 @@ def decode(payload: bytes, max_floats: int = MAX_DECODE_FLOATS) -> np.ndarray:
     ``max_floats`` bounds the TOTAL reconstruction size — callers that know
     their schema pass the exact expected size, so an attacker can't buy a
     multi-GB allocation with a few-KB container (low-rank entries expand
-    (n+m)*r wire floats into n*m)."""
-    entries = _parse_entries(payload)
-    total = 0
-    for kind, data in entries:
-        total += data[0].size if kind == _DENSE else data[0] * data[1]
-        if total > max_floats:
-            raise ValueError(
-                f"powersgd payload reconstructs to >{max_floats} floats "
-                f"(resource-exhaustion guard)"
-            )
+    (n+m)*r wire floats into n*m). The bound is enforced inside
+    ``_parse_entries``, per entry, BEFORE any reconstruction intermediate
+    is allocated. ``mesh_codec`` (ops.mesh_codec, when active) runs the
+    P·Qᵀ reconstruction matmuls on the local device mesh."""
+    entries = _parse_entries(payload, max_floats)
     out: List[np.ndarray] = []
     for kind, data in entries:
         if kind == _DENSE:
             out.append(data[0].copy())
         else:
             _, _, _, p, q = data
-            out.append((p @ q.T).ravel())
+            if mesh_codec is not None and mesh_codec.active:
+                out.append(mesh_codec.lowrank_reconstruct(p, q))
+            else:
+                out.append((p @ q.T).ravel())
     return np.concatenate(out) if out else np.zeros((0,), np.float32)
 
 
-def merge(weighted_payloads: Sequence[Tuple[float, bytes]]) -> bytes:
+def merge(
+    weighted_payloads: Sequence[Tuple[float, bytes]],
+    max_floats: int = MAX_DECODE_FLOATS,
+) -> bytes:
     """The EXACT weighted mean of powersgd payloads, as a powersgd payload.
 
     By linearity, mean_i(w_i · P_i Q_iᵀ) == P_cat Q_catᵀ where P_cat stacks
@@ -224,13 +258,20 @@ def merge(weighted_payloads: Sequence[Tuple[float, bytes]]) -> bytes:
     k·r approaches n·m at large groups); dense entries and oversized
     concatenations are merged densely. Only meaningful for method='mean' —
     robust estimators are nonlinear, and the caller keeps dense results.
+
+    ``max_floats`` bounds EACH payload's dense-reconstruction size (the
+    mixed-kind fallback below densifies low-rank entries via P·Qᵀ): the
+    sync leader merges containers received from the wire, so an entry
+    declaring a huge n·m must be rejected at parse, exactly as in decode.
     """
     if not weighted_payloads:
         raise ValueError("merge of zero payloads")
     total_w = float(sum(w for w, _ in weighted_payloads))
     if total_w <= 0:
         raise ValueError(f"non-positive total weight {total_w}")
-    parsed = [(w / total_w, _parse_entries(p)) for w, p in weighted_payloads]
+    parsed = [
+        (w / total_w, _parse_entries(p, max_floats)) for w, p in weighted_payloads
+    ]
     n_entries = len(parsed[0][1])
     if any(len(entries) != n_entries for _, entries in parsed):
         raise ValueError("powersgd merge: payloads disagree on entry count")

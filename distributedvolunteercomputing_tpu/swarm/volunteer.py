@@ -150,6 +150,12 @@ class VolunteerConfig:
     # the mesh's dp axis (ZeRO-3); ``seq_sharded`` turns on ring attention
     # over its sp axis.
     mesh: str = ""
+    # On-mesh swarm data path (ops.mesh_codec): run the bf16 wire codec,
+    # PowerSGD matmuls, and the leader's tile folds on this volunteer's
+    # local device mesh. "auto" selects mesh on TPU silicon and host on
+    # CPU platforms; "mesh"/"host" force. Selected once at startup,
+    # surfaced in stats()["mesh_codec"], degrades to host on slice failure.
+    mesh_codec: str = "auto"
     fsdp: bool = False
     seq_sharded: bool = False
     sp_impl: str = "ring"  # ring | ulysses (all-to-all seq<->heads)
@@ -554,6 +560,16 @@ class Volunteer:
             )
 
             mesh = make_mesh(**parse_mesh_spec(self.cfg.mesh))
+        # Select THIS volunteer's swarm data-path backend now that the
+        # local mesh exists (the averager resolves the process default
+        # lazily, so configuring here covers the averager built earlier).
+        from distributedvolunteercomputing_tpu.ops import mesh_codec as mesh_codec_mod
+
+        codec = mesh_codec_mod.configure(mesh=mesh, backend=self.cfg.mesh_codec)
+        log.info(
+            "swarm data path: %s backend (mesh=%s)",
+            codec.backend, self.cfg.mesh or "single-device",
+        )
         self.trainer = Trainer(
             bundle,
             data=data,
@@ -710,6 +726,11 @@ class Volunteer:
                     # mid-run so coord.status sees them before the final
                     # summary lands.
                     report["aggregation"] = dict(self.averager._agg_gauges)
+                if self.averager is not None:
+                    # On-mesh data-path backend + degrade evidence: a slice
+                    # failure mid-run shows up in coord.status as
+                    # backend=host/configured=mesh while training continues.
+                    report["mesh_codec"] = self.averager.mesh_codec.stats()
                 failover_stats = getattr(self.averager, "failover_stats", None)
                 if failover_stats is not None:
                     fo = failover_stats()

@@ -376,12 +376,30 @@ class Trainer:
         self._outer_m = None
         self._take_snapshot(int(self.state.step))
 
+    @staticmethod
+    def _host_tree(tree: Any) -> Any:
+        """Gather a pytree to host with every leaf's device-to-host DMA
+        ISSUED UP FRONT (``copy_to_host_async``) before any blocking
+        ``np.asarray``: the transfers run in parallel with each other AND
+        with still-dispatching device compute, so the trainer thread waits
+        ~max(leaf DMA) instead of the sum of sequential synchronous pulls.
+        This is what lets the averaging launch overlap the contribution's
+        D2H with the train step's tail instead of stalling on it."""
+        for leaf in jax.tree_util.tree_leaves(tree):
+            fn = getattr(leaf, "copy_to_host_async", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — async copy is an optimization
+                    break
+        return jax.tree_util.tree_map(np.asarray, tree)
+
     def _take_snapshot(self, step_no: int) -> None:
         """D2H copy of params at a point where the buffers are live (between
         steps, on the trainer thread). One copy per averaging interval."""
         self._snapshot = (
             step_no,
-            jax.tree_util.tree_map(np.asarray, self.state.params),
+            self._host_tree(self.state.params),
         )
 
     def host_snapshot(self):
@@ -602,8 +620,9 @@ class Trainer:
 
         The payload crosses to HOST first — the AveragerFn contract is host
         numpy (the overlap path already guarantees it; for a mesh-sharded
-        state this is also the gather from the slice's shards)."""
-        payload = jax.tree_util.tree_map(np.asarray, self.bundle.avg_select(tree))
+        state this is also the gather from the slice's shards). D2H DMAs
+        issue up front and drain in parallel (_host_tree)."""
+        payload = self._host_tree(self.bundle.avg_select(tree))
         if what == "params":
             self._note_window_progress(step_no)
         t_avg = time.monotonic()
@@ -625,10 +644,12 @@ class Trainer:
 
         The host copy is load-bearing: the jitted step donates the live
         params' buffers, so the pool thread must never touch device arrays
-        the train thread is about to consume."""
-        payload0 = jax.tree_util.tree_map(
-            np.asarray, self.bundle.avg_select(self.state.params)
-        )
+        the train thread is about to consume. It stays on THIS thread for
+        the same reason, but its D2H DMAs issue up front (_host_tree): the
+        copies overlap the boundary step's still-dispatching tail, and the
+        round then streams on the pool while the next step runs — the
+        device never idles for the contribution transfer."""
+        payload0 = self._host_tree(self.bundle.avg_select(self.state.params))
         self._note_window_progress(step_no)
         t0 = time.monotonic()
         fut = self._avg_pool.submit(
@@ -676,9 +697,7 @@ class Trainer:
         # toward (outer-updated) consensus happens on the snapshot term,
         # the steps taken while the round was in flight are preserved.
         averaged = self._outer_transform(averaged)
-        current = jax.tree_util.tree_map(
-            np.asarray, self.bundle.avg_select(self.state.params)
-        )
+        current = self._host_tree(self.bundle.avg_select(self.state.params))
         merged_payload = jax.tree_util.tree_map(
             lambda avg, cur, p0: np.asarray(avg, np.float32) + (cur - p0),
             averaged, current, payload0,

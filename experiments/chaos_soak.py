@@ -650,6 +650,73 @@ def training_phase(args):
     }
 
 
+async def mesh_degrade_campaign(args):
+    """Degraded-slice arm (``--mesh-degrade``, ISSUE-6 satellite): a
+    bf16 swarm whose LEADER runs the on-mesh codec; mid-campaign its local
+    device mesh "shrinks" (injected device failure). Every round —
+    including the one the failure lands in — must COMMIT with the correct
+    average, the codec must degrade to the host backend exactly once, and
+    the degrade must be visible in stats. Artifact:
+    experiments/results/chaos_mesh_degrade.json."""
+    from distributedvolunteercomputing_tpu.ops import mesh_codec
+
+    async def make_node(peer_id, codec, boot=None):
+        t = ChaosTransport(seed=args.seed)
+        dht = DHTNode(t)
+        await dht.start(bootstrap=[boot] if boot else None)
+        mem = SwarmMembership(dht, peer_id, ttl=10.0)
+        await mem.join()
+        avg = SyncAverager(
+            t, dht, mem, join_timeout=4.0, gather_timeout=8.0,
+            wire="bf16", mesh_codec=codec,
+        )
+        return t, avg
+
+    codec_a = mesh_codec.MeshCodec(backend="mesh")
+    codec_b = mesh_codec.MeshCodec(backend="host")
+    ta, avg_a = await make_node("m0", codec_a)
+    tb, avg_b = await make_node("m1", codec_b, boot=ta.addr)
+    n_elems = 200_000  # > chunk threshold: rounds stream tile-by-tile
+    rounds = max(args.mesh_degrade_rounds, 3)
+    degrade_at = rounds // 2
+    committed = 0
+    correct = 0
+    backend_log = []
+    t0 = time.monotonic()
+    try:
+        for r in range(rounds):
+            if r == degrade_at:
+                codec_a.inject_failure(1)  # the slice dies HERE, mid-training
+            res = await asyncio.gather(
+                avg_a.average({"w": np.full((n_elems,), 1.0, np.float32)}, r),
+                avg_b.average({"w": np.full((n_elems,), 3.0, np.float32)}, r),
+            )
+            ok = res[0] is not None and res[1] is not None
+            committed += int(ok)
+            if ok and np.allclose(res[0]["w"], 2.0, rtol=1e-2):
+                correct += 1
+            backend_log.append(codec_a.stats()["backend"])
+    finally:
+        await ta.close()
+        await tb.close()
+    stats = codec_a.stats()
+    return {
+        "rounds": rounds,
+        "degrade_at_round": degrade_at,
+        "committed": committed,
+        "correct": correct,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "backend_per_round": backend_log,
+        "leader_codec": stats,
+        "pass_all_committed": committed == rounds,
+        "pass_all_correct": correct == rounds,
+        "pass_degraded_once": stats["degraded"] and stats["fallbacks"] == 1,
+        "pass_host_after_degrade": all(
+            b == "host" for b in backend_log[degrade_at:]
+        ),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=7)
@@ -667,19 +734,41 @@ def main():
                          "matrix + fencing scenario)")
     ap.add_argument("--failover-rounds", type=int, default=20,
                     help="kill rounds per phase in the failover arm")
+    ap.add_argument("--mesh-degrade", action="store_true",
+                    help="run the degraded-slice arm instead: the leader's "
+                         "on-mesh codec loses its device mesh mid-campaign "
+                         "and must fall back to host without failing a round")
+    ap.add_argument("--mesh-degrade-rounds", type=int, default=10,
+                    help="averaging rounds in the mesh-degrade arm")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     if args.out is None:
         args.out = os.path.join(
             REPO, "experiments", "results",
-            "chaos_failover.json" if args.failover else "chaos_soak.json",
+            "chaos_failover.json" if args.failover
+            else "chaos_mesh_degrade.json" if args.mesh_degrade
+            else "chaos_soak.json",
         )
     if args.quick:
         args.warmup_rounds = 6
         args.faulted_rounds = 10
         args.blocking_rounds = 3
         args.failover_rounds = 5
+        args.mesh_degrade_rounds = 4
         args.no_train = True
+
+    if args.mesh_degrade:
+        result = {"mesh_degrade_campaign": asyncio.run(mesh_degrade_campaign(args))}
+        mc = result["mesh_degrade_campaign"]
+        result["verdict"] = {
+            k: v for k, v in mc.items() if k.startswith("pass_")
+        }
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[done] artifact -> {args.out}")
+        print(json.dumps(result["verdict"], indent=2))
+        sys.exit(0 if all(result["verdict"].values()) else 1)
 
     if args.failover:
         result = {"failover_campaign": asyncio.run(failover_campaign(args))}

@@ -45,11 +45,14 @@ run_tpu() { # $1=tag  $2...=extra args for the TPU volunteer
         sleep 2
     done
     if [ -z "$addr" ]; then echo "{\"tag\": \"$tag\", \"error\": \"no coordinator\"}" >>"$OUT"; kill $cpid 2>/dev/null; return; fi
-    # CPU peer (only for averaging tags)
+    # CPU peer (only for averaging tags). CPU_EXTRA carries settings both
+    # sides must agree on (e.g. --wire: it is part of the schema hash, so
+    # a mixed-wire pair would reject each other's rounds).
     local bpid=""
     if [ "$tag" != "baseline" ]; then
         JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python run_volunteer.py \
-            --coordinator "$addr" --peer-id cpu-peer $MODEL $STEPS $AVG --seed 1 \
+            --coordinator "$addr" --peer-id cpu-peer $MODEL $STEPS $AVG \
+            ${CPU_EXTRA:-} --seed 1 \
             >"/tmp/vb_$tag.log" 2>&1 &
         bpid=$!
     fi
@@ -81,5 +84,13 @@ run_tpu() { # $1=tag  $2...=extra args for the TPU volunteer
 run_tpu baseline --averaging none
 run_tpu overlap $AVG --overlap
 run_tpu blocking $AVG --no-overlap
+# On-mesh data path arm (ISSUE 6): same overlapped topology with the swarm
+# codec + tile folds forced onto the TPU volunteer's device mesh and the
+# bf16 wire active (the codec's hot path). Compares against `overlap`
+# (host data path) for the end-to-end samples/sec/chip win the ROADMAP
+# item's acceptance asks for; the CPU peer keeps the host backend but
+# must share the wire (schema hash).
+CPU_EXTRA="--wire bf16" run_tpu overlap_mesh $AVG --overlap --wire bf16 --mesh-codec mesh
+CPU_EXTRA=""
 echo "chip_overlap done:"
 cat "$OUT"

@@ -52,6 +52,13 @@ def pytest_configure(config):
         "chaos smoke) — in the default lane, and selectable on their own "
         "with -m failover",
     )
+    config.addinivalue_line(
+        "markers",
+        "mesh_codec: on-mesh data-path tests (bf16 codec, device tile "
+        "folds, mean folder, sharded/pallas equivalence, degraded-slice "
+        "fallback, codec bench smoke) — in the default lane, and "
+        "selectable on their own with -m mesh_codec",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -177,6 +177,72 @@ def test_lossy_peer_degrades_then_recovers():
     run(scenario())
 
 
+@pytest.mark.mesh_codec
+def test_mesh_shrink_mid_training_falls_back_to_host_codec():
+    """Degraded-slice scenario (mesh-networks paper, PAPERS.md): one
+    volunteer's local device mesh fails between averaging rounds — the
+    on-mesh codec degrades to the host backend WITHOUT failing the round,
+    the next rounds keep committing, and the degrade is visible in
+    stats()["mesh_codec"]."""
+    from distributedvolunteercomputing_tpu.ops import mesh_codec
+
+    async def scenario():
+        async def make_node(peer_id, codec, boot=None):
+            t = ChaosTransport(seed=5)
+            dht = DHTNode(t)
+            await dht.start(bootstrap=[boot] if boot else None)
+            mem = SwarmMembership(dht, peer_id, ttl=10.0)
+            await mem.join()
+            avg = SyncAverager(
+                t, dht, mem, join_timeout=4.0, gather_timeout=6.0,
+                wire="bf16", mesh_codec=codec,
+            )
+            return t, avg
+
+        codec_a = mesh_codec.MeshCodec(backend="mesh")
+        codec_b = mesh_codec.MeshCodec(backend="host")
+        ta, avg_a = await make_node("ma", codec_a)
+        tb, avg_b = await make_node("mb", codec_b, boot=ta.addr)
+        # Payload crosses the chunking threshold so the round streams.
+        n = 20_000
+        tree_a = {"w": np.full((n,), 1.0, np.float32)}
+        tree_b = {"w": np.full((n,), 3.0, np.float32)}
+        try:
+            # Round 0: a's mesh codec is healthy.
+            r0 = await asyncio.gather(
+                avg_a.average(tree_a, 0), avg_b.average(tree_b, 0)
+            )
+            assert r0[0] is not None and r0[1] is not None
+            np.testing.assert_allclose(r0[0]["w"], np.full((n,), 2.0), rtol=1e-2)
+            assert not codec_a.degraded
+
+            # The slice shrinks: every subsequent device op fails once and
+            # the codec must degrade to host, mid-training, round intact.
+            codec_a.inject_failure(1)
+            r1 = await asyncio.gather(
+                avg_a.average(tree_a, 1), avg_b.average(tree_b, 1)
+            )
+            assert r1[0] is not None and r1[1] is not None, (
+                "round must COMMIT through the mesh shrink, not fail"
+            )
+            np.testing.assert_allclose(r1[0]["w"], np.full((n,), 2.0), rtol=1e-2)
+            assert codec_a.degraded
+            st = avg_a.stats()["mesh_codec"]
+            assert st["backend"] == "host" and st["configured"] == "mesh"
+            assert st["fallbacks"] == 1
+
+            # Round 2: steady state on the host backend.
+            r2 = await asyncio.gather(
+                avg_a.average(tree_a, 2), avg_b.average(tree_b, 2)
+            )
+            assert r2[0] is not None and r2[1] is not None
+        finally:
+            await ta.close()
+            await tb.close()
+
+    run(scenario())
+
+
 def test_delay_jitter_still_averages():
     """Sub-timeout WAN jitter slows rounds but must not break them."""
 

@@ -238,6 +238,45 @@ class TestMerge:
         with pytest.raises(ValueError):
             powersgd.merge([(1.0, wire), (1.0, dense_single)])
 
+    def test_merge_caps_lowrank_reconstruction(self):
+        # The leader MERGES wire containers and its mixed-kind fallback
+        # densifies low-rank entries via Q·Rᵀ — the same hostile-header
+        # amplification as decode, so the same max_floats guard must hold:
+        # a few-hundred-byte container declaring n=m=50000 would otherwise
+        # allocate 10 GB inside merge.
+        import struct
+
+        n = m = 50_000
+        hostile = b"".join([
+            powersgd.MAGIC, struct.pack("<I", 1),
+            struct.pack("<BIIH", 1, n, m, 1),
+            np.zeros((n, 1), np.float32).tobytes(),
+            np.zeros((m, 1), np.float32).tobytes(),
+        ])
+        with pytest.raises(ValueError, match="resource-exhaustion"):
+            powersgd.merge([(1.0, hostile), (1.0, hostile)], max_floats=1 << 20)
+
+    def test_parse_guard_fires_per_entry_before_any_reconstruction(self):
+        # The bound is enforced inside the parse walk, entry by entry: a
+        # payload whose FIRST entry is within budget but whose second blows
+        # it is rejected with no n·m intermediate ever built (the guard
+        # the ISSUE-6 satellite moves off the dense-only path).
+        import struct
+
+        small = np.ones(16, np.float32)
+        n = m = 40_000
+        payload = b"".join([
+            powersgd.MAGIC, struct.pack("<I", 2),
+            struct.pack("<BI", 0, small.size), small.tobytes(),
+            struct.pack("<BIIH", 1, n, m, 1),
+            np.zeros((n, 1), np.float32).tobytes(),
+            np.zeros((m, 1), np.float32).tobytes(),
+        ])
+        with pytest.raises(ValueError, match="resource-exhaustion"):
+            powersgd._parse_entries(payload, max_floats=1 << 20)
+        # Unbounded parse (trusted local round-trips) still succeeds.
+        assert len(powersgd._parse_entries(payload)) == 2
+
 
 class TestSyncPowerSGD:
     def test_mean_of_rank1_trees_near_exact(self):
