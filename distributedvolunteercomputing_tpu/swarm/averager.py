@@ -66,9 +66,15 @@ from distributedvolunteercomputing_tpu.ops import mesh_codec as mesh_codec_mod
 from distributedvolunteercomputing_tpu.ops import robust
 from distributedvolunteercomputing_tpu.swarm.agg_stream import StreamingAggregator
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
-from distributedvolunteercomputing_tpu.swarm.matchmaking import Group, Matchmaker
+from distributedvolunteercomputing_tpu.swarm.matchmaking import (
+    Group,
+    GroupAssignment,
+    GroupSchedule,
+    Matchmaker,
+)
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
 from distributedvolunteercomputing_tpu.swarm.transport import (
+    Addr,
     RPCError,
     StreamPayload,
     Transport,
@@ -183,6 +189,7 @@ class AveragerBase:
         resilience=None,
         failure_detector=None,
         mesh_codec=None,
+        group_schedule: Optional[GroupSchedule] = None,
     ):
         if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -349,6 +356,183 @@ class AveragerBase:
         # busy fraction) — filled by rounds this node LED with a streaming
         # aggregator; surfaced via stats()/volunteer summary/coord.status.
         self._agg_gauges: Dict[str, Any] = {}
+        # Rotating multi-group schedule (Moshpit-style; None = the classic
+        # one-group-per-epoch rendezvous). When attached, every round
+        # rendezvouses under a group-scoped key — the group id folds into
+        # the epoch hash, so fencing/tokens/retained bytes are group-scoped
+        # without touching the round protocol itself.
+        self.group_schedule = group_schedule
+        if group_schedule is not None:
+            # The per-round split reads a one-beat-stale membership view
+            # (alive_peers(max_age=ttl/3)); keep the snapshot warm from the
+            # heartbeat loop so the round path never walks the DHT for it.
+            membership.keep_snapshot_fresh = True
+        # The assignment of the round IN FLIGHT (reset by _rendezvous);
+        # None on the single-group path. _last_seen_assignment persists
+        # past the round for stats(). _last_group_expected is the
+        # assignment's (pid, addr) set when every member's address was in
+        # the membership records — the direct-join fast path's input.
+        self._last_group: Optional[GroupAssignment] = None
+        self._last_seen_assignment: Optional[GroupAssignment] = None
+        self._last_group_expected: List[Tuple[str, Addr]] = []
+        # Per-group gauges (schedule-attached nodes only): bounded
+        # most-recent map — group ids rotate every window, so an unbounded
+        # dict would grow one entry per rotation forever — plus cumulative
+        # multigroup totals and a distinct-group counter.
+        self._group_recent: Dict[str, dict] = {}
+        self._group_totals: Dict[str, Any] = {
+            "rounds_ok": 0, "rounds_skipped": 0, "rounds_degraded": 0,
+            "rounds_led": 0, "last_commit_t": None,
+        }
+        self._groups_seen = 0
+
+    MAX_GROUP_GAUGES = 16
+
+    async def _rendezvous(self) -> str:
+        """Rendezvous key for the NEXT round: the constant per-mode key
+        (no schedule, lookup failure, or a swarm too small to split), or
+        the group-scoped key from the rotating schedule. Side effect:
+        ``self._last_group`` holds the round's assignment for gauges and
+        ``self._last_group_expected`` the group's (pid, addr) set when every
+        member's address is known — the direct-join formation input."""
+        self._last_group = None
+        self._last_group_expected = []
+        if self.group_schedule is None:
+            return self.round_key
+        try:
+            # One-heartbeat staleness is the membership system's native
+            # resolution; accepting it here keeps the iterative DHT lookup
+            # off every round's critical path (worst case: a just-dead
+            # peer stays expected for one beat and costs a refused dial).
+            peers = await self.membership.alive_peers(
+                include_self=True, max_age=self.membership.ttl / 3.0
+            )
+        except Exception as e:  # noqa: BLE001 — a lookup hiccup must not kill rounds
+            log.debug("group schedule: membership lookup failed (%s)", errstr(e))
+            return self.round_key
+        # Same population filter gossip partner-selection applies: only
+        # peers averaging the same namespace count toward the split (a
+        # record without avg_ns — bare test swarms — is not excluded).
+        ids = [
+            pid for pid, rec in peers.items()
+            if pid == self.peer_id
+            or not self.namespace
+            or rec.get("avg_ns", self.namespace) == self.namespace
+        ]
+        asg = self.group_schedule.assign(ids, self.peer_id)
+        if asg is None:
+            return self.round_key
+        self._last_group = asg
+        self._last_seen_assignment = asg
+        # Direct-join needs every expected member's address. A member whose
+        # record lacks one (can't happen for records membership itself
+        # wrote, but belt-and-braces) is simply not expected — it can still
+        # join us via its own view; if WE are the address-less one, the
+        # self entry below fixes it (our own transport knows our addr).
+        expected: List[Tuple[str, Addr]] = []
+        for pid in asg.members:
+            if pid == self.peer_id:
+                expected.append((pid, self.transport.addr))
+                continue
+            addr = (peers.get(pid) or {}).get("addr")
+            if isinstance(addr, (list, tuple)) and len(addr) == 2:
+                expected.append((pid, (str(addr[0]), int(addr[1]))))
+        self._last_group_expected = expected
+        return f"{self.round_key}/{asg.group_id}"
+
+    async def _form_group(self, round_key: str):
+        """Form this round's group: the direct-join fast path when a
+        schedule assignment (with addresses) is in hand — the group is
+        deterministic, so the generic DHT rendezvous (K-replica store +
+        iterative lookup per poll, ~60 DHT RPCs per member-round at N=16)
+        collapses to ~4 direct RPCs — else the classic DHT rendezvous."""
+        if self._last_group is not None and len(self._last_group_expected) >= 2:
+            group = await self.matchmaker.form_group_direct(
+                round_key, self._last_group_expected,
+                self.min_group, self.max_group, self.join_timeout,
+                round_budget_s=self._round_budget(),
+            )
+            if group is None:
+                # A scheduled group that never formed is the signature of
+                # a stale/divergent membership view (churn, join burst):
+                # make the next round's split read fresh.
+                self.membership.invalidate_snapshot()
+        else:
+            group = await self.matchmaker.form_group(
+                round_key, self.min_group, self.max_group, self.join_timeout,
+                round_budget_s=self._round_budget(),
+            )
+        if group is not None and self._last_group is not None:
+            # Stamp the schedule's group id here, once for every averaging
+            # mode — stats and failover logs name the group by it.
+            group.group_id = self._last_group.group_id
+        return group
+
+    def _note_group_round(
+        self,
+        ok: Optional[bool],
+        *,
+        degraded: bool = False,
+        led: bool = False,
+        size: int = 0,
+    ) -> None:
+        """Roll one finished round into the per-group gauges (``ok`` None =
+        the round never formed — a matchmaking skip). No-op without a
+        schedule: single-group stats stay byte-identical to before."""
+        if self.group_schedule is None:
+            return
+        asg = self._last_group
+        gid = asg.group_id if asg is not None else "single"
+        rec = self._group_recent.get(gid)
+        if rec is None:
+            self._groups_seen += 1
+            while len(self._group_recent) >= self.MAX_GROUP_GAUGES:
+                self._group_recent.pop(next(iter(self._group_recent)))
+            rec = self._group_recent[gid] = {
+                "rounds_ok": 0, "rounds_skipped": 0, "rounds_degraded": 0,
+                "rounds_led": 0, "size": 0, "last_commit_t": None,
+            }
+        if size:
+            rec["size"] = size
+        tot = self._group_totals
+        if ok:
+            rec["rounds_ok"] += 1
+            tot["rounds_ok"] += 1
+            t = self.clock()
+            rec["last_commit_t"] = t
+            tot["last_commit_t"] = t
+            if degraded:
+                rec["rounds_degraded"] += 1
+                tot["rounds_degraded"] += 1
+            if led:
+                rec["rounds_led"] += 1
+                tot["rounds_led"] += 1
+        else:
+            rec["rounds_skipped"] += 1
+            tot["rounds_skipped"] += 1
+
+    def group_stats(self) -> dict:
+        """Group-schedule gauges for stats()/volunteer report/coord.status:
+        the current assignment (rotation, group id, split), cumulative
+        multigroup round counters, and a bounded per-group breakdown so
+        dashboards can see per-group commit health instead of one flat
+        number silently averaging across groups."""
+        sched = self.group_schedule
+        out: Dict[str, Any] = {"enabled": sched is not None}
+        if sched is None:
+            return out
+        out["target_size"] = sched.target_size
+        out["rotation_s"] = sched.rotation_s
+        asg = self._last_seen_assignment
+        if asg is not None:
+            out["rot"] = asg.rot
+            out["group_id"] = asg.group_id
+            out["n_groups_view"] = asg.n_groups
+            out["n_peers_view"] = asg.n_peers
+        out.update(self._group_totals)
+        out["distinct_groups"] = self._groups_seen
+        out["recent"] = {g: dict(r) for g, r in self._group_recent.items()}
+        return out
 
     @property
     def round_key(self) -> str:
@@ -465,6 +649,9 @@ class AveragerBase:
             duration_s=duration_s,
             ok=ok,
             degraded=self._round_degraded,
+            group_id=(
+                self._last_group.group_id if self._last_group is not None else None
+            ),
             **detail,
         )
 
@@ -1053,6 +1240,8 @@ class AveragerBase:
         }
         if self._agg_gauges:
             out["aggregation"] = dict(self._agg_gauges)
+        if self.group_schedule is not None:
+            out["groups"] = self.group_stats()
         if self.resilience is not None:
             out["resilience"] = self.resilience.stats()
         return out
@@ -1380,10 +1569,12 @@ class SyncAverager(AveragerBase):
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
         await self._maybe_backoff()
-        group = await self.matchmaker.form_group(
-            self.round_key, self.min_group, self.max_group, self.join_timeout,
-            round_budget_s=self._round_budget(),
-        )
+        # Group-scoped rendezvous when a rotating schedule is attached:
+        # many groups form this round, each running THIS protocol under
+        # its own epoch; we only ever see our own — and the schedule's
+        # determinism lets formation skip the DHT entirely (_form_group).
+        round_key = await self._rendezvous()
+        group = await self._form_group(round_key)
         if group is None:
             # No group formed (too few peers / no begin): a matchmaking
             # skip, not a round — the policy only learns from rounds that
@@ -1391,6 +1582,7 @@ class SyncAverager(AveragerBase):
             # or backs itself off.
             self.rounds_skipped += 1
             self._last_outcomes = None
+            self._note_group_round(None)
             return None
         if group.my_index != 0 and self._recently_deposed(group.leader_id):
             # Leadership strike (tentpole part 3): this peer crashed out of
@@ -1404,6 +1596,7 @@ class SyncAverager(AveragerBase):
             )
             self.rounds_skipped += 1
             self._last_outcomes = None
+            self._note_group_round(None)
             return None
         if group.my_index == 0 and self._specs is not None:
             # Arm the streaming round BEFORE packing our own contribution:
@@ -1434,6 +1627,7 @@ class SyncAverager(AveragerBase):
             self._observe_round_failure()
             self._commit_ef(False)
             self._flush_round_outcome(time.monotonic() - t0, ok=False)
+            self._note_group_round(False, size=group.size)
             return None
         self._commit_ef(result is not None and self._contribution_included)
         if result is None:
@@ -1443,6 +1637,12 @@ class SyncAverager(AveragerBase):
         else:
             self._observe_round_time(time.monotonic() - t0)
         self._flush_round_outcome(time.monotonic() - t0, ok=result is not None)
+        self._note_group_round(
+            result is not None,
+            degraded=self._round_degraded,
+            led=group.my_index == 0,
+            size=group.size,
+        )
         return result
 
     async def _prepare_lead_round(self, group: Group) -> _Round:
@@ -2036,6 +2236,7 @@ class SyncAverager(AveragerBase):
             deadline=deadline,
             budget=budget,
             gen=gen,
+            group_id=group.group_id,
         )
         self._record_epoch_gen(group.epoch, gen)
         # Abort/re-arm: whatever round state the deposed generation left
@@ -2125,6 +2326,7 @@ class SyncAverager(AveragerBase):
             deadline=float(deadline) if isinstance(deadline, (int, float)) else None,
             budget=float(budget) if isinstance(budget, (int, float)) else None,
             gen=rgen,
+            group_id=group.group_id,
         )
         new_leader_id, new_leader_addr = members[0]
         await self._push_contribution(new_leader_addr, rgroup, weight, wire_bytes)
@@ -2496,13 +2698,12 @@ class ButterflyAverager(AveragerBase):
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_stages()
         await self._maybe_backoff()
-        group = await self.matchmaker.form_group(
-            self.round_key, self.min_group, self.max_group, self.join_timeout,
-            round_budget_s=self._round_budget(),
-        )
+        round_key = await self._rendezvous()
+        group = await self._form_group(round_key)
         if group is None:
             self.rounds_skipped += 1
             self._last_outcomes = None
+            self._note_group_round(None)
             return None
         # Round proper starts AFTER formation (same vantage as sync/byz):
         # the policy's deadline estimate must learn exchange time, not
@@ -2568,11 +2769,15 @@ class ButterflyAverager(AveragerBase):
         if not mixed_any:
             self.rounds_skipped += 1
             self._flush_round_outcome(time.monotonic() - t0, ok=False)
+            self._note_group_round(False, size=group.size)
             return None
         self.rounds_ok += 1
         if self._round_degraded:
             self.rounds_degraded += 1
         self._flush_round_outcome(time.monotonic() - t0, ok=True)
+        self._note_group_round(
+            True, degraded=self._round_degraded, size=group.size
+        )
         return await asyncio.to_thread(self._unpack, buf)
 
 
@@ -2641,13 +2846,12 @@ class ByzantineAverager(AveragerBase):
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
         await self._maybe_backoff()
-        group = await self.matchmaker.form_group(
-            self.round_key, self.min_group, self.max_group, self.join_timeout,
-            round_budget_s=self._round_budget(),
-        )
+        round_key = await self._rendezvous()
+        group = await self._form_group(round_key)
         if group is None:
             self.rounds_skipped += 1
             self._last_outcomes = None
+            self._note_group_round(None)
             return None
         buf, wire_bytes, sent = await self._pack_and_compress(tree)
         st = self._rounds.get(group.epoch)
@@ -2711,6 +2915,7 @@ class ByzantineAverager(AveragerBase):
             self._observe_round_failure()
             self._commit_ef(False)
             self._flush_round_outcome(time.monotonic() - t0, ok=False)
+            self._note_group_round(False, size=group.size)
             return None
         self._commit_ef(True)
         if excluded:
@@ -2750,6 +2955,7 @@ class ByzantineAverager(AveragerBase):
             for p in outliers:
                 self.resilience.record_rejection(p)
         self._flush_round_outcome(time.monotonic() - t0, ok=True)
+        self._note_group_round(True, degraded=degraded, size=group.size)
         return await asyncio.to_thread(lambda: self._unpack(agg))
 
 

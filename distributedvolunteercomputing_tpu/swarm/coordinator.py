@@ -36,8 +36,21 @@ class Coordinator:
         self.metrics_path = metrics_path
         self.latest_metrics: Dict[str, dict] = {}
         self._t0 = time.time()
+        # Swarm-wide committed-round rate (multi-group rollup): per-peer
+        # last-seen cumulative rounds_ok, and a sliding window of
+        # (recv_t, delta) increments the status RPC sums over the last
+        # minute — a rate no single volunteer's flat counter can show.
+        self._commit_seen: Dict[str, int] = {}
+        self._commit_window: list = []
         self.transport.register("coord.report", self._rpc_report)
         self.transport.register("coord.status", self._rpc_status)
+
+    COMMIT_WINDOW_S = 60.0
+    # Volunteer ids are fresh uuids per process, so churn would grow the
+    # per-peer maps without bound on a long-running coordinator; a peer
+    # silent this long is dropped (a late reappearance re-seeds its commit
+    # baseline at delta 0, identical to first sight).
+    STALE_PEER_TTL_S = 600.0
 
     async def start(self) -> Tuple[str, int]:
         from distributedvolunteercomputing_tpu.utils.asyncio_debug import maybe_enable_from_env
@@ -60,11 +73,107 @@ class Coordinator:
     async def _rpc_report(self, args: dict, payload: bytes):
         """Volunteers push per-step metrics; coordinator aggregates swarm-level."""
         peer = args.get("peer", "?")
-        self.latest_metrics[peer] = {**args, "recv_t": time.time()}
+        now = time.time()
+        self.latest_metrics[peer] = {**args, "recv_t": now}
+        groups = args.get("groups")
+        if isinstance(groups, dict):
+            total = groups.get("rounds_ok")
+            if isinstance(total, int):
+                prev = self._commit_seen.get(peer)
+                self._commit_seen[peer] = total
+                if prev is None:
+                    # First sight of this peer (fresh coordinator joining a
+                    # long-running swarm, or a new volunteer): seed the
+                    # baseline only — injecting the lifetime total would
+                    # report a bogus commit burst for the next window.
+                    delta = 0
+                elif total >= prev:
+                    delta = total - prev
+                else:
+                    # Counter went backwards = the volunteer restarted;
+                    # count from zero, don't subtract history.
+                    delta = total
+                if delta > 0:
+                    self._commit_window.append((now, delta))
+            cutoff = now - self.COMMIT_WINDOW_S
+            self._commit_window = [
+                (t, d) for t, d in self._commit_window if t >= cutoff
+            ]
+        for p in [
+            p for p, m in self.latest_metrics.items()
+            if now - m["recv_t"] > self.STALE_PEER_TTL_S
+        ]:
+            self.latest_metrics.pop(p, None)
+            self._commit_seen.pop(p, None)
         if self.metrics_path:
             with open(self.metrics_path, "a") as fh:
                 fh.write(json.dumps(self.latest_metrics[peer]) + "\n")
         return {"ok": True}, b""
+
+    def _multigroup_rollup(self, fresh: list) -> Optional[dict]:
+        """Swarm-level view of the rotating group schedule, from the fresh
+        reports that carry ``groups`` gauges. Namespaced PER GROUP — the
+        flat per-peer maps elsewhere in status would silently average
+        across groups — plus the rollups a dashboard needs: groups active
+        this rotation, committed-round rate, and the slowest group's lag
+        behind its last commit."""
+        gstats = {
+            m.get("peer", "?"): m["groups"]
+            for m in fresh
+            if isinstance(m.get("groups"), dict) and m["groups"].get("enabled")
+        }
+        if not gstats:
+            return None
+        now = time.time()
+        rot = max(
+            (gs.get("rot") for gs in gstats.values() if gs.get("rot") is not None),
+            default=None,
+        )
+        active = {
+            gs["group_id"] for gs in gstats.values() if gs.get("group_id")
+        }
+        # Per-group breakdown, merged across reporters. Counters are
+        # volunteer-rounds (a committed group round counts once per member
+        # that saw it commit) — a participation measure, not a round count.
+        per_group: Dict[str, dict] = {}
+        for peer, gs in gstats.items():
+            for gid, rec in (gs.get("recent") or {}).items():
+                g = per_group.setdefault(
+                    gid,
+                    {"volunteers": 0, "rounds_ok": 0, "rounds_skipped": 0,
+                     "rounds_degraded": 0, "last_commit_t": None},
+                )
+                g["volunteers"] += 1
+                for k in ("rounds_ok", "rounds_skipped", "rounds_degraded"):
+                    g[k] += int(rec.get(k) or 0)
+                t = rec.get("last_commit_t")
+                if t is not None and (
+                    g["last_commit_t"] is None or t > g["last_commit_t"]
+                ):
+                    g["last_commit_t"] = t
+        # Slowest ACTIVE group's lag behind its last commit (volunteer
+        # clocks, so skew-accurate only to ClockSync quality): the
+        # "is any group silently stuck" gauge.
+        lags = [
+            now - per_group[gid]["last_commit_t"]
+            for gid in active
+            if gid in per_group and per_group[gid]["last_commit_t"] is not None
+        ]
+        cutoff = now - self.COMMIT_WINDOW_S
+        commits = sum(d for t, d in self._commit_window if t >= cutoff)
+        return {
+            "volunteers": len(gstats),
+            "rot": rot,
+            "groups_active": len(active),
+            "rounds_ok_total": sum(
+                int(gs.get("rounds_ok") or 0) for gs in gstats.values()
+            ),
+            "commits_per_min": round(
+                commits * 60.0 / self.COMMIT_WINDOW_S, 2
+            ),
+            "slowest_group_lag_s": round(max(lags), 3) if lags else None,
+            "per_group": per_group,
+        }
 
     async def _rpc_status(self, args: dict, payload: bytes):
         """Swarm-level view: alive peers + aggregate samples/sec."""
@@ -74,7 +183,12 @@ class Coordinator:
             m for m in self.latest_metrics.values() if time.time() - m["recv_t"] < 60.0
         ]
         agg_sps = sum(float(m.get("samples_per_sec", 0.0)) for m in fresh)
+        multigroup = self._multigroup_rollup(fresh)
         return {
+            # Rotating group-schedule rollup (None until some volunteer
+            # reports multi-group gauges): per-group commit health plus
+            # the swarm-wide rate/lag numbers.
+            "multigroup": multigroup,
             "alive": alive,
             "n_alive": len(alive),
             "swarm_samples_per_sec": agg_sps,

@@ -47,6 +47,15 @@ def key_id(key: str) -> int:
     return _sha1_int(key.encode())
 
 
+def keyspace_position(peer_id: str, salt: int = 0) -> int:
+    """Deterministic 160-bit keyspace position for ``peer_id`` under
+    ``salt``. The group schedule (swarm/matchmaking.py) partitions the
+    swarm by cutting this keyspace into equal arcs and re-salting per
+    rotation, so every volunteer computes the same grid from nothing but
+    the peer id — no negotiation, no coordinator round-trip."""
+    return _sha1_int(f"grid|{salt}|{peer_id}".encode())
+
+
 def node_id_for(addr: Addr) -> int:
     return _sha1_int(f"{addr[0]}:{addr[1]}".encode())
 
@@ -115,6 +124,13 @@ class DHTNode:
         # changed) k-closest set until their TTL runs out, so a record
         # survives its original replicas churning away.
         self._owned: Dict[Tuple[str, str], Tuple[str, float]] = {}
+        # Replica-set cache for stores: target -> (stamp, k-closest). A
+        # periodic re-store of the SAME key (membership heartbeats every
+        # ttl/3) was paying a full iterative lookup each time for an
+        # answer that changes only on churn; within the TTL the cached set
+        # is as fresh as the republish window already tolerated. Evicted
+        # on any store failure to a cached replica (the churn signal).
+        self._store_routes: Dict[int, Tuple[float, List[Tuple[int, Addr]]]] = {}
         self._last_sweep = time.monotonic()
         self.maintenance_interval = maintenance_interval
         self._maint_task: Optional[asyncio.Task] = None
@@ -337,9 +353,20 @@ class DHTNode:
 
     # -- public API --------------------------------------------------------
 
+    STORE_ROUTE_TTL = 15.0
+    MAX_STORE_ROUTES = 64
+
     async def _store_raw(self, key: str, subkey: str, value_json: str, ttl: float) -> int:
         target = key_id(key)
-        closest, _ = await self._lookup(target)
+        now = time.monotonic()
+        cached = self._store_routes.get(target)
+        if cached is not None and now - cached[0] <= self.STORE_ROUTE_TTL:
+            closest = cached[1]
+        else:
+            closest, _ = await self._lookup(target)
+            if len(self._store_routes) >= self.MAX_STORE_ROUTES:
+                self._store_routes.pop(next(iter(self._store_routes)))
+            self._store_routes[target] = (now, closest)
         payload_args = {
             "key": key,
             "subkey": subkey,
@@ -357,6 +384,8 @@ class DHTNode:
                 ok += 1
             except (RPCError, OSError, asyncio.TimeoutError):
                 self.table.remove(nid)
+                # A cached replica died: next store re-walks the keyspace.
+                self._store_routes.pop(target, None)
         return ok
 
     async def store(self, key: str, value: object, subkey: str = "", ttl: float = 60.0) -> int:

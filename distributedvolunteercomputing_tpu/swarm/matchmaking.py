@@ -26,11 +26,178 @@ import hashlib
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.dht import (
+    ID_BITS,
+    DHTNode,
+    keyspace_position,
+)
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, RPCError, Transport
 from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupAssignment:
+    """One volunteer's slot in one rotation of the group schedule."""
+
+    rot: int        # rotation index (wall-clock window of the schedule)
+    group_id: str   # rendezvous-key suffix, e.g. "r42.g3"
+    n_groups: int   # how many groups THIS view's live count splits into
+    n_peers: int    # live peers behind that split (this view)
+    # The peer ids this view puts in MY group (sorted). The whole point of
+    # a deterministic schedule: the group is KNOWN before the round, so
+    # formation can skip the generic DHT rendezvous (store + poll loop, a
+    # full iterative lookup per poll) and members can join their leader
+    # candidate directly — see Matchmaker.form_group_direct.
+    members: Tuple[str, ...] = ()
+
+
+class GroupSchedule:
+    """Moshpit-style rotating multi-group partition of the live swarm.
+
+    One group per epoch caps swarm-wide sync throughput at one leader's
+    NIC and one group's size. This schedule instead partitions the live
+    membership into ``~n_peers / target_size`` groups every rotation by
+    cutting the DHT keyspace into equal arcs: a volunteer's group is the
+    arc its salted ``keyspace_position`` falls in, and the salt is the
+    rotation index — so successive rounds regroup the swarm along a fresh
+    seeded grid and group averages mix globally in O(log N) rounds
+    (Moshpit SGD's argument; the mixing unit test in
+    tests/test_multigroup.py measures the bound, and a NON-rotating
+    schedule measurably fails it).
+
+    Properties the swarm depends on:
+
+    - **deterministic and local**: any volunteer computes its own group
+      from (peer ids, rotation) alone — no negotiation, no extra RPCs.
+      Each group then runs the ORDINARY rendezvous/leader/begin protocol
+      under its group-scoped key, so the epoch+generation fencing from
+      leader failover applies per group unchanged.
+    - **view-divergence tolerant**: a peer's arc depends only on its OWN
+      id, never on its rank in a sorted list, so two volunteers whose
+      membership views differ by a churned peer still compute the same
+      groups for everyone else. Disagreement about the group COUNT (only
+      near ``n / target_size`` boundaries) degrades to an underfilled
+      rendezvous, never to mixed tensors (the epoch guards that).
+    - **best-effort sizing**: arcs are equal but positions are hashed, so
+      group sizes fluctuate around ``target_size``; an undersized group
+      skips its round (min_group) and its members re-mix next rotation.
+    """
+
+    def __init__(
+        self,
+        target_size: int = 8,
+        rotation_s: float = 15.0,
+        clock: Callable[[], float] = time.time,
+        min_size: int = 2,
+    ):
+        if target_size < 2:
+            raise ValueError(f"target_size must be >= 2, got {target_size}")
+        if rotation_s <= 0:
+            raise ValueError(f"rotation_s must be > 0, got {rotation_s}")
+        self.target_size = int(target_size)
+        self.rotation_s = float(rotation_s)
+        # The consensus wall clock when one exists (ClockSync.now): every
+        # member of a prospective group must land in the same rotation
+        # window or they rendezvous under different keys and miss.
+        self.clock = clock
+        self.min_size = int(min_size)
+
+    def rotation(self) -> int:
+        return int(self.clock() // self.rotation_s)
+
+    @staticmethod
+    def n_groups(n_peers: int, target_size: int, min_size: int = 2) -> int:
+        """Groups an ``n_peers`` swarm splits into: ``round(n / target)``,
+        floored at 1 and capped so the EXPECTED group size never drops
+        below ``min_size`` (a split that mostly produces unformable
+        groups is worse than fewer, larger groups)."""
+        if n_peers <= 0:
+            return 0
+        g = int(round(n_peers / float(target_size))) or 1
+        return max(1, min(g, n_peers // max(min_size, 1)))
+
+    @staticmethod
+    def group_of(peer_id: str, rot: int, n_groups: int) -> int:
+        """Arc index of ``peer_id`` under rotation ``rot`` — a function of
+        the peer's own id only (view-divergence tolerance, see class doc)."""
+        return (keyspace_position(peer_id, rot) * n_groups) >> ID_BITS
+
+    def assign(
+        self,
+        member_ids,
+        peer_id: str,
+        rot: Optional[int] = None,
+    ) -> Optional[GroupAssignment]:
+        """This peer's assignment for rotation ``rot`` (current window when
+        None), or None when the live swarm is too small to split — the
+        caller then falls back to the single constant rendezvous key,
+        which keeps small swarms byte-identical to the pre-schedule
+        behavior."""
+        ids = set(member_ids)
+        ids.add(peer_id)
+        n = len(ids)
+        g = self.n_groups(n, self.target_size, self.min_size)
+        if g <= 1:
+            return None
+        rot = self.rotation() if rot is None else int(rot)
+        for home, grp in self._arcs(ids, rot, g, self.min_size):
+            if peer_id in grp:
+                return GroupAssignment(
+                    rot=rot, group_id=f"r{rot}.g{home}", n_groups=g, n_peers=n,
+                    members=tuple(sorted(grp)),
+                )
+        return None  # unreachable: peer_id is in ids
+
+    @classmethod
+    def _arcs(
+        cls, ids, rot: int, g: int, min_size: int
+    ) -> List[Tuple[int, List[str]]]:
+        """(home_arc, members) groups for one view: peers bucketed by their
+        own salted arc, then undersized arcs CARRY-MERGED into the next
+        arc — a hash partition leaves occasional arcs below ``min_size``,
+        and without the merge their members burn a whole join timeout on a
+        rendezvous that can never form. The merge is computed from the
+        local view, so divergent views can disagree about a carried
+        member's group; like every other divergence here that costs an
+        underfilled round, never mixed tensors."""
+        arcs: List[List[str]] = [[] for _ in range(g)]
+        for pid in sorted(ids):
+            arcs[cls.group_of(pid, rot, g)].append(pid)
+        out: List[Tuple[int, List[str]]] = []
+        carry: List[str] = []
+        for a in range(g):
+            cur = arcs[a] + carry
+            if 0 < len(cur) < min_size:
+                carry = cur
+                continue
+            if cur:
+                out.append((a, cur))
+            carry = []
+        if carry:
+            # Leftover tail: fold into the last formed group (or stand
+            # alone when nothing formed at all — the caller's min_group
+            # then decides).
+            if out:
+                out[-1][1].extend(carry)
+            else:
+                out.append((g - 1, carry))
+        return out
+
+    @classmethod
+    def partition(
+        cls, member_ids, rot: int, target_size: int, min_size: int = 2
+    ) -> List[List[str]]:
+        """The full partition one view computes for rotation ``rot``
+        (groups in arc order, members sorted by id). Tests, the chaos
+        campaign, and the scale bench use this to know who SHOULD group
+        with whom; the swarm itself never needs the global view."""
+        ids = sorted(set(member_ids))
+        g = cls.n_groups(len(ids), target_size, min_size)
+        if g <= 1:
+            return [ids] if ids else []
+        return [sorted(grp) for _, grp in cls._arcs(ids, rot, g, min_size)]
 
 
 @dataclasses.dataclass
@@ -63,6 +230,12 @@ class Group:
     # mismatch, so a deposed or partitioned ex-leader's late serve (or a
     # member's stale push) can never mix into a newer generation's round.
     gen: int = 0
+    # Group-schedule id this round formed under ("" = the single constant
+    # rendezvous key). Purely observational: the schedule's group id is
+    # already folded into the epoch hash via the group-scoped round_key,
+    # so fencing/tokens/retained bytes are group-scoped by construction —
+    # this field just lets stats and failover logs name the group.
+    group_id: str = ""
 
     @property
     def leader_id(self) -> str:
@@ -113,7 +286,20 @@ class Matchmaker:
         # arrival time: consumed only if still fresh (a begin parked after a
         # round timed out must not leak into the NEXT round as a dead epoch).
         self._parked_begins: Dict[str, Tuple[float, dict]] = {}
+        # Direct-join fast path (form_group_direct): joins collected while
+        # we lead a scheduled round, and joins that arrived BEFORE our
+        # form_group_direct() registered the collector (a member can dial
+        # its leader candidate the instant its clock enters the rotation
+        # window) — same park-with-TTL discipline as begins.
+        self._join_collectors: Dict[str, dict] = {}
+        self._parked_joins: Dict[str, Tuple[float, Dict[str, Addr]]] = {}
+        # round_keys we already led (direct path), with lead time: a join
+        # arriving AFTER the freeze gets an immediate "too late" reply, so
+        # a straggler skips its round in one RPC instead of burning the
+        # whole join timeout waiting for a begin that can never come.
+        self._recent_leads: Dict[str, float] = {}
         transport.register("avg.begin", self._rpc_begin)
+        transport.register("avg.join", self._rpc_join)
 
     PARKED_BEGIN_TTL = 3.0
     # Distinct round_keys a remote peer can park begins under; entries are
@@ -139,6 +325,43 @@ class Matchmaker:
             ):
                 raise RPCError("parked begin cap reached")
             self._parked_begins[args["round_key"]] = (now, args)
+        return {"ok": True}, b""
+
+    async def _rpc_join(self, args: dict, payload: bytes):
+        """A scheduled member announcing itself directly to this node, its
+        computed leader candidate for ``round_key`` (form_group_direct).
+        Collected live when our own form_group_direct is leading that key;
+        parked briefly otherwise (we may be about to)."""
+        round_key = args["round_key"]
+        pid = str(args["peer"])
+        addr = tuple(args["addr"])
+        col = self._join_collectors.get(round_key)
+        if col is not None:
+            if pid not in col["members"]:
+                col["members"][pid] = addr
+                col["event"].set()
+            return {"ok": True}, b""
+        now = time.monotonic()
+        led_at = self._recent_leads.get(round_key)
+        if led_at is not None and now - led_at <= self.PARKED_BEGIN_TTL:
+            return {"ok": False, "late": True}, b""
+        for k in [
+            k for k, (ts, _) in self._parked_joins.items()
+            if now - ts > self.PARKED_BEGIN_TTL
+        ]:
+            del self._parked_joins[k]
+        ts, joiners = self._parked_joins.get(round_key, (now, {}))
+        if (
+            round_key not in self._parked_joins
+            and len(self._parked_joins) >= self.MAX_PARKED_BEGINS
+        ):
+            # Table full: refuse WITHOUT raising — an RPCError here would
+            # read as "candidate dead" to the joiner, who would then
+            # self-elect a splinter group under the same key. A not-ok
+            # reply makes it retry/skip instead (form_group_direct).
+            return {"ok": False, "busy": True}, b""
+        joiners[pid] = addr
+        self._parked_joins[round_key] = (ts, joiners)
         return {"ok": True}, b""
 
     @staticmethod
@@ -220,6 +443,205 @@ class Matchmaker:
         finally:
             self._begin_futures.pop(round_key, None)
 
+    async def form_group_direct(
+        self,
+        round_key: str,
+        expected: List[Tuple[str, Addr]],
+        min_group: int = 2,
+        max_group: int = 16,
+        join_timeout: float = 10.0,
+        settle: float = 0.5,
+        round_budget_s: Optional[float] = None,
+    ) -> Optional[Group]:
+        """Scheduled-group formation: rendezvous WITHOUT the DHT.
+
+        ``expected`` is the (pid, addr) set the group schedule puts in this
+        round's group — deterministic and already known to every member, so
+        the generic DHT rendezvous (a store fanned to K replicas plus a
+        full iterative lookup per 100 ms poll) is pure waste here. Instead
+        each member sends ONE ``avg.join`` RPC to its leader candidate
+        (``_pick_leader`` over the expected set) and awaits the begin; the
+        candidate collects joins and leads the moment every expected member
+        has joined (or min_group + a ``settle`` quiet period, or the join
+        timeout — whichever first). ~4 RPCs per member-round total, and no
+        settle wait on the common path.
+
+        Degradation matches the classic path class-for-class: a dead
+        candidate is skipped (its conn failure is the signal) and the next
+        expected id self-elects; divergent views (churn near arc
+        boundaries, disagreeing suspicion) can split a group into two
+        epochs or cost an underfilled round, never mixed tensors — the
+        epoch hash still covers the frozen member list. Joiners outside
+        ``expected`` (a peer whose view merged them into this arc) are
+        accepted up to ``max_group``: inclusion under divergence beats
+        symmetry. The epoch/token/begin machinery is byte-identical to
+        form_group's — failover, fencing, and recovery see no difference.
+        """
+        deadline = time.monotonic() + join_timeout
+        dead: set = set()
+        fut = self._begin_futures[round_key] = asyncio.Future()
+        parked = self._parked_begins.pop(round_key, None)
+        if parked is not None and not fut.done():
+            ts, begin = parked
+            if time.monotonic() - ts <= self.PARKED_BEGIN_TTL:
+                fut.set_result(begin)
+        try:
+            while True:
+                alive = [m for m in expected if m[0] not in dead]
+                if not alive:
+                    log.info("round %s: every expected peer dead, skipping",
+                             round_key)
+                    return None
+                # begin-wins, same as form_group: a peer whose view diverged
+                # (suspicion, arc-boundary churn) may have self-elected and
+                # already sent us a begin — joining it beats leading a
+                # splinter group under the same key and stalling its round.
+                if fut.done():
+                    return self._group_from_begin(fut.result(), round_key)
+                cand = self._pick_leader(alive)
+                if cand == self.peer_id:
+                    return await self._lead_direct(
+                        round_key, expected, dead,
+                        min_group=min_group, max_group=max_group,
+                        settle=settle, deadline_mono=deadline,
+                        round_budget_s=round_budget_s,
+                    )
+                addr = next(a for pid, a in alive if pid == cand)
+                try:
+                    ret, _ = await self.transport.call(
+                        addr, "avg.join",
+                        {"round_key": round_key, "peer": self.peer_id,
+                         "addr": list(self.transport.addr)},
+                        timeout=5.0, connect_timeout=3.0,
+                    )
+                except Exception as e:  # noqa: BLE001 — candidate down/refusing
+                    dead.add(cand)
+                    log.info("round %s: leader candidate %s unreachable "
+                             "(%s), trying next", round_key, cand, errstr(e))
+                    if time.monotonic() >= deadline:
+                        return None
+                    continue
+                if not ret.get("ok", True):
+                    # The candidate froze a round under this key moments ago
+                    # (late) or its parked-join table is full (busy). When
+                    # the cadence runs several rounds per rotation window,
+                    # the NEXT round reuses this key and a re-sent join
+                    # lands in its collector (or parks once the recent-lead
+                    # TTL expires) — so retry at settle intervals until the
+                    # join deadline instead of skipping: a genuine
+                    # last-round straggler pays a few tiny RPCs and the
+                    # same timeout the classic rendezvous would have
+                    # burned, while skipping here would drop a whole round
+                    # for every member that starts slightly ahead of its
+                    # leader, every round.
+                    if time.monotonic() >= deadline:
+                        log.info("round %s: joined after the freeze, "
+                                 "skipping", round_key)
+                        return None
+                    log.debug("round %s: candidate %s froze without us, "
+                              "retrying", round_key, cand)
+                    await asyncio.sleep(
+                        min(max(settle, 0.05),
+                            max(deadline - time.monotonic(), 0.0))
+                    )
+                    continue
+                remaining = max(deadline - time.monotonic(), 2.0)
+                begin = await asyncio.wait_for(fut, timeout=remaining)
+                return self._group_from_begin(begin, round_key)
+        except asyncio.TimeoutError:
+            log.info("round %s: no begin from leader, skipping", round_key)
+            return None
+        finally:
+            self._begin_futures.pop(round_key, None)
+
+    async def _lead_direct(
+        self,
+        round_key: str,
+        expected: List[Tuple[str, Addr]],
+        dead: set,
+        *,
+        min_group: int,
+        max_group: int,
+        settle: float,
+        deadline_mono: float,
+        round_budget_s: Optional[float],
+    ) -> Optional[Group]:
+        """Leader half of form_group_direct: collect ``avg.join``s for
+        ``round_key``, freeze, and run the ordinary ``_lead``."""
+        col = self._join_collectors[round_key] = {
+            "members": {}, "event": asyncio.Event(),
+        }
+        parked = self._parked_joins.pop(round_key, None)
+        if parked is not None:
+            ts, joiners = parked
+            if time.monotonic() - ts <= self.PARKED_BEGIN_TTL:
+                col["members"].update(joiners)
+        expect_ids = {
+            pid for pid, _ in expected
+            if pid != self.peer_id and pid not in dead
+        }
+        try:
+            t0 = last_join = time.monotonic()
+            # Expected members get a real grace before the quiet-period
+            # break can freeze them out: under load a member can easily be
+            # a settle late, and freezing early costs it the whole round.
+            # Only a dead-but-not-yet-expired expected peer pays this wait.
+            grace = min(max(4.0 * settle, 1.0), deadline_mono - t0)
+            while True:
+                now = time.monotonic()
+                joined = col["members"]
+                if expect_ids <= joined.keys():
+                    break  # everyone this view expects is here: lead NOW
+                if len(joined) + 1 >= max_group:
+                    break
+                if (
+                    len(joined) + 1 >= min_group
+                    and now - last_join >= settle
+                    and now - t0 >= grace
+                ):
+                    break  # formable, quiet, and stragglers had their grace
+                if now >= deadline_mono:
+                    if len(joined) + 1 >= min_group:
+                        break
+                    log.info("round %s: only %d peers joined, skipping",
+                             round_key, len(joined) + 1)
+                    return None
+                col["event"].clear()
+                # Formable already: wake at the settle boundary. Not yet:
+                # wake at 1s ticks just to re-check the deadline.
+                wait = min(
+                    settle if len(joined) + 1 >= min_group else 1.0,
+                    deadline_mono - now,
+                )
+                try:
+                    await asyncio.wait_for(col["event"].wait(), timeout=wait)
+                    last_join = time.monotonic()
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self._join_collectors.pop(round_key, None)
+        # Freeze. From here a late join is answered "too late" (bounded
+        # map: TTL-swept on insert, same cap discipline as parked begins).
+        now = time.monotonic()
+        for k in [
+            k for k, t in self._recent_leads.items()
+            if now - t > self.PARKED_BEGIN_TTL
+        ]:
+            del self._recent_leads[k]
+        while len(self._recent_leads) >= self.MAX_PARKED_BEGINS:
+            self._recent_leads.pop(next(iter(self._recent_leads)))
+        self._recent_leads[round_key] = now
+        # Self leads, joiners fill the group in id order (the cap
+        # can never drop the leader).
+        others = sorted(col["members"].items())[: max(max_group - 1, 1)]
+        members = [(self.peer_id, self.transport.addr)] + [
+            (pid, tuple(addr)) for pid, addr in others
+        ]
+        return await self._lead(
+            round_key, sorted(members),
+            min_group=min_group, round_budget_s=round_budget_s,
+        )
+
     def _pick_leader(self, members: List[Tuple[str, Addr]]) -> str:
         """Who should self-elect for this candidate set: the smallest
         peer_id the local ``lead_exclude`` predicate does NOT flag, falling
@@ -265,7 +687,7 @@ class Matchmaker:
         min_group: int = 2,
         round_budget_s: Optional[float] = None,
     ) -> Optional[Group]:
-        import uuid
+        import os as _os
 
         members = self._preexclude(members, min_group)
         # The protocol's leader slot IS members[0] (Group.leader_id; the
@@ -281,10 +703,16 @@ class Matchmaker:
             + [m for m in members if m[0] != self.peer_id]
         )
         ids = [pid for pid, _ in members]
-        nonce = uuid.uuid4().hex[:8]
+        # One urandom syscall covers the nonce and every member token
+        # (one uuid4 per member was ~5 getrandom syscalls per round).
+        rand = _os.urandom(4 + 16 * len(ids))
+        nonce = rand[:4].hex()
         epoch = self._epoch(round_key, ids, nonce)
         # One secret per member, delivered only in that member's begin.
-        tokens = {pid: uuid.uuid4().hex for pid in ids}
+        tokens = {
+            pid: rand[4 + 16 * i : 20 + 16 * i].hex()
+            for i, pid in enumerate(ids)
+        }
         # Deadline stamped BEFORE the begin fan-out: the fan-out itself
         # (up to 5s per unreachable member) spends round budget, and every
         # member must agree on the same absolute commit time.
@@ -301,10 +729,7 @@ class Matchmaker:
         if deadline is not None:
             begin["deadline"] = deadline
             begin["budget"] = float(round_budget_s)
-        reached = []
-        for pid, addr in members:
-            if pid == self.peer_id:
-                continue
+        async def _begin_one(pid: str, addr: Addr) -> Optional[str]:
             try:
                 # The begin fan-out spends round budget per member: bound
                 # the dial separately (an unreachable member should cost its
@@ -315,9 +740,25 @@ class Matchmaker:
                     addr, "avg.begin", {**begin, "token": tokens[pid]},
                     timeout=5.0, connect_timeout=3.0,
                 )
-                reached.append(pid)
-            except Exception as e:
+                return pid
+            except Exception as e:  # noqa: BLE001 — one corpse must not kill the round
                 log.warning("round %s: member %s unreachable at begin: %s", round_key, pid, errstr(e))
+                return None
+
+        # Concurrent fan-out: one dead member costs its connect timeout in
+        # PARALLEL with the live sends, not serially ahead of them (a
+        # serial loop made every member behind a corpse start late).
+        reached = [
+            pid
+            for pid in await asyncio.gather(
+                *(
+                    _begin_one(pid, addr)
+                    for pid, addr in members
+                    if pid != self.peer_id
+                )
+            )
+            if pid is not None
+        ]
         if not reached:
             return None
         return Group(

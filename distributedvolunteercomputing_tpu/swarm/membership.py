@@ -53,6 +53,15 @@ class SwarmMembership:
         self._seen_beats: dict = {}
         self._heartbeat_task: Optional[asyncio.Task] = None
         self._left = False
+        # Last live read of the peers key, for alive_peers(max_age=...):
+        # consumers on a round's critical path (the group schedule's
+        # per-round split) accept a view one heartbeat old instead of
+        # paying an iterative DHT lookup per round. Set keep_snapshot_fresh
+        # to make the heartbeat loop refresh it even without a failure
+        # detector attached.
+        self._snapshot: Optional[Dict[str, dict]] = None
+        self._snapshot_t = 0.0
+        self.keep_snapshot_fresh = False
 
     def _record(self) -> dict:
         return {
@@ -87,11 +96,13 @@ class SwarmMembership:
                     await self.dht.store(
                         PEERS_KEY, self._record(), subkey=self.peer_id, ttl=self.ttl
                     )
-                    if self.failure_detector is not None:
+                    if self.failure_detector is not None or self.keep_snapshot_fresh:
                         # Piggyback one observation pass per own beat: the
                         # detector keeps accruing even when nothing else on
                         # this node happens to call alive_peers (an idle
-                        # trainer between wall-clock cadence boundaries).
+                        # trainer between wall-clock cadence boundaries),
+                        # and the snapshot stays one-beat fresh for
+                        # max_age readers.
                         await self.alive_peers()
                 except Exception as e:
                     log.warning("heartbeat store failed: %s", errstr(e))
@@ -126,16 +137,43 @@ class SwarmMembership:
                     fd.observe_latency(pid, lat)
 
     async def alive_peers(
-        self, include_self: bool = True, exclude_suspected: bool = False
+        self,
+        include_self: bool = True,
+        exclude_suspected: bool = False,
+        max_age: float = 0.0,
     ) -> Dict[str, dict]:
         """Live peer_id -> record; tombstones (None) are filtered out.
 
         ``exclude_suspected`` additionally drops peers the phi-accrual
         detector currently suspects — the soft pre-exclusion consumers like
         gossip partner selection opt into (the hard TTL filter always
-        applies)."""
+        applies).
+
+        ``max_age`` > 0 accepts a cached view at most that old instead of
+        walking the DHT — for per-round consumers (the group schedule's
+        split) where one heartbeat interval of staleness only ever costs
+        an underfilled formation, never correctness. Detector bookkeeping
+        runs on live reads only (a cache re-read carries no new beats)."""
+        if (
+            max_age > 0
+            and self._snapshot is not None
+            and time.monotonic() - self._snapshot_t <= max_age
+        ):
+            out = dict(self._snapshot)
+            if self.failure_detector is not None and exclude_suspected:
+                out = {
+                    pid: info
+                    for pid, info in out.items()
+                    if pid == self.peer_id
+                    or not self.failure_detector.suspect(pid)
+                }
+            if not include_self:
+                out.pop(self.peer_id, None)
+            return out
         rec = await self.dht.get(PEERS_KEY)
         out = {pid: info for pid, info in rec.items() if info is not None}
+        self._snapshot = dict(out)
+        self._snapshot_t = time.monotonic()
         self._observe_beats(out)
         if self.failure_detector is not None:
             # A tombstoned/expired peer must not keep accruing silence as
@@ -152,6 +190,13 @@ class SwarmMembership:
         if not include_self:
             out.pop(self.peer_id, None)
         return out
+
+    def invalidate_snapshot(self) -> None:
+        """Force the next ``alive_peers(max_age=...)`` to walk the DHT.
+        Called by consumers whose operation FAILED in a way stale
+        membership explains (a scheduled group that never formed): the
+        cheap view was wrong, buy a fresh one."""
+        self._snapshot = None
 
     def update_info(self, **kv: object) -> None:
         """Update fields (e.g. current step) carried in the next heartbeat."""

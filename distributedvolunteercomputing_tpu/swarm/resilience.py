@@ -109,6 +109,13 @@ class ResiliencePolicy:
         # leadership exclusion) fires on it.
         self.leaders_deposed = 0
         self._method_level = 0
+        # Per-group round records (multi-group schedule): group ids rotate
+        # every window, so this is a bounded most-recent map plus it keeps
+        # the LEARNING unit honest — deadlines and outcome history stay
+        # per-PEER (peers persist across rotating groups; a group id does
+        # not), while these gauges expose per-group commit health so an
+        # operator can see which group is slow instead of one flat number.
+        self.group_rounds: Dict[str, dict] = {}
         # One slow round must count ONCE: a peer whose push lands after the
         # commit is seen twice (absent in the commit batch, late on the RPC
         # path), in either order. These two sets reconcile the duplicate —
@@ -169,6 +176,29 @@ class ResiliencePolicy:
             st.absent *= self.decay
             st.rejected *= self.decay
 
+    MAX_GROUP_RECORDS = 16
+
+    def _note_group(
+        self, group_id: Optional[str], *, ok: bool, degraded: bool,
+        duration_s: float, absent_n: int,
+    ) -> None:
+        if group_id is None:
+            return
+        rec = self.group_rounds.get(group_id)
+        if rec is None:
+            while len(self.group_rounds) >= self.MAX_GROUP_RECORDS:
+                self.group_rounds.pop(next(iter(self.group_rounds)))
+            rec = self.group_rounds[group_id] = {
+                "rounds": 0, "ok": 0, "degraded": 0,
+                "excluded": 0, "last_dt_s": None, "deadline_s": None,
+            }
+        rec["rounds"] += 1
+        rec["ok"] += int(ok)
+        rec["degraded"] += int(degraded)
+        rec["excluded"] += absent_n
+        rec["last_dt_s"] = round(duration_s, 3)
+        rec["deadline_s"] = round(self._deadline, 3)
+
     def record_round(
         self,
         *,
@@ -179,6 +209,7 @@ class ResiliencePolicy:
         late: Iterable[str] = (),
         absent: Iterable[str] = (),
         rejected: Iterable[str] = (),
+        group_id: Optional[str] = None,
     ) -> None:
         """One finished round, from whichever vantage this node had (a
         leader knows per-peer arrivals; a member may only know ok/duration).
@@ -189,6 +220,11 @@ class ResiliencePolicy:
         and observing it would ratchet the estimate to the ceiling in
         exactly the persistent-straggler case this policy targets."""
         self.rounds_seen += 1
+        absent = list(absent)
+        self._note_group(
+            group_id, ok=ok, degraded=degraded,
+            duration_s=duration_s, absent_n=len(absent),
+        )
         self._decay_all()
         for p in on_time:
             st = self._peer(p)
@@ -302,7 +338,7 @@ class ResiliencePolicy:
         return _METHOD_LADDER[self._method_level]
 
     def stats(self) -> dict:
-        return {
+        out = {
             "deadline_s": round(self._deadline, 3),
             "rounds_seen": self.rounds_seen,
             "rounds_degraded": self.rounds_degraded,
@@ -320,3 +356,6 @@ class ResiliencePolicy:
                 for p, st in self.peers.items()
             },
         }
+        if self.group_rounds:
+            out["groups"] = {g: dict(r) for g, r in self.group_rounds.items()}
+        return out

@@ -89,6 +89,20 @@ class VolunteerConfig:
     powersgd_rank: int = 4
     min_group: int = 2
     max_group: int = 16
+    # Multi-group round scheduling (Moshpit-style): partition the live
+    # swarm into many groups of ~this size per round via a rotating seeded
+    # hash grid over the DHT keyspace, instead of one group per epoch —
+    # swarm-wide sync throughput stops being capped by one leader's NIC,
+    # and group averages still mix globally in O(log N) rounds because the
+    # grid re-seeds every rotation. 0 = off (classic single-group
+    # rendezvous). Gather-style modes only (sync/byzantine/butterfly).
+    group_size: int = 0
+    # Rotation cadence of the group schedule, seconds. 0 = AUTO: the
+    # wall-clock averaging interval when one is set (one fresh grid per
+    # round boundary), else 15s. Every member of a prospective group must
+    # land in the same rotation window to rendezvous, so wall-cadence
+    # swarms (clock-synced) are the natural fit.
+    group_rotation_s: float = 0.0
     batch_size: int = 32  # samples per optimizer step (across accum microbatches)
     # Scan up to N steps inside one compiled call between cadence points
     # (host-loop amortization; params mode, no mesh). 1 = off.
@@ -197,6 +211,37 @@ class VolunteerConfig:
             raise ValueError(
                 f"phi_threshold must be > 0, got {self.phi_threshold}"
             )
+        if self.group_rotation_s < 0:
+            raise ValueError(
+                f"group_rotation_s must be >= 0, got {self.group_rotation_s}"
+            )
+        if self.group_size:
+            # Fail at config time (the method/wire validation policy): the
+            # schedule only makes sense for round-structured gather-style
+            # modes — gossip has no rounds to group and "none" no averaging.
+            if self.group_size < 2:
+                raise ValueError(
+                    f"group_size must be >= 2 (or 0 = off), got {self.group_size}"
+                )
+            if self.averaging not in ("sync", "byzantine", "butterfly"):
+                raise ValueError(
+                    "--group-size requires --averaging sync, byzantine, or "
+                    "butterfly (gossip is pairwise — there is no round-"
+                    "structured group to schedule)"
+                )
+            if self.group_size < self.min_group:
+                raise ValueError(
+                    f"group_size {self.group_size} < min_group "
+                    f"{self.min_group}: every scheduled group would be "
+                    "refused at formation"
+                )
+            if self.group_size > self.max_group:
+                raise ValueError(
+                    f"group_size {self.group_size} > max_group "
+                    f"{self.max_group}: the leader freezes at max_group, so "
+                    "the surplus members of every scheduled group would "
+                    "join-retry until the deadline and skip the round"
+                )
         if self.average_interval_s > 0:
             if self.average_what != "params":
                 raise ValueError(
@@ -485,6 +530,23 @@ class Volunteer:
                 resilience=self.resilience_policy,
                 failure_detector=self.failure_detector,
             )
+            if self.cfg.group_size:
+                from distributedvolunteercomputing_tpu.swarm.matchmaking import (
+                    GroupSchedule,
+                )
+
+                # Rotation rides the consensus clock when one exists: every
+                # member of a prospective group must land in the same
+                # window or they rendezvous under different keys.
+                kw["group_schedule"] = GroupSchedule(
+                    target_size=self.cfg.group_size,
+                    rotation_s=self.cfg.group_rotation_s
+                    or (self.cfg.average_interval_s or 15.0),
+                    clock=self.clocksync.now
+                    if self.clocksync is not None
+                    else time.time,
+                    min_size=self.cfg.min_group,
+                )
             if self.cfg.averaging == "byzantine" and (
                 self.cfg.method != "mean" or self.cfg.wire == "topk"
             ):
@@ -731,6 +793,15 @@ class Volunteer:
                     # failure mid-run shows up in coord.status as
                     # backend=host/configured=mesh while training continues.
                     report["mesh_codec"] = self.averager.mesh_codec.stats()
+                if (
+                    self.averager is not None
+                    and getattr(self.averager, "group_schedule", None) is not None
+                ):
+                    # Multi-group schedule gauges (current rotation/group,
+                    # per-group round counters): coord.status rolls these
+                    # up per group swarm-wide instead of silently averaging
+                    # across groups.
+                    report["groups"] = self.averager.group_stats()
                 failover_stats = getattr(self.averager, "failover_stats", None)
                 if failover_stats is not None:
                     fo = failover_stats()

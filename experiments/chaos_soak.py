@@ -95,6 +95,8 @@ from distributedvolunteercomputing_tpu.swarm.transport import (  # noqa: E402
 
 STRAGGLER = "v3"  # sorts last: v0 always leads
 
+from distributedvolunteercomputing_tpu.swarm.matchmaking import GroupSchedule  # noqa: E402
+
 
 def tree_for(i: int, size: int = 2048):
     return {"w": np.full((size,), float(i), np.float32)}
@@ -570,6 +572,234 @@ async def fencing_scenario():
     return res
 
 
+# -- multi-group campaign (ISSUE 7 acceptance) ------------------------------
+
+
+def _pinned_schedule(rot_cell, target):
+    """Schedule whose rotation the campaign advances explicitly (a shared
+    cell instead of wall clock), so each kill round runs against a KNOWN
+    partition."""
+    return GroupSchedule(
+        target_size=target, rotation_s=1000.0,
+        clock=lambda: rot_cell["rot"] * 1000.0 + 0.5,
+    )
+
+
+async def _make_mg_node(pid, boot, rot_cell, target, gather_timeout):
+    t = Transport()
+    dht = DHTNode(t)
+    await dht.start(bootstrap=[boot])
+    fd = PhiAccrualDetector(bootstrap_s=2.0)
+    policy = ResiliencePolicy(
+        max_deadline_s=gather_timeout, min_deadline_s=1.0,
+        preexclude_misses=3, failure_detector=fd,
+    )
+    mem = SwarmMembership(dht, pid, ttl=10.0, failure_detector=fd)
+    await mem.join()
+    avg = SyncAverager(
+        t, dht, mem,
+        min_group=2, max_group=3 * target,
+        join_timeout=8.0, gather_timeout=gather_timeout,
+        resilience=policy, failure_detector=fd,
+        group_schedule=_pinned_schedule(rot_cell, target),
+    )
+    return {"pid": pid, "t": t, "dht": dht, "mem": mem, "avg": avg,
+            "fd": fd, "policy": policy}
+
+
+def _find_rot(pids, target, start, need_big=True):
+    """Next rotation whose partition has every group formable (>= 2) and —
+    when a kill is planned — at least one group with >= 3 members (the
+    victim's group must keep min_group survivors after the leader dies)."""
+    rot = start
+    while True:
+        groups = GroupSchedule.partition(pids, rot, target)
+        if (
+            len(groups) >= 2
+            and all(len(g) >= 2 for g in groups)
+            and (not need_big or any(len(g) >= 3 for g in groups))
+        ):
+            return rot, groups
+        rot += 1
+
+
+async def _revive_mg(vol, vols):
+    vol["avg"]._phase_hooks.clear()
+    for st in vol["avg"]._rounds.values():
+        if st.stream is not None:
+            st.stream.fence()
+    vol["avg"]._rounds.clear()
+    await vol["t"].start()
+    await vol["mem"].join()
+    for v in vols:
+        if v is vol:
+            continue
+        v["avg"]._deposed_leaders.pop(vol["pid"], None)
+        v["fd"]._failed.pop(vol["pid"], None)
+        v["policy"].peers.pop(vol["pid"], None)
+
+
+async def multigroup_campaign(args):
+    """Multi-group churn arm (``--multigroup``): an 8-volunteer swarm on a
+    rotating 3-ish-sized group schedule. Each kill round, ONE group's
+    leader dies mid-stream; the acceptance bar is that every OTHER group's
+    round commits on time with zero failover activity (the kill stays
+    group-local), while the victim's own survivors recover via the
+    epoch-fenced failover from PR 4. A flash-crowd join burst lands
+    mid-campaign and the next rotations must absorb the newcomers.
+    Artifact: experiments/results/chaos_multigroup.json."""
+    gather_timeout = 8.0
+    target = 3
+    rot_cell = {"rot": 0}
+    boot_t = Transport()
+    boot_dht = DHTNode(boot_t)
+    await boot_dht.start(bootstrap=None)
+    vols = []
+    out = {"seed": args.seed, "kill_rounds": args.multigroup_rounds,
+           "group_target": target, "per_round": []}
+    try:
+        for i in range(8):
+            vols.append(await _make_mg_node(
+                f"m{i}", boot_t.addr, rot_cell, target, gather_timeout
+            ))
+        pid_of = {v["pid"]: v for v in vols}
+
+        # Healthy warmup: learn deadlines + formation overhead, prove the
+        # schedule itself commits.
+        warm_dts = []
+        rot = 1
+        for r in range(2):
+            rot, _ = _find_rot([v["pid"] for v in vols], target, rot,
+                               need_big=False)
+            rot_cell["rot"] = rot
+            results = await asyncio.gather(
+                *(_timed_average(v, i, r) for i, v in enumerate(vols))
+            )
+            assert all(
+                res is not None and not isinstance(res, BaseException)
+                for _, res in results
+            ), f"healthy multigroup warmup round {r} failed"
+            warm_dts.append(max(dt for dt, _ in results))
+            rot += 1
+        overhead = max(max(warm_dts), 1.0) + SyncAverager.RECOVERY_BEGIN_WAIT_S
+        out["warmup_max_round_s"] = round(max(warm_dts), 3)
+
+        burst_at = args.multigroup_rounds // 2
+        joined_burst = False
+        for k in range(args.multigroup_rounds):
+            if k == burst_at and not joined_burst:
+                # Flash crowd: 4 volunteers join between rounds; the next
+                # rotation's partition includes them immediately.
+                for i in range(8, 12):
+                    vols.append(await _make_mg_node(
+                        f"m{i}", boot_t.addr, rot_cell, target, gather_timeout
+                    ))
+                pid_of = {v["pid"]: v for v in vols}
+                joined_burst = True
+                # Newcomers are visible to the split once every volunteer's
+                # membership snapshot has refreshed — one heartbeat
+                # interval (ttl/3), the TTL-membership system's propagation
+                # resolution. Rotating before that measures a stale-view
+                # divergence the schedule already tolerates (underfilled
+                # rounds), not the flash-crowd absorption being asserted.
+                await asyncio.sleep(vols[0]["mem"].ttl / 3.0 + 0.7)
+            pids = [v["pid"] for v in vols]
+            rot, groups = _find_rot(pids, target, rot)
+            rot_cell["rot"] = rot
+            victim_group = next(g for g in groups if len(g) >= 3)
+            victim = pid_of[min(victim_group)]  # smallest id leads its group
+            others = [
+                v for v in vols
+                if v["pid"] not in victim_group
+            ]
+            survivors = [
+                pid_of[p] for p in victim_group if p != victim["pid"]
+            ]
+            budget = others[0]["avg"]._round_budget()
+            before = {
+                v["pid"]: (v["avg"].leaders_deposed, v["avg"].rounds_recovered)
+                for v in vols
+            }
+            _install_kill(victim, "mid_stream")
+            results = await asyncio.gather(
+                *(_timed_average(v, i, 100 + k) for i, v in enumerate(vols))
+            )
+            by_pid = {v["pid"]: res for v, res in zip(vols, results)}
+            other_ok = [
+                by_pid[v["pid"]][1] is not None
+                and not isinstance(by_pid[v["pid"]][1], BaseException)
+                for v in others
+            ]
+            other_max_dt = max(by_pid[v["pid"]][0] for v in others)
+            other_failover_clean = all(
+                (v["avg"].leaders_deposed, v["avg"].rounds_recovered)
+                == before[v["pid"]]
+                for v in others
+            )
+            surv_recovered = sum(
+                v["avg"].rounds_recovered > before[v["pid"]][1]
+                for v in survivors
+            )
+            out["per_round"].append({
+                "round": k,
+                "rot": rot,
+                "n_groups": len(groups),
+                "victim": victim["pid"],
+                "victim_group_size": len(victim_group),
+                "others_committed": sum(other_ok),
+                "others_total": len(others),
+                "others_all_committed": all(other_ok),
+                "others_max_dt_s": round(other_max_dt, 3),
+                "others_within_budget": other_max_dt <= budget + overhead,
+                "others_failover_clean": other_failover_clean,
+                "survivors_recovered": surv_recovered,
+                "survivors_total": len(survivors),
+                "after_join_burst": joined_burst,
+            })
+            await _revive_mg(victim, vols)
+            await asyncio.sleep(0.3)
+            rot += 1
+
+        recs = out["per_round"]
+        out["verdict_inputs"] = {
+            "others_unaffected_rounds": sum(
+                r["others_all_committed"]
+                and r["others_within_budget"]
+                and r["others_failover_clean"]
+                for r in recs
+            ),
+            "rounds": len(recs),
+            "local_recovery_rounds": sum(
+                r["survivors_recovered"] > 0 for r in recs
+            ),
+            "burst_rounds_committed": sum(
+                r["others_all_committed"] for r in recs if r["after_join_burst"]
+            ),
+            "burst_rounds": sum(1 for r in recs if r["after_join_burst"]),
+            "max_groups_seen": max(r["n_groups"] for r in recs),
+        }
+    finally:
+        for v in vols:
+            try:
+                await v["mem"].leave()
+            except Exception:
+                pass
+            try:
+                await v["dht"].stop()
+            except Exception:
+                pass
+            try:
+                await v["t"].close()
+            except Exception:
+                pass
+        try:
+            await boot_dht.stop()
+        except Exception:
+            pass
+        await boot_t.close()
+    return out
+
+
 # -- training phase (subprocess volunteers, real entrypoints) --------------
 
 
@@ -740,6 +970,14 @@ def main():
                          "and must fall back to host without failing a round")
     ap.add_argument("--mesh-degrade-rounds", type=int, default=10,
                     help="averaging rounds in the mesh-degrade arm")
+    ap.add_argument("--multigroup", action="store_true",
+                    help="run the multi-group churn arm instead: rotating "
+                         "group schedule, one group's leader killed "
+                         "mid-round per kill round (other groups must "
+                         "commit unaffected), plus a flash-crowd join "
+                         "burst mid-campaign")
+    ap.add_argument("--multigroup-rounds", type=int, default=6,
+                    help="kill rounds in the multigroup arm")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     if args.out is None:
@@ -747,6 +985,7 @@ def main():
             REPO, "experiments", "results",
             "chaos_failover.json" if args.failover
             else "chaos_mesh_degrade.json" if args.mesh_degrade
+            else "chaos_multigroup.json" if args.multigroup
             else "chaos_soak.json",
         )
     if args.quick:
@@ -755,7 +994,33 @@ def main():
         args.blocking_rounds = 3
         args.failover_rounds = 5
         args.mesh_degrade_rounds = 4
+        args.multigroup_rounds = 3
         args.no_train = True
+
+    if args.multigroup:
+        result = {"multigroup_campaign": asyncio.run(multigroup_campaign(args))}
+        mg = result["multigroup_campaign"]["verdict_inputs"]
+        result["verdict"] = {
+            # The acceptance bar: a group-leader kill never delays or
+            # taints any OTHER group's round in the same rotation.
+            "pass_other_groups_unaffected": (
+                mg["others_unaffected_rounds"] == mg["rounds"]
+            ),
+            "pass_local_recovery": (
+                mg["local_recovery_rounds"] >= 0.8 * mg["rounds"]
+            ),
+            "pass_flash_crowd": (
+                mg["burst_rounds"] > 0
+                and mg["burst_rounds_committed"] == mg["burst_rounds"]
+                and mg["max_groups_seen"] >= 4
+            ),
+        }
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[done] artifact -> {args.out}")
+        print(json.dumps(result["verdict"], indent=2))
+        sys.exit(0 if all(result["verdict"].values()) else 1)
 
     if args.mesh_degrade:
         result = {"mesh_degrade_campaign": asyncio.run(mesh_degrade_campaign(args))}

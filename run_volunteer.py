@@ -106,6 +106,19 @@ def main() -> None:
                          "than this many steps (0 = no bound)")
     ap.add_argument("--min-group", type=int, default=2)
     ap.add_argument("--max-group", type=int, default=16)
+    ap.add_argument("--group-size", type=int, default=0,
+                    help="multi-group round scheduling (Moshpit-style): "
+                         "partition the live swarm into many groups of "
+                         "~this size per round via a rotating seeded hash "
+                         "grid over the DHT keyspace, so sync throughput "
+                         "is no longer capped by one leader's NIC; group "
+                         "averages mix globally in O(log N) rounds. 0 = "
+                         "off (one group per epoch). sync/byzantine/"
+                         "butterfly only")
+    ap.add_argument("--group-rotation-s", type=float, default=0.0,
+                    help="rotation cadence of the group schedule, seconds "
+                         "(0 = auto: the wall-clock averaging interval "
+                         "when set, else 15s)")
     ap.add_argument("--method", default="trimmed_mean",
                     help="byzantine estimator: trimmed_mean|median|krum|"
                          "geometric_median|bulyan|centered_clip")
@@ -252,6 +265,8 @@ def main() -> None:
         max_staleness=args.max_staleness,
         min_group=args.min_group,
         max_group=args.max_group,
+        group_size=args.group_size,
+        group_rotation_s=args.group_rotation_s,
         method=args.method,
         method_kw=method_kw or None,
         batch_size=args.batch_size,

@@ -59,6 +59,13 @@ def pytest_configure(config):
         "fallback, codec bench smoke) — in the default lane, and "
         "selectable on their own with -m mesh_codec",
     )
+    config.addinivalue_line(
+        "markers",
+        "multigroup: rotating multi-group schedule tests (grid partition, "
+        "Moshpit mixing bound, group-scoped rounds, group-local failover, "
+        "per-group stats rollups, scale-bench smoke) — in the default "
+        "lane, and selectable on their own with -m multigroup",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
