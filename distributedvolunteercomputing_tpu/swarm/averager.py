@@ -305,6 +305,7 @@ class AveragerBase:
         self.matchmaker = Matchmaker(
             transport, dht, self.peer_id, clock=self.clock, exclude=exclude,
             lead_exclude=self._lead_excluded,
+            lead_weight=self._advertised_bw,
         )
         self.min_group = min_group
         self.max_group = max_group
@@ -385,8 +386,32 @@ class AveragerBase:
             "rounds_led": 0, "last_commit_t": None,
         }
         self._groups_seen = 0
+        # Per-hierarchy-level round counters (flat | intra | cross), only
+        # populated on schedule-attached nodes: the observability half of
+        # the hierarchical schedule — an operator must be able to see the
+        # intra/cross cadence actually happening, per level, not folded
+        # into one gauge.
+        self._level_totals: Dict[str, Dict[str, int]] = {}
 
     MAX_GROUP_GAUGES = 16
+
+    @property
+    def zone(self) -> str:
+        """This volunteer's advertised zone ("" = unzoned), read from the
+        membership record fields so the schedule, the stats, and the wire
+        advertisement can never disagree."""
+        return str(self.membership.extra_info.get("zone") or "")
+
+    def _advertised_bw(self, pid: str) -> Optional[float]:
+        """Advertised uplink bandwidth (bytes/s) for a leadership
+        candidate, from the cached membership snapshot — the deterministic
+        rendezvous input for bandwidth-weighted leader election (no extra
+        RPCs; one-heartbeat staleness resolves via begin-wins)."""
+        rec = self.membership.peer_record(pid)
+        bw = (rec or {}).get("bw_up")
+        if isinstance(bw, (int, float)) and not isinstance(bw, bool) and bw > 0:
+            return float(bw)
+        return None
 
     async def _rendezvous(self) -> str:
         """Rendezvous key for the NEXT round: the constant per-mode key
@@ -419,7 +444,15 @@ class AveragerBase:
             or not self.namespace
             or rec.get("avg_ns", self.namespace) == self.namespace
         ]
-        asg = self.group_schedule.assign(ids, self.peer_id)
+        # Zone advertisements for the hierarchical split (peers without one
+        # — mixed-version swarms — schedule as the "" pseudo-zone; our own
+        # zone comes from our record, or the local config if the snapshot
+        # predates our join).
+        zones = {
+            pid: str(peers.get(pid, {}).get("zone") or "") for pid in ids
+        }
+        zones.setdefault(self.peer_id, self.zone)
+        asg = self.group_schedule.assign(ids, self.peer_id, zones=zones)
         if asg is None:
             return self.round_key
         self._last_group = asg
@@ -446,6 +479,25 @@ class AveragerBase:
         deterministic, so the generic DHT rendezvous (K-replica store +
         iterative lookup per poll, ~60 DHT RPCs per member-round at N=16)
         collapses to ~4 direct RPCs — else the classic DHT rendezvous."""
+        if (
+            self._last_group is not None
+            and len(self._last_group.members) < max(2, self.min_group)
+        ):
+            # A scheduled group below the configured floor (a lone peer —
+            # or an undersized zone — at an intra rotation): the schedule
+            # is deterministic, so the members that could rendezvous under
+            # this key can never reach min_group — skip in O(1) instead of
+            # burning the whole join timeout, and never run a round
+            # beneath the operator's robustness minimum (a byzantine
+            # min_group is a breakdown-point guarantee, not a preference).
+            # The members keep training locally and re-mix at the next
+            # cross rotation.
+            log.debug(
+                "round %s: scheduled group of %d below min_group %d, "
+                "skipping", round_key, len(self._last_group.members),
+                self.min_group,
+            )
+            return None
         if self._last_group is not None and len(self._last_group_expected) >= 2:
             group = await self.matchmaker.form_group_direct(
                 round_key, self._last_group_expected,
@@ -483,6 +535,7 @@ class AveragerBase:
             return
         asg = self._last_group
         gid = asg.group_id if asg is not None else "single"
+        level = asg.level if asg is not None else "flat"
         rec = self._group_recent.get(gid)
         if rec is None:
             self._groups_seen += 1
@@ -491,9 +544,20 @@ class AveragerBase:
             rec = self._group_recent[gid] = {
                 "rounds_ok": 0, "rounds_skipped": 0, "rounds_degraded": 0,
                 "rounds_led": 0, "size": 0, "last_commit_t": None,
+                "level": level,
+                "zone": asg.zone if asg is not None else "",
             }
         if size:
             rec["size"] = size
+        lv = self._level_totals.setdefault(
+            level, {"rounds_ok": 0, "rounds_skipped": 0, "rounds_degraded": 0}
+        )
+        if ok:
+            lv["rounds_ok"] += 1
+            if degraded:
+                lv["rounds_degraded"] += 1
+        else:
+            lv["rounds_skipped"] += 1
         tot = self._group_totals
         if ok:
             rec["rounds_ok"] += 1
@@ -511,26 +575,63 @@ class AveragerBase:
             rec["rounds_skipped"] += 1
             tot["rounds_skipped"] += 1
 
+    def zone_traffic(self) -> dict:
+        """WAN bytes split by zone locality, from the transport's per-peer
+        counters joined against the membership snapshot's addr -> zone map
+        (all traffic to a peer counts — averaging payloads dominate, and
+        DHT/heartbeat bytes cross the same links). Peers whose address is
+        not in the snapshot (departed, or the coordinator) are uncharged.
+        This is the live, per-volunteer form of the hierarchical
+        schedule's headline metric: cross-zone bytes, rollable into
+        cross_zone_bytes_per_commit at the coordinator."""
+        myz = self.zone
+        zmap = self.membership.zone_by_addr()
+        out = {
+            "cross_zone_bytes_sent": 0, "cross_zone_bytes_received": 0,
+            "intra_zone_bytes_sent": 0, "intra_zone_bytes_received": 0,
+        }
+        # Same-package read of the transport's per-peer counters (the
+        # public stats() form stringifies the addr key).
+        for addr, st in self.transport._peer_stats.items():
+            z = zmap.get(addr)
+            if z is None:
+                continue
+            side = "cross" if z != myz else "intra"
+            out[f"{side}_zone_bytes_sent"] += st.bytes_sent
+            out[f"{side}_zone_bytes_received"] += st.bytes_received
+        return out
+
     def group_stats(self) -> dict:
         """Group-schedule gauges for stats()/volunteer report/coord.status:
         the current assignment (rotation, group id, split), cumulative
         multigroup round counters, and a bounded per-group breakdown so
         dashboards can see per-group commit health instead of one flat
-        number silently averaging across groups."""
+        number silently averaging across groups. Hierarchy-aware: the
+        volunteer's zone, the current assignment's level, per-level round
+        counters, and the cross/intra-zone byte split ride along so the
+        coordinator can roll up per-zone health and cross-zone bytes per
+        committed round."""
         sched = self.group_schedule
         out: Dict[str, Any] = {"enabled": sched is not None}
         if sched is None:
             return out
         out["target_size"] = sched.target_size
         out["rotation_s"] = sched.rotation_s
+        if sched.cross_zone_every_k:
+            out["cross_zone_every_k"] = sched.cross_zone_every_k
+        out["zone"] = self.zone
         asg = self._last_seen_assignment
         if asg is not None:
             out["rot"] = asg.rot
             out["group_id"] = asg.group_id
             out["n_groups_view"] = asg.n_groups
             out["n_peers_view"] = asg.n_peers
+            out["level"] = asg.level
         out.update(self._group_totals)
         out["distinct_groups"] = self._groups_seen
+        if self._level_totals:
+            out["levels"] = {lv: dict(c) for lv, c in self._level_totals.items()}
+        out.update(self.zone_traffic())
         out["recent"] = {g: dict(r) for g, r in self._group_recent.items()}
         return out
 
@@ -651,6 +752,9 @@ class AveragerBase:
             degraded=self._round_degraded,
             group_id=(
                 self._last_group.group_id if self._last_group is not None else None
+            ),
+            level=(
+                self._last_group.level if self._last_group is not None else None
             ),
             **detail,
         )

@@ -34,9 +34,13 @@ import contextvars
 import dataclasses
 import random
 import time
-from typing import Iterable, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, Optional, Sequence, Set, Tuple
 
-from distributedvolunteercomputing_tpu.swarm.transport import Addr, Transport
+from distributedvolunteercomputing_tpu.swarm.transport import (
+    Addr,
+    Transport,
+    _payload_len,
+)
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -171,6 +175,17 @@ class ChaosTransport(Transport):
     # corrupt-offset hook, and any attached FaultSchedule: the partition
     # check runs first (a cut link delivers nothing to delay or corrupt).
     _partitions: Set[frozenset] = set()
+    # Process-wide per-peer-pair LINK MODEL (set_link): propagation latency
+    # plus serialization bandwidth for the edge between two addresses.
+    # Class-level for the same reason as _partitions — a link is a property
+    # of the path between two nodes. Applied on the OUTBOUND half at each
+    # endpoint (delay = latency + request_payload/bw before the call), so a
+    # WAN scenario models what the hierarchical schedule cares about: a
+    # member's bulk contribution push crossing a thin/far edge pays for it
+    # in wall time. Composes with everything above — partition first (a cut
+    # link delivers nothing), then the link delay, then rates/schedules.
+    # Tests/campaigns must ``clear_links()`` in teardown.
+    _links: Dict[frozenset, Tuple[float, Optional[float]]] = {}
 
     def __init__(
         self,
@@ -254,6 +269,64 @@ class ChaosTransport(Transport):
             return False
         return self._pair(self.addr, addr) in ChaosTransport._partitions
 
+    # -- per-pair link model ------------------------------------------------
+
+    def set_link(
+        self,
+        peer_a,
+        peer_b,
+        latency_s: float = 0.0,
+        bw_bps: Optional[float] = None,
+    ) -> None:
+        """Model the link between two peer addresses: every call either
+        endpoint makes to the other first pays ``latency_s`` plus the
+        request payload's serialization time at ``bw_bps`` bytes/s (None =
+        unconstrained). The WAN building block for hierarchical-scheduling
+        scenarios — a two-zone swarm is a few fat intra-zone links plus
+        thin, far cross-zone ones. Both endpoints must run ChaosTransports
+        for both directions to be modeled; response payloads ride the
+        receiver's own outbound model when it calls back. Re-setting a
+        pair replaces its link; composes with ``partition``/``heal``,
+        constant rates, ``corrupt_at_frac``, and fault schedules.
+
+        Fidelity limit: the delay is applied BEFORE the call's bytes are
+        written, so it shapes WALL TIME but not the receiver's measured
+        arrival rate — the production bandwidth-measurement path (the
+        read-timed bw_down EWMA and the rx_bps uplink echo) still
+        observes localhost speed over a modeled thin link. Scenarios that
+        need bandwidth ADVERTISEMENTS under a modeled WAN inject them
+        directly via membership ``extra_info`` (hierarchy_bench does);
+        pacing the actual socket writes is a transport change, not a
+        wrapper's."""
+        if latency_s < 0:
+            raise ValueError(f"latency_s must be >= 0, got {latency_s}")
+        if bw_bps is not None and bw_bps <= 0:
+            raise ValueError(f"bw_bps must be > 0 (or None), got {bw_bps}")
+        ChaosTransport._links[self._pair(peer_a, peer_b)] = (
+            float(latency_s),
+            float(bw_bps) if bw_bps is not None else None,
+        )
+
+    def clear_links(self, peer_a=None, peer_b=None) -> None:
+        """Remove one modeled link; with a single peer, every link touching
+        that peer; with no arguments, every link (scenario teardown)."""
+        if peer_a is None:
+            ChaosTransport._links.clear()
+        elif peer_b is None:
+            pa = (str(peer_a[0]), int(peer_a[1]))
+            ChaosTransport._links = {
+                p: v for p, v in ChaosTransport._links.items() if pa not in p
+            }
+        else:
+            ChaosTransport._links.pop(self._pair(peer_a, peer_b), None)
+
+    def _link_delay(self, addr: Addr, n_bytes: int) -> float:
+        link = ChaosTransport._links.get(self._pair(self.addr, addr))
+        if link is None:
+            return 0.0
+        latency, bw = link
+        return latency + (n_bytes / bw if bw else 0.0)
+
     async def call(
         self,
         addr: Addr,
@@ -268,6 +341,14 @@ class ChaosTransport(Transport):
                 f"chaos: partitioned link {self.addr} <-> {tuple(addr)} "
                 f"(call {method} dropped)"
             )
+        if ChaosTransport._links:
+            link_delay = self._link_delay(
+                (str(addr[0]), int(addr[1])), _payload_len(payload)
+            )
+            if link_delay > 0:
+                # Deterministic (no jitter), like scheduled delays: a link
+                # model should reproduce exactly across campaign replays.
+                await asyncio.sleep(link_delay)
         if self.drop_rate and self._chaos.random() < self.drop_rate:
             raise OSError(f"chaos: dropped call {method} to {addr}")
         if self.delay_s:

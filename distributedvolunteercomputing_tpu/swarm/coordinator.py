@@ -42,6 +42,15 @@ class Coordinator:
         # minute — a rate no single volunteer's flat counter can show.
         self._commit_seen: Dict[str, int] = {}
         self._commit_window: list = []
+        # Cross-zone byte rate (hierarchical-schedule rollup), tracked the
+        # same way: per-peer last-seen cumulative cross-zone bytes SENT
+        # (sent-side only, so each wire byte is counted once across the
+        # swarm — the same definition hierarchy_bench.json uses) and a
+        # sliding window of increments, so status can report
+        # cross_zone_bytes_per_commit — the hierarchical schedule's
+        # headline metric — live.
+        self._xz_seen: Dict[str, int] = {}
+        self._xz_window: list = []
         self.transport.register("coord.report", self._rpc_report)
         self.transport.register("coord.status", self._rpc_status)
 
@@ -95,9 +104,26 @@ class Coordinator:
                     delta = total
                 if delta > 0:
                     self._commit_window.append((now, delta))
+            xz = groups.get("cross_zone_bytes_sent")
+            if isinstance(xz, int):
+                prev = self._xz_seen.get(peer)
+                self._xz_seen[peer] = xz
+                # Unlike the commit counter, a DECREASE here re-baselines
+                # at delta 0 rather than counting from zero: the byte sum
+                # is cumulative-but-not-strictly-monotone (peer-stats LRU
+                # eviction or a zone re-attribution can dip it), and
+                # "count from zero" would re-inject a volunteer's entire
+                # lifetime cross-zone bytes as one phantom burst. A real
+                # volunteer restart just loses the first window's bytes.
+                xdelta = xz - prev if prev is not None and xz >= prev else 0
+                if xdelta > 0:
+                    self._xz_window.append((now, xdelta))
             cutoff = now - self.COMMIT_WINDOW_S
             self._commit_window = [
                 (t, d) for t, d in self._commit_window if t >= cutoff
+            ]
+            self._xz_window = [
+                (t, d) for t, d in self._xz_window if t >= cutoff
             ]
         for p in [
             p for p, m in self.latest_metrics.items()
@@ -105,6 +131,7 @@ class Coordinator:
         ]:
             self.latest_metrics.pop(p, None)
             self._commit_seen.pop(p, None)
+            self._xz_seen.pop(p, None)
         if self.metrics_path:
             with open(self.metrics_path, "a") as fh:
                 fh.write(json.dumps(self.latest_metrics[peer]) + "\n")
@@ -159,8 +186,32 @@ class Coordinator:
             for gid in active
             if gid in per_group and per_group[gid]["last_commit_t"] is not None
         ]
+        # Per-zone breakdown (hierarchical schedule): volunteers, commit
+        # totals, and each zone's cross-zone byte footprint — so an
+        # operator sees WHICH zone is burning WAN bytes or lagging, not
+        # one flat number averaging a DC slice against a home DSL line.
+        per_zone: Dict[str, dict] = {}
+        per_level: Dict[str, dict] = {}
+        for gs in gstats.values():
+            z = per_zone.setdefault(
+                str(gs.get("zone") or ""),
+                {"volunteers": 0, "rounds_ok": 0,
+                 "cross_zone_bytes_sent": 0, "cross_zone_bytes_received": 0},
+            )
+            z["volunteers"] += 1
+            z["rounds_ok"] += int(gs.get("rounds_ok") or 0)
+            for k in ("cross_zone_bytes_sent", "cross_zone_bytes_received"):
+                z[k] += int(gs.get(k) or 0)
+            for lv, rec in (gs.get("levels") or {}).items():
+                agg = per_level.setdefault(
+                    str(lv),
+                    {"rounds_ok": 0, "rounds_skipped": 0, "rounds_degraded": 0},
+                )
+                for k in agg:
+                    agg[k] += int(rec.get(k) or 0)
         cutoff = now - self.COMMIT_WINDOW_S
         commits = sum(d for t, d in self._commit_window if t >= cutoff)
+        xz_bytes = sum(d for t, d in self._xz_window if t >= cutoff)
         return {
             "volunteers": len(gstats),
             "rot": rot,
@@ -173,6 +224,16 @@ class Coordinator:
             ),
             "slowest_group_lag_s": round(max(lags), 3) if lags else None,
             "per_group": per_group,
+            "per_zone": per_zone,
+            "per_level": per_level or None,
+            # The hierarchical schedule's headline metric, live: WAN bytes
+            # that crossed a zone boundary (sent-side counters, each wire
+            # byte counted once — the hierarchy_bench definition) per
+            # committed volunteer-round, over the sliding window (None
+            # until a commit lands in it).
+            "cross_zone_bytes_per_commit": (
+                round(xz_bytes / commits, 1) if commits else None
+            ),
         }
 
     async def _rpc_status(self, args: dict, payload: bytes):

@@ -23,7 +23,10 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import hashlib
+import math
+import re
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 from distributedvolunteercomputing_tpu.swarm.dht import (
@@ -42,7 +45,7 @@ class GroupAssignment:
     """One volunteer's slot in one rotation of the group schedule."""
 
     rot: int        # rotation index (wall-clock window of the schedule)
-    group_id: str   # rendezvous-key suffix, e.g. "r42.g3"
+    group_id: str   # rendezvous-key suffix, e.g. "r42.g3" / "r42.zdc1.g0"
     n_groups: int   # how many groups THIS view's live count splits into
     n_peers: int    # live peers behind that split (this view)
     # The peer ids this view puts in MY group (sorted). The whole point of
@@ -51,6 +54,14 @@ class GroupAssignment:
     # full iterative lookup per poll) and members can join their leader
     # candidate directly — see Matchmaker.form_group_direct.
     members: Tuple[str, ...] = ()
+    # Hierarchy level this assignment schedules ("flat" = the single-level
+    # PR-7 grid; "intra" = a group scoped to one zone's members; "cross" =
+    # a cross-zone mixing rotation). The level rides in the group_id, so
+    # the group-scoped round key — and therefore the epoch hash, fencing
+    # tokens, and retained-bytes keys — is level-scoped by construction.
+    level: str = "flat"
+    # Zone an "intra" assignment is scoped to ("" otherwise).
+    zone: str = ""
 
 
 class GroupSchedule:
@@ -83,6 +94,27 @@ class GroupSchedule:
     - **best-effort sizing**: arcs are equal but positions are hashed, so
       group sizes fluctuate around ``target_size``; an undersized group
       skips its round (min_group) and its members re-mix next rotation.
+
+    **Hierarchy** (``cross_zone_every_k`` > 0): real swarms have locality
+    structure — same-DC TPU slices next to homes behind asymmetric WAN
+    links — and the flat grid burns slow cross-zone bandwidth every round
+    moving gradient mass an intra-zone group could have averaged locally.
+    With volunteers advertising a ``zone`` (membership ``extra_info``),
+    the schedule becomes a two-level grid in the hierarchical-HSDP shape:
+    most rotations are INTRA-zone (the hash-arc layout scoped to each
+    zone's own member set, so groups never span a zone boundary and no
+    cross-zone byte moves), and every k-th rotation is a CROSS-zone
+    mixing rotation (the ordinary zone-blind flat grid, whose hashed arcs
+    span zones). Group means still reach the global mean because the
+    Moshpit argument applies per level — O(log zone_size) intra rotations
+    converge each zone, O(log N) cross rotations mix the zone means — and
+    the level rides in the group id (``r<rot>.z<zone>.g<i>`` vs
+    ``r<rot>.x<i>``), so the epoch+generation fencing and group-local
+    failover of the flat schedule carry over unchanged. Fallback rules:
+    fewer than two distinct advertised zones (or ``cross_zone_every_k``
+    0) degrade to the flat grid — a mixed-version swarm where some peers
+    never advertise a zone schedules those peers as one "" pseudo-zone,
+    and never crashes.
     """
 
     def __init__(
@@ -91,11 +123,16 @@ class GroupSchedule:
         rotation_s: float = 15.0,
         clock: Callable[[], float] = time.time,
         min_size: int = 2,
+        cross_zone_every_k: int = 0,
     ):
         if target_size < 2:
             raise ValueError(f"target_size must be >= 2, got {target_size}")
         if rotation_s <= 0:
             raise ValueError(f"rotation_s must be > 0, got {rotation_s}")
+        if cross_zone_every_k < 0:
+            raise ValueError(
+                f"cross_zone_every_k must be >= 0 (0 = flat), got {cross_zone_every_k}"
+            )
         self.target_size = int(target_size)
         self.rotation_s = float(rotation_s)
         # The consensus wall clock when one exists (ClockSync.now): every
@@ -103,9 +140,40 @@ class GroupSchedule:
         # window or they rendezvous under different keys and miss.
         self.clock = clock
         self.min_size = int(min_size)
+        # Hierarchy cadence: every k-th rotation mixes across zones; the
+        # rest stay intra-zone. 0 = flat single-level grid (and any value
+        # degrades to flat while fewer than two zones are advertised).
+        self.cross_zone_every_k = int(cross_zone_every_k)
 
     def rotation(self) -> int:
         return int(self.clock() // self.rotation_s)
+
+    def level_of(self, rot: int, zones_by_peer: Optional[Dict[str, str]] = None) -> str:
+        """Hierarchy level rotation ``rot`` schedules at, given the zone
+        advertisements in view ("flat" when the hierarchy is off or fewer
+        than two distinct zones are advertised)."""
+        k = self.cross_zone_every_k
+        if k <= 0 or len(set((zones_by_peer or {}).values())) < 2:
+            return "flat"
+        return "cross" if rot % k == 0 else "intra"
+
+    @staticmethod
+    def zone_tag(zone: str) -> str:
+        """Deterministic, key-safe tag for a zone name. Readable when the
+        name already is; a sanitized name gets a crc suffix so two zones
+        that sanitize identically ("a b" vs "a_b") cannot collide onto one
+        keyspace (collision would only cost an accidental cross-zone
+        group, never mixed tensors — the epoch hash covers members — but
+        it would silently defeat the locality the operator asked for).
+        The unzoned "" pseudo-zone tags as "~", a character the sanitizer
+        can never emit for a real zone name — so no operator-chosen zone
+        (not even one literally named "none") can share its keyspace."""
+        if not zone:
+            return "~"
+        safe = re.sub(r"[^A-Za-z0-9_-]", "_", zone)[:16]
+        if safe == zone:
+            return safe
+        return f"{safe}-{zlib.crc32(zone.encode()) & 0xFFFF:04x}"
 
     @staticmethod
     def n_groups(n_peers: int, target_size: int, min_size: int = 2) -> int:
@@ -129,24 +197,54 @@ class GroupSchedule:
         member_ids,
         peer_id: str,
         rot: Optional[int] = None,
+        zones: Optional[Dict[str, str]] = None,
     ) -> Optional[GroupAssignment]:
         """This peer's assignment for rotation ``rot`` (current window when
         None), or None when the live swarm is too small to split — the
         caller then falls back to the single constant rendezvous key,
         which keeps small swarms byte-identical to the pre-schedule
-        behavior."""
+        behavior.
+
+        ``zones`` maps peer_id -> advertised zone (absent/None/"" = the
+        unzoned pseudo-zone). With the hierarchy on and >= 2 distinct
+        zones in view, intra rotations scope the hash-arc layout to this
+        peer's zone — an assignment with fewer than ``min_size`` members
+        (a lone peer in its zone) is returned as-is so the caller can
+        skip the round CHEAPLY (it is deterministic that nobody else will
+        rendezvous under that key) instead of burning a join timeout."""
         ids = set(member_ids)
         ids.add(peer_id)
+        rot = self.rotation() if rot is None else int(rot)
+        zmap = {pid: str((zones or {}).get(pid) or "") for pid in ids}
+        level = self.level_of(rot, zmap)
+        if level == "intra":
+            zone = zmap[peer_id]
+            zone_ids = {pid for pid, z in zmap.items() if z == zone}
+            n = len(zone_ids)
+            g = max(self.n_groups(n, self.target_size, self.min_size), 1)
+            ztag = self.zone_tag(zone)
+            for home, grp in self._arcs(zone_ids, rot, g, self.min_size):
+                if peer_id in grp:
+                    return GroupAssignment(
+                        rot=rot, group_id=f"r{rot}.z{ztag}.g{home}",
+                        n_groups=g, n_peers=n, members=tuple(sorted(grp)),
+                        level="intra", zone=zone,
+                    )
+            # Singleton zone: _arcs yields one group of one; still scoped.
+            return GroupAssignment(
+                rot=rot, group_id=f"r{rot}.z{ztag}.g0", n_groups=1,
+                n_peers=n, members=(peer_id,), level="intra", zone=zone,
+            )
         n = len(ids)
         g = self.n_groups(n, self.target_size, self.min_size)
         if g <= 1:
             return None
-        rot = self.rotation() if rot is None else int(rot)
+        gtag = "x" if level == "cross" else "g"
         for home, grp in self._arcs(ids, rot, g, self.min_size):
             if peer_id in grp:
                 return GroupAssignment(
-                    rot=rot, group_id=f"r{rot}.g{home}", n_groups=g, n_peers=n,
-                    members=tuple(sorted(grp)),
+                    rot=rot, group_id=f"r{rot}.{gtag}{home}", n_groups=g,
+                    n_peers=n, members=tuple(sorted(grp)), level=level,
                 )
         return None  # unreachable: peer_id is in ids
 
@@ -187,13 +285,34 @@ class GroupSchedule:
 
     @classmethod
     def partition(
-        cls, member_ids, rot: int, target_size: int, min_size: int = 2
+        cls,
+        member_ids,
+        rot: int,
+        target_size: int,
+        min_size: int = 2,
+        zones: Optional[Dict[str, str]] = None,
+        cross_zone_every_k: int = 0,
     ) -> List[List[str]]:
         """The full partition one view computes for rotation ``rot``
         (groups in arc order, members sorted by id). Tests, the chaos
         campaign, and the scale bench use this to know who SHOULD group
-        with whom; the swarm itself never needs the global view."""
+        with whom; the swarm itself never needs the global view. With
+        ``zones`` + ``cross_zone_every_k`` the partition is the
+        hierarchical one: per-zone arcs on intra rotations (zones in
+        sorted order), the zone-blind flat grid on cross rotations."""
         ids = sorted(set(member_ids))
+        zmap = {pid: str((zones or {}).get(pid) or "") for pid in ids}
+        k = int(cross_zone_every_k)
+        hier = k > 0 and len(set(zmap.values())) >= 2
+        if hier and rot % k != 0:
+            out: List[List[str]] = []
+            for zone in sorted(set(zmap.values())):
+                zone_ids = [pid for pid in ids if zmap[pid] == zone]
+                g = max(cls.n_groups(len(zone_ids), target_size, min_size), 1)
+                out.extend(
+                    sorted(grp) for _, grp in cls._arcs(zone_ids, rot, g, min_size)
+                )
+            return out
         g = cls.n_groups(len(ids), target_size, min_size)
         if g <= 1:
             return [ids] if ids else []
@@ -262,6 +381,7 @@ class Matchmaker:
         clock: Callable[[], float] = time.time,
         exclude: Optional[Callable[[str], bool]] = None,
         lead_exclude: Optional[Callable[[str], bool]] = None,
+        lead_weight: Optional[Callable[[str], Optional[float]]] = None,
     ):
         self.transport = transport
         self.dht = dht
@@ -275,10 +395,16 @@ class Matchmaker:
         # LEADERSHIP exclusion predicate: candidates it flags (recently
         # deposed as leader, currently suspected) are passed over when
         # deciding who self-elects, so a flaky peer is not handed the lead
-        # again the moment it reappears.
+        # again the moment it reappears. ``lead_weight`` maps a candidate
+        # to its advertised uplink bandwidth (bytes/s; None = none
+        # advertised): the leader serves the whole group's begin fan-out,
+        # contribution gather, and result fetches, so among non-excluded
+        # candidates the fattest advertised uplink self-elects — computed
+        # from the membership snapshot alone, no extra RPCs.
         self.clock = clock
         self.exclude = exclude
         self.lead_exclude = lead_exclude
+        self.lead_weight = lead_weight
         # Peers dropped from the last led round's member list (stats/tests).
         self.last_preexcluded: List[str] = []
         self._begin_futures: Dict[str, asyncio.Future] = {}
@@ -618,6 +744,16 @@ class Matchmaker:
                     last_join = time.monotonic()
                 except asyncio.TimeoutError:
                     pass
+            if len(col["members"]) + 1 < min_group:
+                # Every expected member joined but the group is still
+                # below the floor (an undersized scheduled group under a
+                # divergent view — the caller's own deterministic check
+                # normally skips these before dialing): min_group is a
+                # robustness guarantee, never lead beneath it.
+                log.info("round %s: only %d peers joined (< min_group %d), "
+                         "skipping", round_key, len(col["members"]) + 1,
+                         min_group)
+                return None
         finally:
             self._join_collectors.pop(round_key, None)
         # Freeze. From here a late join is answered "too late" (bounded
@@ -643,21 +779,40 @@ class Matchmaker:
         )
 
     def _pick_leader(self, members: List[Tuple[str, Addr]]) -> str:
-        """Who should self-elect for this candidate set: the smallest
-        peer_id the local ``lead_exclude`` predicate does NOT flag, falling
-        back to the plain smallest when every candidate is flagged (a round
-        with a suspect leader beats no round). Purely local and advisory:
-        peers with divergent suspicion may elect different leaders, which
-        yields two distinct epochs (never mixed tensors) and one
+        """Who should self-elect for this candidate set: among candidates
+        the local ``lead_exclude`` predicate does NOT flag, the one with
+        the fattest advertised uplink (``lead_weight``, bucketed to
+        octaves so heartbeat-to-heartbeat EWMA jitter between two
+        similar links cannot flap the choice), ties and no-advertisement
+        falling back to the smallest peer_id; the plain smallest when
+        every candidate is flagged (a round with a suspect leader beats
+        no round). Purely local and advisory: peers with divergent
+        suspicion or stale bandwidth views may elect different leaders,
+        which yields two distinct epochs (never mixed tensors) and one
         underfilled round — the members' begin-wins rule resolves it."""
-        if self.lead_exclude is not None:
-            for pid, _ in members:
+        best: Optional[Tuple[int, str]] = None
+        for pid, _ in members:
+            if self.lead_exclude is not None:
                 try:
                     flagged = bool(self.lead_exclude(pid))
                 except Exception:  # noqa: BLE001 — a policy bug must not kill rounds
                     flagged = False
-                if not flagged:
-                    return pid
+                if flagged:
+                    continue
+            bucket = -1
+            if self.lead_weight is not None:
+                try:
+                    bw = self.lead_weight(pid)
+                except Exception:  # noqa: BLE001 — a policy bug must not kill rounds
+                    bw = None
+                if isinstance(bw, (int, float)) and bw > 0:
+                    bucket = int(math.log2(float(bw)))
+            if best is None or bucket > best[0] or (
+                bucket == best[0] and pid < best[1]
+            ):
+                best = (bucket, pid)
+        if best is not None:
+            return best[1]
         return members[0][0]
 
     def _group_from_begin(self, begin: dict, round_key: str) -> Optional[Group]:

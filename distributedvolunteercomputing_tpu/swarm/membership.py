@@ -41,18 +41,37 @@ class SwarmMembership:
         ttl: float = 15.0,
         extra_info: Optional[dict] = None,
         failure_detector=None,
+        bandwidth_source=None,
     ):
         self.dht = dht
         self.peer_id = peer_id
         self.ttl = ttl
         self.extra_info = extra_info or {}
         self.failure_detector = failure_detector
+        # Callable returning this node's measured-bandwidth advertisement
+        # fields (Transport.bandwidth_advertisement: {"bw_up": bps,
+        # "bw_down": bps}, {} when nothing fresh) — re-evaluated on EVERY
+        # announce, so the advertisement refreshes with each heartbeat and
+        # a stale estimate ages out of the record rather than lingering.
+        # Consumers (the hierarchical group schedule's bandwidth-weighted
+        # leader election) treat absent fields as "no advertisement".
+        self.bandwidth_source = bandwidth_source
         # Last announce-timestamp seen per peer: a new heartbeat is a CHANGED
         # record ``t``, so observation cadence (who calls alive_peers, how
         # often) can't fabricate arrivals out of re-reads of the same record.
         self._seen_beats: dict = {}
         self._heartbeat_task: Optional[asyncio.Task] = None
         self._left = False
+        # Sticky addr -> zone attribution (zone_by_addr): once a peer has
+        # advertised a zone from an address, the mapping OUTLIVES its
+        # membership record. Consumers sum cumulative transport byte
+        # counters against this map (Averager.zone_traffic, rolled into
+        # the coordinator's cross_zone_bytes_per_commit as windowed
+        # deltas), so the attribution must be as monotone as the counters:
+        # a peer missing one heartbeat must not subtract its lifetime
+        # bytes from the sum and re-add them as a phantom burst when the
+        # record reappears. Bounded (addresses are one-per-process).
+        self._zone_cache: Dict[tuple, str] = {}
         # Last live read of the peers key, for alive_peers(max_age=...):
         # consumers on a round's critical path (the group schedule's
         # per-round split) accept a view one heartbeat old instead of
@@ -64,11 +83,17 @@ class SwarmMembership:
         self.keep_snapshot_fresh = False
 
     def _record(self) -> dict:
-        return {
+        rec = {
             "addr": list(self.dht.transport.addr),
             "t": time.time(),
             **self.extra_info,
         }
+        if self.bandwidth_source is not None:
+            try:
+                rec.update(self.bandwidth_source() or {})
+            except Exception as e:  # noqa: BLE001 — advertisement is advisory
+                log.debug("bandwidth advertisement failed: %s", errstr(e))
+        return rec
 
     async def join(self) -> None:
         """Announce and start heartbeating."""
@@ -190,6 +215,51 @@ class SwarmMembership:
         if not include_self:
             out.pop(self.peer_id, None)
         return out
+
+    def peer_record(self, peer_id: str) -> Optional[dict]:
+        """The cached membership record for ``peer_id`` (our own record
+        included), from the last live read — NO DHT walk, so it is safe on
+        a round's critical path. None before the first read or for an
+        unknown peer. The hierarchical schedule and the bandwidth-weighted
+        leader election read zones/bandwidth advertisements through this:
+        every member consults the same soft state, so their choices agree
+        up to one heartbeat of staleness (divergence costs an underfilled
+        round via begin-wins, never mixed tensors)."""
+        if peer_id == self.peer_id and (
+            self._snapshot is None or peer_id not in self._snapshot
+        ):
+            return self._record()
+        if self._snapshot is None:
+            return None
+        return self._snapshot.get(peer_id)
+
+    MAX_ZONE_CACHE = 4096
+
+    def zone_by_addr(self) -> Dict[tuple, str]:
+        """Advertised zone per peer ADDRESS — the join key for charging
+        the transport's per-peer byte counters to zones (the transport
+        knows addresses, membership knows zones; this is where both are
+        known). STICKY: entries learned from any snapshot persist after
+        the record expires, so byte sums over cumulative counters stay
+        monotone through heartbeat churn (a one-beat record gap must not
+        read as the peer's lifetime traffic vanishing and reappearing)."""
+        cache = self._zone_cache
+        for rec in (self._snapshot or {}).values():
+            addr = rec.get("addr")
+            if isinstance(addr, (list, tuple)) and len(addr) == 2:
+                key = (str(addr[0]), int(addr[1]))
+                zone = str(rec.get("zone") or "")
+                if not zone and cache.get(key):
+                    # Never downgrade a zoned attribution to "": a
+                    # restarted (or zone-stripped) peer on a known address
+                    # would flip that address's historical bytes from
+                    # cross to intra (or back) and dip the cumulative sum.
+                    # A real zone CHANGE (zone -> other zone) still lands.
+                    continue
+                if key not in cache and len(cache) >= self.MAX_ZONE_CACHE:
+                    cache.clear()  # churn far beyond any real swarm; reset
+                cache[key] = zone
+        return dict(cache)
 
     def invalidate_snapshot(self) -> None:
         """Force the next ``alive_peers(max_age=...)`` to walk the DHT.

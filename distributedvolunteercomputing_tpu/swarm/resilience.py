@@ -116,6 +116,15 @@ class ResiliencePolicy:
         # not), while these gauges expose per-group commit health so an
         # operator can see which group is slow instead of one flat number.
         self.group_rounds: Dict[str, dict] = {}
+        # Per-hierarchy-level round records (flat | intra | cross), the
+        # level-scoped twin of group_rounds: the hierarchical schedule
+        # runs intra-zone rounds on fast links every rotation and
+        # cross-zone rounds on slow links every k-th, so their durations,
+        # degradation rates, and learned-deadline pressure differ BY
+        # DESIGN — folding both into one gauge would hide exactly the
+        # asymmetry the hierarchy exists to exploit. (Learning stays
+        # per-peer and global: a deadline per level is a follow-on.)
+        self.level_rounds: Dict[str, dict] = {}
         # One slow round must count ONCE: a peer whose push lands after the
         # commit is seen twice (absent in the commit batch, late on the RPC
         # path), in either order. These two sets reconcile the duplicate —
@@ -199,6 +208,20 @@ class ResiliencePolicy:
         rec["last_dt_s"] = round(duration_s, 3)
         rec["deadline_s"] = round(self._deadline, 3)
 
+    def _note_level(
+        self, level: Optional[str], *, ok: bool, degraded: bool,
+        duration_s: float,
+    ) -> None:
+        if not level:
+            return
+        rec = self.level_rounds.setdefault(
+            level, {"rounds": 0, "ok": 0, "degraded": 0, "last_dt_s": None},
+        )
+        rec["rounds"] += 1
+        rec["ok"] += int(ok)
+        rec["degraded"] += int(degraded)
+        rec["last_dt_s"] = round(duration_s, 3)
+
     def record_round(
         self,
         *,
@@ -210,6 +233,7 @@ class ResiliencePolicy:
         absent: Iterable[str] = (),
         rejected: Iterable[str] = (),
         group_id: Optional[str] = None,
+        level: Optional[str] = None,
     ) -> None:
         """One finished round, from whichever vantage this node had (a
         leader knows per-peer arrivals; a member may only know ok/duration).
@@ -225,6 +249,7 @@ class ResiliencePolicy:
             group_id, ok=ok, degraded=degraded,
             duration_s=duration_s, absent_n=len(absent),
         )
+        self._note_level(level, ok=ok, degraded=degraded, duration_s=duration_s)
         self._decay_all()
         for p in on_time:
             st = self._peer(p)
@@ -358,4 +383,6 @@ class ResiliencePolicy:
         }
         if self.group_rounds:
             out["groups"] = {g: dict(r) for g, r in self.group_rounds.items()}
+        if self.level_rounds:
+            out["levels"] = {lv: dict(r) for lv, r in self.level_rounds.items()}
         return out

@@ -78,6 +78,7 @@ import asyncio
 import hashlib
 import hmac
 import json
+import statistics
 import struct
 import time
 import uuid
@@ -114,6 +115,16 @@ MAX_META = 4 << 20  # 4 MiB: meta is a small JSON dict, never tensor data
 # frames); bigger payloads stream as chunk frames.
 CHUNK_BYTES = 1 << 20
 MAX_CHUNKS = 1 << 20  # framing sanity bound, far above MAX_PAYLOAD/CHUNK_BYTES
+# Smallest payload that contributes a bandwidth sample to the per-peer
+# up/down throughput EWMAs: below this, per-RPC overhead (syscalls, loop
+# scheduling) dominates the measurement and the estimate would read as a
+# slow link. 256 KiB ~ a handful of wire chunks.
+MIN_BW_SAMPLE_BYTES = 256 << 10
+# A bandwidth estimate older than this no longer appears in
+# bandwidth_advertisement(): links change (congestion, migration), and an
+# aged-out advertisement degrades consumers to the unweighted default
+# instead of electing yesterday's fat uplink.
+BW_ADVERT_MAX_AGE_S = 120.0
 DEFAULT_CONNECT_TIMEOUT = 5.0
 # Concurrent in-flight requests served per inbound connection; past this the
 # read loop stops pulling frames (TCP backpressure) until a handler finishes.
@@ -184,7 +195,7 @@ class _PeerStats:
 
     __slots__ = (
         "bytes_sent", "bytes_received", "rpcs", "connects", "lat_ewma",
-        "last_used",
+        "last_used", "bw_up_ewma", "bw_down_ewma", "bw_up_t", "bw_down_t",
     )
 
     def __init__(self):
@@ -194,12 +205,45 @@ class _PeerStats:
         self.connects = 0
         self.lat_ewma: Optional[float] = None
         self.last_used = time.monotonic()
+        # Observed payload throughput to/from this peer (bytes/s), sampled
+        # only on bulk transfers (>= MIN_BW_SAMPLE_BYTES) so control-plane
+        # RPC timing never pollutes the estimate. Both directions are
+        # measured at a RECEIVER (reads wait for bytes to actually arrive;
+        # a sender's drain() only measures the kernel socket buffer):
+        # ``bw_down`` from our own reads of this peer's responses,
+        # ``bw_up`` from the peer's echoed arrival rate of our request
+        # payloads (the ``rx_bps`` response field). Floors of the real
+        # link rate — the safe direction for the consumers: bandwidth-
+        # weighted leader election (matchmaking) and the membership
+        # advertisement (bandwidth_advertisement). Each direction carries
+        # its OWN sample timestamp so a stale estimate ages out of the
+        # advertisement independently — a node still fetching bulk results
+        # (fresh bw_down) but no longer pushing bulk payloads must not
+        # keep advertising yesterday's uplink.
+        self.bw_up_ewma: Optional[float] = None
+        self.bw_down_ewma: Optional[float] = None
+        self.bw_up_t = 0.0
+        self.bw_down_t = 0.0
 
     def observe_latency(self, dt: float) -> None:
         if self.lat_ewma is None:
             self.lat_ewma = dt
         else:
             self.lat_ewma += 0.2 * (dt - self.lat_ewma)
+
+    def observe_bw_up(self, bps: float) -> None:
+        self.bw_up_ewma = (
+            bps if self.bw_up_ewma is None
+            else self.bw_up_ewma + 0.3 * (bps - self.bw_up_ewma)
+        )
+        self.bw_up_t = time.monotonic()
+
+    def observe_bw_down(self, bps: float) -> None:
+        self.bw_down_ewma = (
+            bps if self.bw_down_ewma is None
+            else self.bw_down_ewma + 0.3 * (bps - self.bw_down_ewma)
+        )
+        self.bw_down_t = time.monotonic()
 
     def as_dict(self) -> dict:
         return {
@@ -209,6 +253,12 @@ class _PeerStats:
             "connects": self.connects,
             "latency_ewma_ms": (
                 round(self.lat_ewma * 1e3, 3) if self.lat_ewma is not None else None
+            ),
+            "bw_up_bps": (
+                round(self.bw_up_ewma) if self.bw_up_ewma is not None else None
+            ),
+            "bw_down_bps": (
+                round(self.bw_down_ewma) if self.bw_down_ewma is not None else None
             ),
         }
 
@@ -472,6 +522,41 @@ class Transport:
             return None
         return st.lat_ewma if st is not None else None
 
+    def bandwidth_advertisement(
+        self, max_age_s: float = BW_ADVERT_MAX_AGE_S
+    ) -> dict:
+        """This node's measured up/down bandwidth, as the membership
+        advertisement fields (``bw_up``/``bw_down``, bytes/s). ``bw_down``
+        is the MAX of the fresh per-peer EWMAs — measured locally (our
+        own reads), so every sample is a trustworthy floor and the best
+        observed peer is the tightest floor on our link. ``bw_up``
+        samples are peer-REPORTED (the rx_bps response echo), so one
+        lying peer must not control the advertisement: with >= 3 fresh
+        reporters the MEDIAN is taken (a minority of byzantine peers
+        can't push it past honest reports), max otherwise (too few
+        reporters to out-vote — the residual trust a 2-peer swarm always
+        has). Each direction ages out independently; with nothing fresh
+        within ``max_age_s`` the field is simply omitted and consumers
+        degrade to unweighted behavior — a stale advertisement ages out
+        rather than lingering."""
+        cutoff = time.monotonic() - max_age_s
+        up = [
+            st.bw_up_ewma for st in self._peer_stats.values()
+            if st.bw_up_ewma is not None and st.bw_up_t >= cutoff
+        ]
+        down = [
+            st.bw_down_ewma for st in self._peer_stats.values()
+            if st.bw_down_ewma is not None and st.bw_down_t >= cutoff
+        ]
+        out: dict = {}
+        if up:
+            out["bw_up"] = round(
+                statistics.median(up) if len(up) >= 3 else max(up)
+            )
+        if down:
+            out["bw_down"] = round(max(down))
+        return out
+
     def stats(self) -> dict:
         """Transport-level counters: totals plus per-dialed-peer detail."""
         return {
@@ -709,20 +794,39 @@ class Transport:
             raise RPCError(f"malformed frame meta (not an object: {type(meta).__name__})")
         rid = meta.get("rid", "")
         rid = rid if isinstance(rid, str) else ""
+        # Local measurement stash only (set below, echoed by the server
+        # half): a remote peer must not be able to pre-seed it.
+        meta.pop("_rx_bps", None)
         n_chunks = meta.get("chunks")
         if n_chunks is None:
             # Inline message: the v1 wire, byte-identical.
+            t_payload = time.monotonic()
             payload = await reader.readexactly(payload_len) if payload_len else b""
             received += payload_len
             self.bytes_received += received
+            dt = time.monotonic() - t_payload
             if peer is not None:
-                self._peer(peer).bytes_received += received
+                st = self._peer(peer)
+                st.bytes_received += received
+                if payload_len >= MIN_BW_SAMPLE_BYTES and dt > 0:
+                    st.observe_bw_down(payload_len / dt)
             if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
                 # The declared lengths were honored, so the stream is still
                 # in sync: reject THIS message, keep the connection.
                 raise _PayloadError(rid, "payload CRC mismatch (corrupt frame)")
             if self._secret is not None:
                 self._verify_auth(ftype, meta, payload)
+            if payload_len >= MIN_BW_SAMPLE_BYTES and dt > 0:
+                # Read-side throughput is genuine: readexactly waits for
+                # bytes to actually ARRIVE (stream buffer caps at 64 KiB),
+                # so the rate is bounded by the sender's uplink + path.
+                # Stashed in the meta so the server half can echo it back
+                # to the sender as its measured uplink (see
+                # _handle_request); the sender CANNOT measure this itself —
+                # its drain() returns once the kernel socket buffer accepts
+                # the bytes, a ceiling on the link rate, not a floor. Set
+                # AFTER auth: the MAC covers the meta as the sender sent it.
+                meta["_rx_bps"] = payload_len / dt
             return ftype, meta, payload
         # Chunked message.
         if (
@@ -775,6 +879,7 @@ class Transport:
         buf: Optional[bytearray] = None if sink is not None else bytearray(payload_len)
         got = 0
         bad: Optional[str] = None
+        t_chunks = time.monotonic()
         try:
             for i in range(n_chunks):
                 ch = await reader.readexactly(_CHUNK.size)
@@ -832,8 +937,17 @@ class Transport:
             _close_sink(False)
             raise
         self.bytes_received += received
+        chunk_dt = time.monotonic() - t_chunks
+        if bad is None and payload_len >= MIN_BW_SAMPLE_BYTES and chunk_dt > 0:
+            # First chunk to last: a throughput floor (the sender's encode
+            # pacing only makes the true link faster). Same echo contract
+            # as the inline path above.
+            meta["_rx_bps"] = payload_len / chunk_dt
         if peer is not None:
-            self._peer(peer).bytes_received += received
+            st = self._peer(peer)
+            st.bytes_received += received
+            if bad is None and payload_len >= MIN_BW_SAMPLE_BYTES and chunk_dt > 0:
+                st.observe_bw_down(payload_len / chunk_dt)
         if bad is not None:
             _close_sink(False)
             raise _PayloadError(rid, bad)
@@ -969,6 +1083,21 @@ class Transport:
                 try:
                     resp_meta, out_payload = await handler(meta.get("args", {}), payload)
                     out_type, out_meta = TYPE_RESP, {"rid": rid, "ret": resp_meta}
+                    rx_bps = meta.get("_rx_bps")
+                    if rx_bps:
+                        # Echo the measured arrival rate of the request's
+                        # bulk payload back to its sender — the only place
+                        # the sender's UPLINK is genuinely observable (its
+                        # own drain() only measures the kernel buffer).
+                        # MAC-covered under auth like the rest of the
+                        # response meta. Trust note: a LYING responder
+                        # inflates the honest REQUESTER's uplink estimate
+                        # (possibly electing a thin-linked leader), which
+                        # is why bandwidth_advertisement aggregates these
+                        # by MEDIAN across reporters — a minority of
+                        # byzantine peers can't move the advertisement —
+                        # and why samples age out in BW_ADVERT_MAX_AGE_S.
+                        out_meta["rx_bps"] = round(rx_bps)
                 except Exception as e:  # handler errors go back on the wire
                     log.debug("handler %s raised: %s", method, errstr(e))
                     out_type = TYPE_ERR
@@ -1110,6 +1239,16 @@ class Transport:
         st.rpcs += 1
         if record_latency:
             st.observe_latency(time.monotonic() - t0)
+        rx_bps = meta.get("rx_bps") if isinstance(meta, dict) else None
+        if (
+            isinstance(rx_bps, (int, float))
+            and not isinstance(rx_bps, bool)
+            and 0 < rx_bps < 1e12
+        ):
+            # The receiver's measured arrival rate of our bulk request
+            # payload (see _handle_request): the honest uplink sample —
+            # our own drain() timing only measures the kernel buffer.
+            st.observe_bw_up(float(rx_bps))
         self.rpcs_sent += 1
         conn.reused = True
         if ftype == TYPE_ERR:

@@ -103,6 +103,18 @@ class VolunteerConfig:
     # land in the same rotation window to rendezvous, so wall-cadence
     # swarms (clock-synced) are the natural fit.
     group_rotation_s: float = 0.0
+    # Locality zone this volunteer advertises in its membership record
+    # (e.g. "dc-eu1", "home-us"): volunteers in the same zone share fast
+    # links. "" = unzoned. Advertised regardless of scheduling mode; the
+    # hierarchical schedule below consumes it.
+    zone: str = ""
+    # Hierarchical two-level scheduling cadence: with a group schedule and
+    # >= 2 advertised zones live, every k-th rotation runs the zone-blind
+    # CROSS-zone mixing grid and the rest stay INTRA-zone (groups never
+    # span a zone boundary, so those rounds move zero cross-zone bytes).
+    # 0 = flat single-level grid. Degrades to flat automatically while
+    # fewer than two zones are advertised (mixed-version swarms).
+    cross_zone_every_k: int = 0
     batch_size: int = 32  # samples per optimizer step (across accum microbatches)
     # Scan up to N steps inside one compiled call between cadence points
     # (host-loop amortization; params mode, no mesh). 1 = off.
@@ -214,6 +226,20 @@ class VolunteerConfig:
         if self.group_rotation_s < 0:
             raise ValueError(
                 f"group_rotation_s must be >= 0, got {self.group_rotation_s}"
+            )
+        if self.cross_zone_every_k < 0:
+            raise ValueError(
+                f"cross_zone_every_k must be >= 0 (0 = flat), got "
+                f"{self.cross_zone_every_k}"
+            )
+        if self.cross_zone_every_k and not self.group_size:
+            # Fail at config time (the method/wire validation policy): the
+            # hierarchy is a property of the group schedule — without one
+            # the flag would silently do nothing for the whole run.
+            raise ValueError(
+                "--cross-zone-every-k requires --group-size (the hierarchy "
+                "schedules the multi-group grid; single-group swarms have "
+                "no grid to layer)"
             )
         if self.group_size:
             # Fail at config time (the method/wire validation policy): the
@@ -481,18 +507,29 @@ class Volunteer:
                 initial_deadline_s=self.cfg.round_deadline_s or None,
                 failure_detector=self.failure_detector,
             )
+        extra_info = {
+            "model": self.cfg.model,
+            # Full averaging namespace (model/average_what): gossip picks
+            # partners from membership records (no rendezvous key), so the
+            # record must carry the same string the averagers namespace
+            # their rounds by — a params-mode peer must never gossip with
+            # a grads-mode peer on the same model.
+            "avg_ns": f"{self.cfg.model}/{self.cfg.average_what}",
+        }
+        if self.cfg.zone:
+            # Locality advertisement for the hierarchical schedule; absent
+            # on unzoned volunteers so mixed-version swarms degrade to
+            # flat scheduling instead of treating "" as a real zone name.
+            extra_info["zone"] = self.cfg.zone
         self.membership = SwarmMembership(
             self.dht, self.cfg.peer_id, ttl=self.cfg.heartbeat_ttl,
             failure_detector=self.failure_detector,
-            extra_info={
-                "model": self.cfg.model,
-                # Full averaging namespace (model/average_what): gossip picks
-                # partners from membership records (no rendezvous key), so the
-                # record must carry the same string the averagers namespace
-                # their rounds by — a params-mode peer must never gossip with
-                # a grads-mode peer on the same model.
-                "avg_ns": f"{self.cfg.model}/{self.cfg.average_what}",
-            },
+            extra_info=extra_info,
+            # Measured up/down bandwidth rides every heartbeat (refreshed
+            # from the transport's bulk-transfer throughput EWMAs; stale
+            # estimates age out to absent fields): the input to
+            # bandwidth-weighted leader election.
+            bandwidth_source=self.transport.bandwidth_advertisement,
         )
         await self.membership.join()
         if self.cfg.average_interval_s > 0:
@@ -546,6 +583,7 @@ class Volunteer:
                     if self.clocksync is not None
                     else time.time,
                     min_size=self.cfg.min_group,
+                    cross_zone_every_k=self.cfg.cross_zone_every_k,
                 )
             if self.cfg.averaging == "byzantine" and (
                 self.cfg.method != "mean" or self.cfg.wire == "topk"

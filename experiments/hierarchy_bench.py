@@ -1,0 +1,399 @@
+#!/usr/bin/env python
+"""Hierarchy bench: flat vs hierarchical scheduling on a two-zone WAN.
+
+The hierarchical schedule's claim (ROADMAP item 1 / ISSUE 8): on a swarm
+with locality structure — zones of volunteers on fast intra-zone links,
+thin+far cross-zone links — intra-zone groups every rotation plus
+cross-zone mixing every k-th rotation reach the SAME global mixing error
+as the flat zone-blind grid while moving a fraction of the cross-zone
+bytes, because only 1/k of rotations put gradient mass on the WAN.
+
+Arms (both run until mixing error <= the target, so the byte comparison
+is at EQUAL mixing error):
+
+  flat — the PR-7 single-level grid (zones advertised but ignored):
+         every rotation's hashed arcs span zones, so every committed
+         round moves cross-zone bytes.
+  hier — the two-level grid (--cross-zone-every-k): intra rotations
+         never cross a zone boundary (zero cross-zone payload bytes);
+         every k-th rotation runs the flat grid to mix zone means.
+
+Cross-zone bytes are measured from the transport's per-peer counters
+joined against the membership zone map (Averager.zone_traffic), i.e. the
+same live accounting coord.status rolls up — not a model.
+
+A second experiment measures BANDWIDTH-WEIGHTED LEADER ELECTION: a
+4-volunteer group where one peer has a fat uplink (per-pair ChaosTransport
+links) runs rounds with and without bandwidth advertisements; the
+advertised arm must elect the fat peer and cut median round wall time
+(every member's bulk push rides the fat edge instead of a thin one).
+
+The two-zone WAN itself is simulated with ChaosTransport.set_link
+(per-peer-pair latency + serialization bandwidth), composing with the
+existing fault machinery.
+
+Artifact: experiments/results/hierarchy_bench.json (committed).
+
+Usage:
+    python experiments/hierarchy_bench.py            # full campaign
+    python experiments/hierarchy_bench.py --quick    # smaller N, looser target
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.chaos import ChaosTransport  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.matchmaking import GroupSchedule  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership  # noqa: E402
+
+GROUP_TARGET = 3
+TREE_ELEMS = 32_768          # 128 KiB f32 per contribution
+TARGET_ERR = 5e-3            # relative global-mean deviation both arms must reach
+CROSS_EVERY_K = 3
+# Two-zone WAN model (bytes/s; latencies s). Cross-zone: a thin, far link
+# (~64 Mbit/s, 30 ms). Intra-zone: fast and near (left unmodeled =
+# localhost). The asymmetry is what the hierarchy exploits.
+INTER_ZONE_LAT_S = 0.03
+INTER_ZONE_BW_BPS = 8e6
+
+
+async def build_node(pid, zone, *, boot, schedule, extra=None,
+                     gather_timeout=10.0, join_timeout=6.0):
+    t = ChaosTransport()
+    dht = DHTNode(t, maintenance_interval=120.0)
+    await dht.start(bootstrap=[boot] if boot else None)
+    mem = SwarmMembership(
+        dht, pid, ttl=30.0, extra_info={"zone": zone, **(extra or {})}
+    )
+    await mem.join()
+    avg = SyncAverager(
+        t, dht, mem,
+        min_group=2, max_group=3 * GROUP_TARGET,
+        join_timeout=join_timeout, gather_timeout=gather_timeout,
+        group_schedule=schedule,
+    )
+    return {"pid": pid, "zone": zone, "t": t, "dht": dht, "mem": mem,
+            "avg": avg}
+
+
+async def teardown(nodes):
+    for nd in nodes:
+        try:
+            await nd["mem"].leave()
+        except Exception:
+            pass
+        try:
+            await nd["dht"].stop()
+        except Exception:
+            pass
+        try:
+            await nd["t"].close()
+        except Exception:
+            pass
+    ChaosTransport._partitions.clear()
+    ChaosTransport._links.clear()
+
+
+def _link_cross_zone(nodes, lat, bw):
+    """Model every cross-zone edge as a thin, far link (both directions:
+    set_link is pairwise and each endpoint applies its outbound half)."""
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1:]:
+            if a["zone"] != b["zone"]:
+                a["t"].set_link(a["t"].addr, b["t"].addr, lat, bw)
+
+
+def _xz_sent(nodes):
+    """Total cross-zone bytes on the wire (each byte counted once, at its
+    sender), via the same zone_traffic accounting coord.status rolls up."""
+    return sum(
+        nd["avg"].zone_traffic()["cross_zone_bytes_sent"] for nd in nodes
+    )
+
+
+async def run_config(
+    n: int,
+    arm: str,
+    *,
+    group_target: int = GROUP_TARGET,
+    tree_elems: int = TREE_ELEMS,
+    target_err: float = TARGET_ERR,
+    max_rounds: int = 15,
+    cross_every_k: int = CROSS_EVERY_K,
+    links: bool = True,
+    inter_lat: float = INTER_ZONE_LAT_S,
+    inter_bw: float = INTER_ZONE_BW_BPS,
+) -> dict:
+    """One (N, arm) cell, in-process: N volunteers split over two zones,
+    rotations pinned per round, values adopted from committed results so
+    the mixing error is the REAL protocol's, not a simulation's. Runs
+    until the error hits ``target_err`` (or max_rounds) and reports
+    cross-zone bytes per committed round."""
+    assert arm in ("flat", "hier")
+    rot_cell = {"rot": 0}
+    k = cross_every_k if arm == "hier" else 0
+    nodes = []
+    boot = None
+    try:
+        for i in range(n):
+            zone = "dc" if i < n // 2 else "home"
+            sched = GroupSchedule(
+                target_size=group_target, rotation_s=1000.0, min_size=2,
+                cross_zone_every_k=k,
+                clock=lambda: rot_cell["rot"] * 1000.0 + 0.5,
+            )
+            nd = await build_node(
+                f"b{i:03d}", zone, boot=boot, schedule=sched,
+            )
+            if boot is None:
+                boot = nd["t"].addr
+            nodes.append(nd)
+        if links:
+            _link_cross_zone(nodes, inter_lat, inter_bw)
+        for nd in nodes:
+            await nd["mem"].alive_peers()  # prime snapshots + zone maps
+        vals = {i: float(i) for i in range(n)}
+        gmean = statistics.mean(vals.values())
+        spread = max(vals.values()) - min(vals.values())
+        xz0 = _xz_sent(nodes)
+        dts, committed = [], 0
+        err_hist = []
+        t_start = time.monotonic()
+
+        async def one(i, nd, r):
+            t0 = time.monotonic()
+            try:
+                res = await asyncio.wait_for(
+                    nd["avg"].average(
+                        {"w": np.full((tree_elems,), vals[i], np.float32)},
+                        round_no=r,
+                    ),
+                    timeout=40.0,
+                )
+            except Exception:
+                res = None
+            return time.monotonic() - t0, res
+
+        rounds_used = 0
+        for r in range(1, max_rounds + 1):
+            rot_cell["rot"] = r
+            rounds_used = r
+            results = await asyncio.gather(
+                *(one(i, nd, r) for i, nd in enumerate(nodes))
+            )
+            for i, (dt, res) in enumerate(results):
+                dts.append(dt)
+                if res is not None:
+                    committed += 1
+                    vals[i] = float(res["w"][0])
+            err = max(abs(v - gmean) for v in vals.values()) / spread
+            err_hist.append(round(err, 6))
+            if err <= target_err:
+                break
+        wall = time.monotonic() - t_start
+        xz_bytes = _xz_sent(nodes) - xz0
+        levels = {}
+        for nd in nodes:
+            for lv, rec in nd["avg"].group_stats().get("levels", {}).items():
+                agg = levels.setdefault(lv, {"rounds_ok": 0, "rounds_skipped": 0})
+                agg["rounds_ok"] += rec.get("rounds_ok", 0)
+                agg["rounds_skipped"] += rec.get("rounds_skipped", 0)
+    finally:
+        await teardown(nodes)
+    dts.sort()
+    return {
+        "n": n, "arm": arm, "group_target": group_target,
+        "tree_elems": tree_elems, "tree_bytes": tree_elems * 4,
+        "cross_zone_every_k": k, "links_modeled": links,
+        "target_err": target_err, "rounds_used": rounds_used,
+        "mix_err_hist": err_hist, "mix_err_final": err_hist[-1],
+        "node_rounds": rounds_used * n,
+        "committed_node_rounds": committed,
+        "commit_frac": round(committed / max(rounds_used * n, 1), 4),
+        "round_s_median": round(statistics.median(dts), 4) if dts else None,
+        "round_s_p90": round(dts[max(0, int(0.9 * len(dts)) - 1)], 4) if dts else None,
+        "campaign_wall_s": round(wall, 2),
+        "cross_zone_bytes": xz_bytes,
+        "xz_bytes_per_commit": round(xz_bytes / max(committed, 1), 1),
+        "levels": levels,
+    }
+
+
+# -- bandwidth-weighted leader election experiment ---------------------------
+
+THIN_BW_BPS = 1e6      # home uplink (~8 Mbit/s): 1 MiB push ~ 1.05 s
+FAT_BW_BPS = 1e8       # DC uplink: same push ~ 10 ms
+LEADER_TREE_ELEMS = 262_144  # 1 MiB f32
+
+
+async def run_leader_config(weighted: bool, rounds: int = 6) -> dict:
+    """4 volunteers, one group, one FAT peer (every edge touching it is
+    fast; thin-thin edges are slow). ``weighted`` advertises bw_up so the
+    fat peer self-elects; unweighted falls back to smallest-id (a thin
+    peer). Median round wall time is the comparison. The schedule is
+    attached but never splits (target > N), so rounds run the classic
+    single-group rendezvous while the per-group gauges record who led."""
+    nodes = []
+    boot = None
+    try:
+        for i in range(4):
+            fat = i == 3  # ids sort v0 < v1 < v2 < v3: unweighted elects v0
+            extra = {}
+            if weighted:
+                extra["bw_up"] = FAT_BW_BPS if fat else THIN_BW_BPS
+            nd = await build_node(
+                f"v{i}", "z", boot=boot,
+                schedule=GroupSchedule(target_size=8, rotation_s=1000.0),
+                extra=extra,
+            )
+            if boot is None:
+                boot = nd["t"].addr
+            nodes.append(nd)
+        for i, a in enumerate(nodes):
+            for j, b in enumerate(nodes[i + 1:], start=i + 1):
+                bw = THIN_BW_BPS if (i != 3 and j != 3) else FAT_BW_BPS
+                a["t"].set_link(a["t"].addr, b["t"].addr, 0.005, bw)
+        for nd in nodes:
+            await nd["mem"].alive_peers()  # snapshots carry the adverts
+        dts = []
+        for r in range(1, rounds + 1):
+            t0 = time.monotonic()
+            results = await asyncio.gather(
+                *(
+                    nd["avg"].average(
+                        {"w": np.full((LEADER_TREE_ELEMS,), float(i), np.float32)},
+                        round_no=r,
+                    )
+                    for i, nd in enumerate(nodes)
+                ),
+                return_exceptions=True,
+            )
+            dts.append(time.monotonic() - t0)
+            ok = sum(1 for res in results if not isinstance(res, Exception)
+                     and res is not None)
+            if ok < 2:
+                raise RuntimeError(f"leader arm round {r}: only {ok} commits")
+        leaders = sorted(
+            nd["pid"] for nd in nodes
+            if nd["avg"].group_stats().get("rounds_led", 0) > 0
+        )
+    finally:
+        await teardown(nodes)
+    dts.sort()
+    return {
+        "weighted": weighted,
+        "rounds": rounds,
+        "tree_bytes": LEADER_TREE_ELEMS * 4,
+        "leaders_observed": leaders,
+        "round_s_median": round(statistics.median(dts), 4),
+        "round_s_mean": round(statistics.mean(dts), 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=12)
+    ap.add_argument("--group-target", type=int, default=GROUP_TARGET)
+    ap.add_argument("--tree-elems", type=int, default=TREE_ELEMS)
+    ap.add_argument("--target-err", type=float, default=TARGET_ERR)
+    ap.add_argument("--max-rounds", type=int, default=18)
+    ap.add_argument("--cross-every-k", type=int, default=CROSS_EVERY_K)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "experiments", "results", "hierarchy_bench.json"))
+    args = ap.parse_args()
+    if args.quick:
+        args.n, args.tree_elems, args.target_err = 8, 16_384, 5e-2
+
+    cells = {}
+    for arm in ("flat", "hier"):
+        print(f"[cell] n={args.n} arm={arm}", flush=True)
+        cells[arm] = asyncio.run(run_config(
+            args.n, arm, group_target=args.group_target,
+            tree_elems=args.tree_elems, target_err=args.target_err,
+            max_rounds=args.max_rounds, cross_every_k=args.cross_every_k,
+        ))
+        c = cells[arm]
+        print(f"[cell] -> rounds {c['rounds_used']}, err {c['mix_err_final']}, "
+              f"xz B/commit {c['xz_bytes_per_commit']}, "
+              f"round median {c['round_s_median']}s", flush=True)
+
+    print("[leader] weighted vs unweighted", flush=True)
+    leader = {
+        "unweighted": asyncio.run(run_leader_config(False)),
+        "weighted": asyncio.run(run_leader_config(True)),
+    }
+    for k, v in leader.items():
+        print(f"[leader] {k}: median {v['round_s_median']}s "
+              f"leaders {v['leaders_observed']}", flush=True)
+
+    flat, hier = cells["flat"], cells["hier"]
+    bytes_ratio = flat["xz_bytes_per_commit"] / max(
+        hier["xz_bytes_per_commit"], 1.0
+    )
+    wall_ratio = (
+        leader["weighted"]["round_s_median"]
+        / max(leader["unweighted"]["round_s_median"], 1e-9)
+    )
+    verdict = {
+        # Acceptance: >= 2x fewer cross-zone bytes per committed round at
+        # equal mixing error (both arms ran to the same target).
+        "xz_bytes_per_commit_flat": flat["xz_bytes_per_commit"],
+        "xz_bytes_per_commit_hier": hier["xz_bytes_per_commit"],
+        "xz_bytes_ratio_flat_over_hier": round(bytes_ratio, 2),
+        "pass_bytes_2x": bytes_ratio >= 2.0,
+        "pass_equal_error": (
+            flat["mix_err_final"] <= args.target_err
+            and hier["mix_err_final"] <= args.target_err
+        ),
+        # Bandwidth-weighted leaders: fat peer elected, round wall down.
+        "leader_weighted_round_s_median": leader["weighted"]["round_s_median"],
+        "leader_unweighted_round_s_median": leader["unweighted"]["round_s_median"],
+        "leader_wall_ratio_weighted_over_unweighted": round(wall_ratio, 3),
+        "pass_leader_elects_fat_peer": (
+            leader["weighted"]["leaders_observed"] == ["v3"]
+        ),
+        "pass_leader_wall_reduced": wall_ratio <= 0.85,
+    }
+    verdict["pass"] = bool(
+        verdict["pass_bytes_2x"]
+        and verdict["pass_equal_error"]
+        and verdict["pass_leader_elects_fat_peer"]
+        and verdict["pass_leader_wall_reduced"]
+    )
+    result = {
+        "inter_zone_lat_s": INTER_ZONE_LAT_S,
+        "inter_zone_bw_bps": INTER_ZONE_BW_BPS,
+        "thin_bw_bps": THIN_BW_BPS,
+        "fat_bw_bps": FAT_BW_BPS,
+        "host_cores": os.cpu_count(),
+        "cells": cells,
+        "leader": leader,
+        "verdict": verdict,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"[done] artifact -> {args.out}")
+    print(json.dumps(verdict, indent=2))
+    sys.exit(0 if verdict["pass"] else 1)
+
+
+if __name__ == "__main__":
+    main()

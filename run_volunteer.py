@@ -119,6 +119,23 @@ def main() -> None:
                     help="rotation cadence of the group schedule, seconds "
                          "(0 = auto: the wall-clock averaging interval "
                          "when set, else 15s)")
+    ap.add_argument("--zone", default="",
+                    help="locality zone this volunteer advertises (e.g. "
+                         "dc-eu1, home-us): volunteers in one zone share "
+                         "fast links; the hierarchical schedule groups "
+                         "intra-zone every rotation and only crosses zones "
+                         "every --cross-zone-every-k rotations. Empty = "
+                         "unzoned (flat scheduling)")
+    ap.add_argument("--cross-zone-every-k", type=int, default=0,
+                    help="hierarchical scheduling cadence: with "
+                         "--group-size and >= 2 advertised zones live, "
+                         "every k-th rotation runs the zone-blind CROSS-"
+                         "zone mixing grid and the rest stay INTRA-zone "
+                         "(those rounds move zero cross-zone bytes; group "
+                         "means still reach the global mean in O(log N) "
+                         "rounds per level, Moshpit-style). 0 = flat "
+                         "single-level grid; degrades to flat while fewer "
+                         "than two zones are advertised")
     ap.add_argument("--method", default="trimmed_mean",
                     help="byzantine estimator: trimmed_mean|median|krum|"
                          "geometric_median|bulyan|centered_clip")
@@ -267,6 +284,8 @@ def main() -> None:
         max_group=args.max_group,
         group_size=args.group_size,
         group_rotation_s=args.group_rotation_s,
+        zone=args.zone,
+        cross_zone_every_k=args.cross_zone_every_k,
         method=args.method,
         method_kw=method_kw or None,
         batch_size=args.batch_size,

@@ -66,6 +66,14 @@ def pytest_configure(config):
         "per-group stats rollups, scale-bench smoke) — in the default "
         "lane, and selectable on their own with -m multigroup",
     )
+    config.addinivalue_line(
+        "markers",
+        "hierarchy: hierarchical (zone-aware) scheduling tests (two-level "
+        "grid, per-level mixing bound, zone-local failover, bandwidth-"
+        "weighted leader election, per-pair link model, per-zone rollups, "
+        "cross-zone-bytes bench smoke) — in the default lane, and "
+        "selectable on their own with -m hierarchy",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,822 @@
+"""Hierarchical (locality- and bandwidth-aware) scheduling tests.
+
+Five layers:
+
+1. ``GroupSchedule`` hierarchy math — intra rotations never span zones,
+   cross rotations use the zone-blind flat grid, levels ride in the group
+   ids, absent zones degrade to flat (mixed-version swarms never crash).
+2. The PER-LEVEL MIXING bound — the reason the hierarchy is sound: with
+   distinct per-volunteer scalars across two zones, intra+cross rotations
+   must still converge every volunteer to the GLOBAL mean within
+   O(log N)-per-level rounds, and an intra-only schedule must NOT (each
+   zone converges to its own mean and stays there).
+3. Bandwidth-weighted leader election — the fattest advertised uplink
+   self-elects, deterministically from the membership snapshot alone,
+   with exclusion and no-advertisement fallbacks intact.
+4. ChaosTransport's per-peer-pair link model (``set_link``) — the WAN
+   building block the two-zone bench rests on.
+5. Real in-process two-zone swarms over localhost TCP — intra rounds
+   average zone-locally under level-scoped keys, cross rounds mix, a
+   zone-group leader kill stays group-local (PR-4 fencing regression
+   under the new keys), per-zone/per-level rollups land in coord.status,
+   and the bench smoke fails loudly if hierarchical scheduling stops
+   beating the flat grid on cross-zone bytes per committed round.
+"""
+
+import asyncio
+import statistics
+import time as _time
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+from distributedvolunteercomputing_tpu.swarm.chaos import ChaosTransport
+from distributedvolunteercomputing_tpu.swarm.coordinator import Coordinator
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.matchmaking import (
+    GroupSchedule,
+    Matchmaker,
+)
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.resilience import ResiliencePolicy
+from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+pytestmark = pytest.mark.hierarchy
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def two_zones(n, za="dc", zb="home"):
+    """n peers split evenly across two zones: ids + zone map."""
+    ids = [f"p{i:02d}" for i in range(n)]
+    zones = {pid: (za if i < n // 2 else zb) for i, pid in enumerate(ids)}
+    return ids, zones
+
+
+class TestHierarchicalSchedule:
+    def test_intra_rotation_never_spans_zones(self):
+        ids, zones = two_zones(24)
+        for rot in (1, 2, 4, 5):  # k=3: none of these are cross rotations
+            groups = GroupSchedule.partition(
+                ids, rot, 4, zones=zones, cross_zone_every_k=3
+            )
+            flat = [p for g in groups for p in g]
+            assert sorted(flat) == sorted(ids)  # disjoint cover
+            assert len(flat) == len(set(flat))
+            for g in groups:
+                assert len({zones[p] for p in g}) == 1, (rot, g)
+
+    def test_cross_rotation_is_the_flat_grid(self):
+        ids, zones = two_zones(24)
+        for rot in (0, 3, 6):  # k=3 cross rotations
+            hier = GroupSchedule.partition(
+                ids, rot, 4, zones=zones, cross_zone_every_k=3
+            )
+            flat = GroupSchedule.partition(ids, rot, 4)
+            assert hier == flat
+        # and the hashed flat grid genuinely spans zones somewhere
+        spans = [
+            g
+            for g in GroupSchedule.partition(
+                ids, 3, 4, zones=zones, cross_zone_every_k=3
+            )
+            if len({zones[p] for p in g}) > 1
+        ]
+        assert spans
+
+    def test_assign_encodes_level_and_zone_in_group_id(self):
+        ids, zones = two_zones(16)
+        sched = GroupSchedule(target_size=4, cross_zone_every_k=3)
+        intra = sched.assign(ids, "p00", rot=1, zones=zones)
+        assert intra.level == "intra" and intra.zone == "dc"
+        assert ".zdc." in intra.group_id
+        assert all(zones[p] == "dc" for p in intra.members)
+        cross = sched.assign(ids, "p00", rot=3, zones=zones)
+        assert cross.level == "cross" and cross.zone == ""
+        assert ".x" in cross.group_id and ".g" not in cross.group_id
+        # distinct levels -> distinct keyspaces by construction
+        assert intra.group_id != cross.group_id
+
+    def test_degrades_to_flat_without_two_zones(self):
+        ids = [f"p{i}" for i in range(16)]
+        sched = GroupSchedule(target_size=4, cross_zone_every_k=3)
+        # no zones advertised at all (mixed-version swarm, pre-zone peers)
+        for rot in (1, 3):
+            asg = sched.assign(ids, "p0", rot=rot)
+            assert asg.level == "flat"
+            assert ".z" not in asg.group_id and ".x" not in asg.group_id
+        # one zone only: same degradation
+        one = {pid: "dc" for pid in ids}
+        assert sched.assign(ids, "p0", rot=1, zones=one).level == "flat"
+        # hierarchy off: zones ignored
+        flat_sched = GroupSchedule(target_size=4)
+        ids2, zones2 = two_zones(16)
+        asg = flat_sched.assign(ids2, "p00", rot=1, zones=zones2)
+        assert asg.level == "flat" and ".z" not in asg.group_id
+
+    def test_unzoned_peers_schedule_as_pseudo_zone(self):
+        """Peers without a zone advertisement form the "" pseudo-zone:
+        they intra-group among themselves, never crash the split, and
+        still mix with everyone on cross rotations."""
+        ids, zones = two_zones(12)
+        for pid in list(zones)[:4]:
+            del zones[pid]  # mixed-version: some peers advertise nothing
+        groups = GroupSchedule.partition(
+            ids, 1, 3, zones=zones, cross_zone_every_k=3
+        )
+        flat = [p for g in groups for p in g]
+        assert sorted(flat) == sorted(ids)
+        for g in groups:
+            assert len({zones.get(p, "") for p in g}) == 1
+
+    def test_singleton_zone_gets_unformable_scoped_assignment(self):
+        """A lone peer in its zone at an intra rotation must get a
+        members=(self,) assignment (so the averager can skip in O(1))
+        rather than None (which would fall back to the GLOBAL key and
+        burn a join timeout against peers that are all on zone keys)."""
+        ids, zones = two_zones(9)
+        zones["p08"] = "lonely"
+        sched = GroupSchedule(target_size=3, cross_zone_every_k=4)
+        asg = sched.assign(ids, "p08", rot=1, zones=zones)
+        assert asg is not None and asg.level == "intra"
+        assert asg.members == ("p08",)
+
+    def test_zone_tag_safe_and_collision_resistant(self):
+        assert GroupSchedule.zone_tag("dc-eu1") == "dc-eu1"
+        a, b = GroupSchedule.zone_tag("a b"), GroupSchedule.zone_tag("a_b")
+        assert a != b  # sanitization must not collide two distinct zones
+        for tag in (a, b):
+            assert all(c.isalnum() or c in "_-" for c in tag)
+        # the unzoned pseudo-zone can collide with NO real zone name: its
+        # tag uses a character the sanitizer never emits
+        assert GroupSchedule.zone_tag("") == "~"
+        for real in ("none", "~", "-0000", "_"):
+            assert GroupSchedule.zone_tag(real) != "~"
+
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError):
+            GroupSchedule(target_size=4, cross_zone_every_k=-1)
+
+
+class TestPerLevelMixing:
+    @staticmethod
+    def _mix(n, target, rounds, k, zones):
+        """Simulated hierarchy rounds: group means applied per partition,
+        relative global-mean deviation history returned."""
+        ids = sorted(zones)
+        vals = {p: float(i) for i, p in enumerate(ids)}
+        gmean = statistics.mean(vals.values())
+        spread = max(vals.values()) - min(vals.values())
+        history = []
+        for r in range(1, rounds + 1):
+            for grp in GroupSchedule.partition(
+                ids, r, target, zones=zones, cross_zone_every_k=k
+            ):
+                if len(grp) >= 2:
+                    m = statistics.mean(vals[p] for p in grp)
+                    for p in grp:
+                        vals[p] = m
+            history.append(max(abs(v - gmean) for v in vals.values()) / spread)
+        return history
+
+    def test_two_zone_hierarchy_mixes_in_log_rounds_per_level(self):
+        """N=16 across two zones, target 4, cross every 3rd rotation:
+        every volunteer must reach the global mean (rel. deviation < 1e-3
+        of the initial spread) within 2 levels x 3*log2(N) rounds — the
+        Moshpit bound applied per level, with slack for hash-arc skew and
+        the 1/k cross cadence."""
+        n = 16
+        ids, zones = two_zones(n)
+        budget = 2 * 3 * int(np.ceil(np.log2(n)))  # 24 rounds
+        hist = self._mix(n, 4, budget, k=3, zones=dict(zones))
+        assert hist[-1] < 1e-3, hist
+
+    def test_intra_only_schedule_does_not_mix_globally(self):
+        """The control: without cross rotations (k larger than the round
+        budget, rotations starting at 1 so none hits rot % k == 0) each
+        zone converges to its OWN mean and global deviation freezes —
+        the measured claim that the cross cadence, not zone grouping, is
+        what buys global mixing."""
+        n = 16
+        ids, zones = two_zones(n)
+        hist = self._mix(n, 4, 12, k=1000, zones=dict(zones))
+        # Deviation can never drop below the zone-mean gap: each zone of
+        # 8 converges to its own mean (|zone_mean - gmean| / spread =
+        # 4/15 ~ 0.267 here) and stays there.
+        assert hist[-1] > 0.25, hist
+        assert abs(hist[-1] - hist[8]) < 1e-3  # settled at the zone means
+
+    def test_mixing_scales_to_64_across_four_zones(self):
+        ids = [f"p{i:02d}" for i in range(64)]
+        zones = {pid: f"z{i % 4}" for i, pid in enumerate(ids)}
+        budget = 2 * 3 * int(np.ceil(np.log2(64)))
+        hist = self._mix(64, 8, budget, k=3, zones=zones)
+        assert hist[-1] < 1e-3, hist
+
+
+class TestBandwidthWeightedLeader:
+    @staticmethod
+    def mm(weights=None, exclude=None):
+        t = Transport()
+        return Matchmaker(
+            t, DHTNode(t), "self",
+            lead_weight=(lambda pid: (weights or {}).get(pid)),
+            lead_exclude=(lambda pid: pid in (exclude or ())),
+        )
+
+    MEMBERS = [("a", ("h", 1)), ("b", ("h", 2)), ("c", ("h", 3))]
+
+    def test_fattest_advertised_uplink_leads(self):
+        mm = self.mm(weights={"a": 1e6, "b": 64e6, "c": 8e6})
+        assert mm._pick_leader(self.MEMBERS) == "b"
+
+    def test_no_advertisement_falls_back_to_smallest_id(self):
+        mm = self.mm(weights={})
+        assert mm._pick_leader(self.MEMBERS) == "a"
+
+    def test_octave_bucket_ties_break_by_id(self):
+        """EWMA jitter between similar links must not flap the leader:
+        bandwidths within one octave tie, and the smallest id wins."""
+        mm = self.mm(weights={"b": 1024.0, "c": 1536.0})  # both bucket 10
+        assert mm._pick_leader(self.MEMBERS) == "b"
+
+    def test_excluded_fat_peer_is_skipped(self):
+        mm = self.mm(weights={"b": 64e6, "c": 8e6}, exclude={"b"})
+        assert mm._pick_leader(self.MEMBERS) == "c"
+        # every candidate flagged: plain smallest still leads (a round
+        # with a suspect leader beats no round)
+        mm = self.mm(weights={"b": 64e6}, exclude={"a", "b", "c"})
+        assert mm._pick_leader(self.MEMBERS) == "a"
+
+    def test_weight_callback_bug_does_not_kill_election(self):
+        t = Transport()
+        mm = Matchmaker(
+            t, DHTNode(t), "self",
+            lead_weight=lambda pid: (_ for _ in ()).throw(RuntimeError("bug")),
+        )
+        assert mm._pick_leader(self.MEMBERS) == "a"
+
+
+class TestTransportBandwidth:
+    def test_bulk_transfer_feeds_bandwidth_advertisement(self):
+        """A payload-scale RPC must populate the per-peer up/down
+        throughput EWMAs and surface them via bandwidth_advertisement();
+        an aged-out sample must vanish from the advertisement (absent
+        fields = consumers degrade to unweighted)."""
+
+        async def main():
+            server, client = Transport(), Transport()
+
+            async def echo(args, payload):
+                return {"ok": True}, payload
+
+            server.register("echo", echo)
+            await server.start()
+            await client.start()
+            try:
+                big = b"\x00" * (1 << 19)  # 512 KiB: over the sample floor
+                ret, back = await client.call(server.addr, "echo", {}, big)
+                assert len(back) == len(big)
+                adv = client.bandwidth_advertisement()
+                assert adv.get("bw_up", 0) > 0
+                assert adv.get("bw_down", 0) > 0
+                # directions age out INDEPENDENTLY: a node still fetching
+                # bulk results must not keep advertising a stale uplink
+                st = client._peer_stats[
+                    (str(server.addr[0]), int(server.addr[1]))
+                ]
+                st.bw_up_t = _time.monotonic() - 1e6
+                adv = client.bandwidth_advertisement()
+                assert "bw_up" not in adv and adv.get("bw_down", 0) > 0
+                st.bw_down_t = _time.monotonic() - 1e6
+                assert client.bandwidth_advertisement() == {}
+            finally:
+                await client.close()
+                await server.close()
+
+        run(main())
+
+    def test_small_rpcs_never_pollute_the_estimate(self):
+        async def main():
+            server, client = Transport(), Transport()
+
+            async def echo(args, payload):
+                return {"ok": True}, payload
+
+            server.register("echo", echo)
+            await server.start()
+            await client.start()
+            try:
+                for _ in range(5):
+                    await client.call(server.addr, "echo", {}, b"x" * 100)
+                assert client.bandwidth_advertisement() == {}
+            finally:
+                await client.close()
+                await server.close()
+
+        run(main())
+
+    def test_uplink_advertisement_is_median_across_reporters(self):
+        """bw_up samples are peer-REPORTED (rx_bps echoes): one lying
+        responder must not control the advertisement. With >= 3 fresh
+        reporters the median is advertised; bw_down (locally measured)
+        keeps the max."""
+        t = Transport()
+        for port, up, down in ((1, 9e11, 5e6), (2, 1e6, 7e6), (3, 1.2e6, 6e6)):
+            st = t._peer(("h", port))
+            st.observe_bw_up(up)   # port 1 is the liar
+            st.observe_bw_down(down)
+        adv = t.bandwidth_advertisement()
+        assert adv["bw_up"] == pytest.approx(1.2e6)  # median, not the lie
+        assert adv["bw_down"] == pytest.approx(7e6)  # local max
+
+    def test_zone_by_addr_is_sticky_across_snapshot_churn(self):
+        """The addr -> zone attribution must OUTLIVE a peer's membership
+        record: zone_traffic sums cumulative transport counters against
+        it, so a one-beat record gap must not make the peer's lifetime
+        bytes vanish and reappear as a phantom burst in the
+        coordinator's windowed cross_zone_bytes_per_commit."""
+        t = Transport()
+        mem = SwarmMembership(DHTNode(t), "p0")
+        mem._snapshot = {
+            "p1": {"addr": ["h", 1], "zone": "dc"},
+            "p2": {"addr": ["h", 2]},
+        }
+        assert mem.zone_by_addr() == {("h", 1): "dc", ("h", 2): ""}
+        mem._snapshot = {}  # p1/p2 missed a heartbeat
+        assert mem.zone_by_addr() == {("h", 1): "dc", ("h", 2): ""}
+        mem._snapshot = {"p1": {"addr": ["h", 1], "zone": "dc2"}}
+        assert mem.zone_by_addr()[("h", 1)] == "dc2"  # updates still land
+        # a zone-stripped record on a known address must NOT downgrade
+        # the attribution to "" (it would flip historical bytes)
+        mem._snapshot = {"px": {"addr": ["h", 1]}}
+        assert mem.zone_by_addr()[("h", 1)] == "dc2"
+
+    def test_coordinator_never_recounts_a_byte_dip(self):
+        """The cross-zone byte sum is cumulative but not strictly
+        monotone (peer-stats LRU eviction, zone re-attribution): a
+        DECREASE must re-baseline at delta 0, never re-inject the
+        volunteer's lifetime bytes as a phantom burst."""
+        coord = Coordinator()
+
+        def rep(xz):
+            return {"peer": "a", "groups": {
+                "enabled": True, "rounds_ok": 1,
+                "cross_zone_bytes_sent": xz, "recent": {}}}
+
+        async def feed():
+            await coord._rpc_report(rep(10_000_000), b"")  # baseline
+            await coord._rpc_report(rep(10_002_000), b"")  # +2000 real
+            await coord._rpc_report(rep(9_000_000), b"")   # dip: NOT -1M or +9M
+            await coord._rpc_report(rep(9_001_000), b"")   # +1000 real
+
+        asyncio.run(feed())
+        assert sum(d for _, d in coord._xz_window) == 3000
+
+    def test_membership_record_carries_and_refreshes_advertisement(self):
+        async def main():
+            t = Transport()
+            dht = DHTNode(t)
+            adv = {"bw_up": 1000}
+            mem = SwarmMembership(
+                dht, "p0", extra_info={"zone": "dc"},
+                bandwidth_source=lambda: dict(adv),
+            )
+            rec = mem._record()
+            assert rec["bw_up"] == 1000 and rec["zone"] == "dc"
+            adv["bw_up"] = 2000  # re-evaluated per announce (heartbeat)
+            assert mem._record()["bw_up"] == 2000
+            adv.clear()  # aged out -> field absent, not stale
+            assert "bw_up" not in mem._record()
+            # a buggy source must not kill the heartbeat
+            mem.bandwidth_source = lambda: (_ for _ in ()).throw(OSError())
+            assert "bw_up" not in mem._record()
+            await t.close()
+
+        run(main())
+
+
+class TestChaosLinkModel:
+    def test_set_link_latency_and_serialization_delay(self):
+        async def main():
+            server = ChaosTransport()
+
+            async def echo(args, payload):
+                return {"ok": True}, b""
+
+            server.register("echo", echo)
+            await server.start()
+            client = ChaosTransport()
+            await client.start()
+            try:
+                payload = b"\x00" * 100_000
+                t0 = _time.monotonic()
+                await client.call(server.addr, "echo", {}, payload)
+                base = _time.monotonic() - t0
+                # 0.15s latency + 100 KB at 1 MB/s = 0.1s serialization
+                client.set_link(client.addr, server.addr, 0.15, 1e6)
+                t0 = _time.monotonic()
+                await client.call(server.addr, "echo", {}, payload)
+                modeled = _time.monotonic() - t0
+                assert modeled >= base + 0.2, (base, modeled)
+                client.clear_links()
+                t0 = _time.monotonic()
+                await client.call(server.addr, "echo", {}, payload)
+                assert _time.monotonic() - t0 < base + 0.2
+            finally:
+                client.clear_links()
+                await client.close()
+                await server.close()
+
+        run(main())
+
+    def test_link_composes_with_partition(self):
+        async def main():
+            server = ChaosTransport()
+
+            async def ping(args, payload):
+                return {"ok": True}, b""
+
+            server.register("ping", ping)
+            await server.start()
+            client = ChaosTransport()
+            await client.start()
+            try:
+                client.set_link(client.addr, server.addr, 0.01, None)
+                client.partition(client.addr, server.addr)
+                with pytest.raises(OSError):
+                    await client.call(server.addr, "ping", {}, timeout=2.0)
+                client.heal()
+                await client.call(server.addr, "ping", {}, timeout=5.0)
+            finally:
+                client.clear_links()
+                client.heal()
+                await client.close()
+                await server.close()
+
+        run(main())
+
+    def test_set_link_validation(self):
+        t = ChaosTransport()
+        with pytest.raises(ValueError):
+            t.set_link(("h", 1), ("h", 2), latency_s=-1.0)
+        with pytest.raises(ValueError):
+            t.set_link(("h", 1), ("h", 2), bw_bps=0)
+
+
+class TestRollups:
+    def test_resilience_records_per_level(self):
+        pol = ResiliencePolicy(max_deadline_s=10.0)
+        pol.record_round(duration_s=0.2, ok=True, group_id="r1.zdc.g0",
+                         level="intra")
+        pol.record_round(duration_s=2.0, ok=True, degraded=True,
+                         group_id="r3.x0", level="cross")
+        pol.record_round(duration_s=0.3, ok=False, group_id="r4.zdc.g0",
+                         level="intra")
+        st = pol.stats()["levels"]
+        assert st["intra"]["rounds"] == 2 and st["intra"]["ok"] == 1
+        assert st["cross"]["degraded"] == 1
+        # levels are a tiny fixed set; no bounding needed, but absent
+        # levels (flat swarms) must not create the section at all
+        pol2 = ResiliencePolicy(max_deadline_s=10.0)
+        pol2.record_round(duration_s=0.1, ok=True)
+        assert "levels" not in pol2.stats()
+
+    def test_coordinator_per_zone_rollup_and_bytes_per_commit(self):
+        """coord.status must break the multigroup rollup down per zone
+        and per level, and track cross_zone_bytes_per_commit from report
+        deltas — the hierarchical schedule's headline metric, live."""
+        coord = Coordinator()
+
+        def report(peer, rounds_ok, xz_sent, xz_recv, zone):
+            return {
+                "peer": peer,
+                "groups": {
+                    "enabled": True, "rot": 7, "zone": zone,
+                    "rounds_ok": rounds_ok,
+                    "cross_zone_bytes_sent": xz_sent,
+                    "cross_zone_bytes_received": xz_recv,
+                    "levels": {
+                        "intra": {"rounds_ok": rounds_ok - 1,
+                                  "rounds_skipped": 0, "rounds_degraded": 0},
+                        "cross": {"rounds_ok": 1, "rounds_skipped": 0,
+                                  "rounds_degraded": 0},
+                    },
+                    "recent": {},
+                },
+            }
+
+        async def feed():
+            # Baselines (first sight seeds only), then real increments.
+            await coord._rpc_report(report("a", 2, 1000, 500, "dc"), b"")
+            await coord._rpc_report(report("b", 1, 0, 0, "home"), b"")
+            await coord._rpc_report(report("a", 6, 9000, 4500, "dc"), b"")
+            await coord._rpc_report(report("b", 3, 4000, 2000, "home"), b"")
+
+        asyncio.run(feed())
+        fresh = list(coord.latest_metrics.values())
+        roll = coord._multigroup_rollup(fresh)
+        assert roll["per_zone"]["dc"]["volunteers"] == 1
+        assert roll["per_zone"]["home"]["rounds_ok"] == 3
+        assert roll["per_zone"]["dc"]["cross_zone_bytes_sent"] == 9000
+        assert roll["per_level"]["cross"]["rounds_ok"] == 2
+        # windows: commits delta = (6-2)+(3-1) = 6; SENT-side bytes delta
+        # (each wire byte counted once, the hierarchy_bench definition) =
+        # (9000-1000) + (4000-0) = 12000 -> 2000 B/commit
+        assert roll["cross_zone_bytes_per_commit"] == pytest.approx(2000.0)
+
+
+# -- real in-process two-zone swarms ----------------------------------------
+
+
+def pinned_schedule(rot_cell, target, k, min_size=2):
+    return GroupSchedule(
+        target_size=target, rotation_s=1000.0, min_size=min_size,
+        cross_zone_every_k=k,
+        clock=lambda: rot_cell["rot"] * 1000.0 + 0.5,
+    )
+
+
+async def spawn_zoned(zone_sizes, target, rot_cell, k=3, **avg_kw):
+    """Volunteers across zones sharing one DHT; returns [(t, dht, mem,
+    avg, zone)] with ids vol0..volN in zone order; [0] is the bootstrap."""
+    vols = []
+    boot = None
+    kw = {"join_timeout": 6.0, "gather_timeout": 8.0, "min_group": 2,
+          "max_group": 3 * target, **avg_kw}
+    i = 0
+    for zone, size in zone_sizes.items():
+        for _ in range(size):
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=[boot] if boot else None)
+            if boot is None:
+                boot = t.addr
+            mem = SwarmMembership(
+                dht, f"vol{i}", ttl=10.0, extra_info={"zone": zone}
+            )
+            await mem.join()
+            avg = SyncAverager(
+                t, dht, mem,
+                group_schedule=pinned_schedule(rot_cell, target, k), **kw
+            )
+            vols.append((t, dht, mem, avg, zone))
+            i += 1
+    # Prime every snapshot so the first round's split (and zone maps) see
+    # the whole swarm.
+    for _, _, mem, _, _ in vols:
+        await mem.alive_peers()
+    return vols
+
+
+async def teardown(vols):
+    for t, dht, mem, _, _ in vols:
+        try:
+            await mem.leave()
+        except Exception:
+            pass
+        try:
+            await dht.stop()
+        except Exception:
+            pass
+        await t.close()
+
+
+def tree(v: float):
+    return {"w": np.full((64,), v, np.float32)}
+
+
+class TestHierarchicalRounds:
+    def test_intra_rounds_average_zone_locally(self):
+        """6 volunteers, two zones of 3, target 3, k=3: rotation 1 is
+        intra — each volunteer's result must be ITS ZONE's mean, under a
+        zone-scoped group id, with level gauges recorded."""
+        rot_cell = {"rot": 0}
+
+        async def main():
+            vols = await spawn_zoned({"dc": 3, "home": 3}, 3, rot_cell, k=3)
+            try:
+                rot_cell["rot"] = 1  # 1 % 3 != 0 -> intra
+                results = await asyncio.gather(
+                    *(
+                        v[3].average(tree(float(i)), round_no=1)
+                        for i, v in enumerate(vols)
+                    )
+                )
+                zone_vals = {}
+                for i, v in enumerate(vols):
+                    zone_vals.setdefault(v[4], []).append(float(i))
+                for i, (v, res) in enumerate(zip(vols, results)):
+                    assert res is not None, f"vol{i} skipped"
+                    np.testing.assert_allclose(
+                        res["w"], statistics.mean(zone_vals[v[4]]), rtol=1e-5
+                    )
+                    gs = v[3].group_stats()
+                    assert gs["level"] == "intra"
+                    assert f".z{v[4]}." in gs["group_id"]
+                    assert gs["zone"] == v[4]
+                    assert gs["levels"]["intra"]["rounds_ok"] == 1
+            finally:
+                await teardown(vols)
+
+        run(main())
+
+    def test_two_zone_swarm_converges_to_global_mean(self):
+        """The hierarchical mixing claim end-to-end: distinct scalars
+        across two zones, real rotated rounds (intra + every-3rd cross)
+        — every volunteer converges to the GLOBAL mean within
+        O(log N)-per-level rotations, through real level-scoped round
+        keys over localhost TCP."""
+        rot_cell = {"rot": 0}
+
+        async def main():
+            vols = await spawn_zoned({"dc": 3, "home": 3}, 3, rot_cell, k=3)
+            try:
+                vals = {i: float(i) for i in range(6)}
+                gmean = statistics.mean(vals.values())
+                spread = max(vals.values()) - min(vals.values())
+                budget = 2 * 3 * int(np.ceil(np.log2(6)))  # 18 rotations
+                err = None
+                for r in range(1, budget + 1):
+                    rot_cell["rot"] = r
+                    results = await asyncio.gather(
+                        *(
+                            v[3].average(tree(vals[i]), round_no=r)
+                            for i, v in enumerate(vols)
+                        )
+                    )
+                    for i, res in enumerate(results):
+                        if res is not None:
+                            vals[i] = float(res["w"][0])
+                    err = max(abs(v - gmean) for v in vals.values()) / spread
+                    if err < 1e-3:
+                        break
+                assert err is not None and err < 1e-3, (r, err, vals)
+                # both levels actually ran
+                lv = vols[0][3].group_stats()["levels"]
+                assert lv.get("intra", {}).get("rounds_ok", 0) >= 1
+                assert lv.get("cross", {}).get("rounds_ok", 0) >= 1
+            finally:
+                await teardown(vols)
+
+        run(main(), timeout=300)
+
+    @pytest.mark.chaos
+    @pytest.mark.failover
+    def test_zone_group_leader_kill_stays_group_local(self):
+        """Kill one zone-group's leader mid-stream at an intra rotation:
+        the OTHER zone's round must commit its own zone mean with ZERO
+        failover activity, while the victim zone's survivors recover via
+        the PR-4 machinery — the fencing regression under level-scoped
+        round keys."""
+        rot_cell = {"rot": 0}
+
+        async def main():
+            vols = await spawn_zoned({"dc": 3, "home": 3}, 3, rot_cell, k=3)
+            try:
+                rot_cell["rot"] = 1  # intra
+                by_pid = {f"vol{i}": vols[i] for i in range(6)}
+                dc_pids = [f"vol{i}" for i, v in enumerate(vols)
+                           if v[4] == "dc"]
+                home_pids = [f"vol{i}" for i, v in enumerate(vols)
+                             if v[4] == "home"]
+                victim_pid = min(dc_pids)  # smallest id leads (no bw adv)
+                victim = by_pid[victim_pid]
+
+                async def die():
+                    await victim[0].close()
+                    raise RuntimeError("chaos: zone-group leader killed")
+
+                victim[3]._phase_hooks["mid_stream"] = die
+
+                async def one(i, v):
+                    try:
+                        return await v[3].average(tree(float(i)), round_no=2)
+                    except Exception:
+                        return None
+
+                results = await asyncio.gather(
+                    *(one(i, v) for i, v in enumerate(vols))
+                )
+                res_of = {f"vol{i}": r for i, r in enumerate(results)}
+                home_mean = statistics.mean(float(p[3:]) for p in home_pids)
+                for p in home_pids:
+                    assert res_of[p] is not None, f"{p} failed to commit"
+                    np.testing.assert_allclose(
+                        res_of[p]["w"], home_mean, rtol=1e-5
+                    )
+                    assert by_pid[p][3].leaders_deposed == 0
+                    assert by_pid[p][3].rounds_recovered == 0
+                survivors = [p for p in dc_pids if p != victim_pid]
+                assert any(
+                    by_pid[p][3].rounds_recovered >= 1 for p in survivors
+                ), "victim zone's survivors did not recover"
+                for p in survivors:
+                    if res_of[p] is not None:
+                        np.testing.assert_allclose(
+                            res_of[p]["w"],
+                            statistics.mean(float(q[3:]) for q in survivors),
+                            rtol=1e-5,
+                        )
+            finally:
+                await teardown(vols)
+
+        run(main(), timeout=180)
+
+    def test_undersized_zone_skips_below_min_group(self):
+        """min_group is a robustness floor (byzantine breakdown point),
+        not a preference: a zone with fewer members than min_group must
+        SKIP its intra rounds — fast, deterministically — rather than
+        quietly running rounds beneath the configured floor (the flat
+        grid's analogue falls back to the whole-swarm round, which the
+        zone scoping removes)."""
+        rot_cell = {"rot": 0}
+
+        async def main():
+            vols = await spawn_zoned(
+                {"dc": 4, "small": 3}, 4, rot_cell, k=5,
+                min_group=4, join_timeout=8.0,
+            )
+            try:
+                rot_cell["rot"] = 1  # intra
+                results = await asyncio.gather(
+                    *(
+                        v[3].average(tree(float(i)), round_no=1)
+                        for i, v in enumerate(vols)
+                    )
+                )
+                for i, (v, res) in enumerate(zip(vols, results)):
+                    if v[4] == "small":
+                        assert res is None, f"vol{i} ran below min_group"
+                        assert v[3].rounds_skipped == 1
+                    else:
+                        assert res is not None, f"vol{i} (dc) skipped"
+                        np.testing.assert_allclose(
+                            res["w"], statistics.mean((0.0, 1.0, 2.0, 3.0)),
+                            rtol=1e-5,
+                        )
+            finally:
+                await teardown(vols)
+
+        run(main())
+
+    def test_lone_zone_peer_skips_intra_round_fast(self):
+        """A zone with one member at an intra rotation: its scheduled
+        group is just itself, and the round must SKIP in well under the
+        join timeout (deterministic — nobody else will ever rendezvous
+        under that key) instead of burning it."""
+        rot_cell = {"rot": 0}
+
+        async def main():
+            vols = await spawn_zoned(
+                {"dc": 4, "lonely": 1}, 2, rot_cell, k=5, join_timeout=8.0
+            )
+            try:
+                rot_cell["rot"] = 1  # intra
+                lone = vols[4]
+                assert lone[4] == "lonely"
+                t0 = _time.monotonic()
+                res = await lone[3].average(tree(9.0), round_no=1)
+                dt = _time.monotonic() - t0
+                assert res is None
+                assert dt < 4.0, dt  # skipped, not a burned join timeout
+                assert lone[3].rounds_skipped == 1
+            finally:
+                await teardown(vols)
+
+        run(main())
+
+
+class TestHierarchyBenchSmoke:
+    def test_hier_beats_flat_on_cross_zone_bytes_per_commit(self):
+        """Fast in-process smoke of experiments/hierarchy_bench.py in the
+        default lane: on a two-zone swarm run to the same mixing-error
+        target, hierarchical scheduling must move measurably fewer
+        cross-zone bytes per committed round than the flat PR-7 grid —
+        loud failure if the hierarchy stops paying for itself. The banked
+        two-zone artifact (with WAN link asymmetry and the >= 2x verdict)
+        is experiments/results/hierarchy_bench.json."""
+        from experiments.hierarchy_bench import run_config
+
+        flat = run(
+            run_config(8, "flat", group_target=2, tree_elems=16384,
+                       target_err=5e-2, max_rounds=8, links=False),
+            timeout=300,
+        )
+        hier = run(
+            run_config(8, "hier", group_target=2, tree_elems=16384,
+                       target_err=5e-2, max_rounds=12, links=False,
+                       cross_every_k=3),
+            timeout=300,
+        )
+        assert flat["commit_frac"] >= 0.7, flat
+        assert hier["commit_frac"] >= 0.7, hier
+        assert flat["mix_err_final"] <= 5e-2, flat
+        assert hier["mix_err_final"] <= 5e-2, hier
+        ratio = flat["xz_bytes_per_commit"] / max(
+            hier["xz_bytes_per_commit"], 1.0
+        )
+        assert ratio >= 1.5, (flat, hier)
