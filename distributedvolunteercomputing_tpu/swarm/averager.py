@@ -190,6 +190,7 @@ class AveragerBase:
         failure_detector=None,
         mesh_codec=None,
         group_schedule: Optional[GroupSchedule] = None,
+        control_plane=None,
     ):
         if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -302,10 +303,19 @@ class AveragerBase:
         # the moment it reappears — and by sync members refusing to join a
         # round such a peer leads while the strike is fresh.
         self._deposed_leaders: Dict[str, float] = {}
+        # Replicated control plane (swarm/control_plane.py): matchmaking's
+        # rendezvous polls read through a replica's micro-cache when one
+        # answers (N members polling one forming round amortize to ~one
+        # DHT lookup per cache window), with automatic fallback to direct
+        # DHT reads — matchmaking never depends on a coordinator.
+        self.control_plane = control_plane
         self.matchmaker = Matchmaker(
             transport, dht, self.peer_id, clock=self.clock, exclude=exclude,
             lead_exclude=self._lead_excluded,
             lead_weight=self._advertised_bw,
+            rendezvous_get=(
+                control_plane.rendezvous_get if control_plane is not None else None
+            ),
         )
         self.min_group = min_group
         self.max_group = max_group
@@ -1348,6 +1358,15 @@ class AveragerBase:
             out["groups"] = self.group_stats()
         if self.resilience is not None:
             out["resilience"] = self.resilience.stats()
+        # Control-plane accounting: messages this node spends per heartbeat
+        # interval (the batching headline metric) plus the failover
+        # client's replica view — proves the batched path is actually in
+        # use and shows where traffic fails over during replica churn.
+        cp_stats = self.membership.stats() if hasattr(self.membership, "stats") else None
+        if cp_stats is not None and (
+            cp_stats.get("beats") or self.control_plane is not None
+        ):
+            out["control_plane"] = cp_stats
         return out
 
     def _note_agg_round(self, stream: Optional[StreamingAggregator]) -> None:

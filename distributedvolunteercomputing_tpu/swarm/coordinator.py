@@ -1,21 +1,32 @@
-"""Coordinator: swarm bootstrap node + liveness registry + metrics sink.
+"""Coordinator: a stateless front for the replicated control plane.
 
 Reference parity: the ``coordinator.py`` entrypoint "bootstraps the swarm:
 initial DHT node, rendezvous address, liveness registry" (SURVEY.md §2,
-BASELINE.json:5). It does NO device work (SURVEY.md §3-A) — one asyncio
-process serving DHT RPCs, collecting per-volunteer metrics, and evicting the
-dead (by TTL expiry, which the DHT does for free).
+BASELINE.json:5). Since the control-plane PR it holds NO authoritative
+state: it is one DHT node plus one ``ControlPlaneReplica``
+(swarm/control_plane.py) — membership records, metrics rollups, and the
+replica set itself are TTL'd DHT soft state, sharded by key range across
+every elected replica (any volunteer run with ``--host-replica`` is a
+candidate too). Kill this process mid-training and a surviving replica
+serves ``coord.status`` within one heartbeat interval; volunteers' batched
+heartbeat/report traffic fails over on conn failure, exactly like the PR-4
+leader-deposal path.
+
+SIGTERM (the TPU-VM preemption notice) retires gracefully: a "retiring"
+tombstone under ``cp/replicas`` makes volunteers and peer replicas
+re-resolve the active set immediately instead of waiting for the record's
+TTL.
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
+import signal
 import time
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
+from distributedvolunteercomputing_tpu.swarm.control_plane import ControlPlaneReplica
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
-from distributedvolunteercomputing_tpu.swarm.membership import PEERS_KEY
 from distributedvolunteercomputing_tpu.swarm.transport import Transport
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
 
@@ -23,6 +34,11 @@ log = get_logger(__name__)
 
 
 class Coordinator:
+    """Swarm bootstrap node hosting one control-plane replica. The public
+    surface (``coord.report``/``coord.status`` RPCs, ``_rpc_status`` for
+    in-process callers) is unchanged from the single-host coordinator; the
+    state behind it moved into the DHT."""
+
     def __init__(
         self,
         host: str = "127.0.0.1",
@@ -30,36 +46,13 @@ class Coordinator:
         metrics_path: Optional[str] = None,
         advertise_host: Optional[str] = None,
         secret: Optional[bytes] = None,
+        rid: Optional[str] = None,
     ):
         self.transport = Transport(host, port, advertise_host=advertise_host, secret=secret)
         self.dht = DHTNode(self.transport)
-        self.metrics_path = metrics_path
-        self.latest_metrics: Dict[str, dict] = {}
-        self._t0 = time.time()
-        # Swarm-wide committed-round rate (multi-group rollup): per-peer
-        # last-seen cumulative rounds_ok, and a sliding window of
-        # (recv_t, delta) increments the status RPC sums over the last
-        # minute — a rate no single volunteer's flat counter can show.
-        self._commit_seen: Dict[str, int] = {}
-        self._commit_window: list = []
-        # Cross-zone byte rate (hierarchical-schedule rollup), tracked the
-        # same way: per-peer last-seen cumulative cross-zone bytes SENT
-        # (sent-side only, so each wire byte is counted once across the
-        # swarm — the same definition hierarchy_bench.json uses) and a
-        # sliding window of increments, so status can report
-        # cross_zone_bytes_per_commit — the hierarchical schedule's
-        # headline metric — live.
-        self._xz_seen: Dict[str, int] = {}
-        self._xz_window: list = []
-        self.transport.register("coord.report", self._rpc_report)
-        self.transport.register("coord.status", self._rpc_status)
-
-    COMMIT_WINDOW_S = 60.0
-    # Volunteer ids are fresh uuids per process, so churn would grow the
-    # per-peer maps without bound on a long-running coordinator; a peer
-    # silent this long is dropped (a late reappearance re-seeds its commit
-    # baseline at delta 0, identical to first sight).
-    STALE_PEER_TTL_S = 600.0
+        self.replica = ControlPlaneReplica(
+            self.transport, self.dht, rid=rid, metrics_path=metrics_path,
+        )
 
     async def start(self) -> Tuple[str, int]:
         from distributedvolunteercomputing_tpu.utils.asyncio_debug import maybe_enable_from_env
@@ -68,214 +61,56 @@ class Coordinator:
         self._loop_monitor = maybe_enable_from_env()
         addr = await self.transport.start()
         await self.dht.start(bootstrap=None)
+        await self.replica.start()
         log.info("coordinator listening on %s:%d", *addr)
         return addr
 
     async def close(self) -> None:
+        await self.replica.stop()
         await self.dht.stop()
         if getattr(self, "_loop_monitor", None) is not None:
             await self._loop_monitor.stop()
         await self.transport.close()
 
-    # -- RPCs --------------------------------------------------------------
+    async def retire(self, grace: float = 0.5) -> None:
+        """Graceful SIGTERM path: publish the retiring tombstone, drain,
+        then close."""
+        await self.replica.retire(grace=grace)
+        await self.close()
+
+    # Back-compat passthroughs: in-process callers (tests, the forever
+    # loop) talk to the coordinator, the replica does the work. The window
+    # views flatten the replica's per-shard windows back into the flat
+    # lists the single-host coordinator kept.
+
+    @property
+    def latest_metrics(self):
+        return self.replica.latest_metrics
+
+    @property
+    def _commit_window(self):
+        return sorted(
+            (td for w in self.replica._commit_window.values() for td in w),
+            key=lambda td: td[0],
+        )
+
+    @property
+    def _xz_window(self):
+        return sorted(
+            (td for w in self.replica._xz_window.values() for td in w),
+            key=lambda td: td[0],
+        )
+
+    def _multigroup_rollup(self, fresh: list):
+        return self.replica._multigroup_rollup(
+            fresh, self._commit_window, self._xz_window
+        )
 
     async def _rpc_report(self, args: dict, payload: bytes):
-        """Volunteers push per-step metrics; coordinator aggregates swarm-level."""
-        peer = args.get("peer", "?")
-        now = time.time()
-        self.latest_metrics[peer] = {**args, "recv_t": now}
-        groups = args.get("groups")
-        if isinstance(groups, dict):
-            total = groups.get("rounds_ok")
-            if isinstance(total, int):
-                prev = self._commit_seen.get(peer)
-                self._commit_seen[peer] = total
-                if prev is None:
-                    # First sight of this peer (fresh coordinator joining a
-                    # long-running swarm, or a new volunteer): seed the
-                    # baseline only — injecting the lifetime total would
-                    # report a bogus commit burst for the next window.
-                    delta = 0
-                elif total >= prev:
-                    delta = total - prev
-                else:
-                    # Counter went backwards = the volunteer restarted;
-                    # count from zero, don't subtract history.
-                    delta = total
-                if delta > 0:
-                    self._commit_window.append((now, delta))
-            xz = groups.get("cross_zone_bytes_sent")
-            if isinstance(xz, int):
-                prev = self._xz_seen.get(peer)
-                self._xz_seen[peer] = xz
-                # Unlike the commit counter, a DECREASE here re-baselines
-                # at delta 0 rather than counting from zero: the byte sum
-                # is cumulative-but-not-strictly-monotone (peer-stats LRU
-                # eviction or a zone re-attribution can dip it), and
-                # "count from zero" would re-inject a volunteer's entire
-                # lifetime cross-zone bytes as one phantom burst. A real
-                # volunteer restart just loses the first window's bytes.
-                xdelta = xz - prev if prev is not None and xz >= prev else 0
-                if xdelta > 0:
-                    self._xz_window.append((now, xdelta))
-            cutoff = now - self.COMMIT_WINDOW_S
-            self._commit_window = [
-                (t, d) for t, d in self._commit_window if t >= cutoff
-            ]
-            self._xz_window = [
-                (t, d) for t, d in self._xz_window if t >= cutoff
-            ]
-        for p in [
-            p for p, m in self.latest_metrics.items()
-            if now - m["recv_t"] > self.STALE_PEER_TTL_S
-        ]:
-            self.latest_metrics.pop(p, None)
-            self._commit_seen.pop(p, None)
-            self._xz_seen.pop(p, None)
-        if self.metrics_path:
-            with open(self.metrics_path, "a") as fh:
-                fh.write(json.dumps(self.latest_metrics[peer]) + "\n")
-        return {"ok": True}, b""
-
-    def _multigroup_rollup(self, fresh: list) -> Optional[dict]:
-        """Swarm-level view of the rotating group schedule, from the fresh
-        reports that carry ``groups`` gauges. Namespaced PER GROUP — the
-        flat per-peer maps elsewhere in status would silently average
-        across groups — plus the rollups a dashboard needs: groups active
-        this rotation, committed-round rate, and the slowest group's lag
-        behind its last commit."""
-        gstats = {
-            m.get("peer", "?"): m["groups"]
-            for m in fresh
-            if isinstance(m.get("groups"), dict) and m["groups"].get("enabled")
-        }
-        if not gstats:
-            return None
-        now = time.time()
-        rot = max(
-            (gs.get("rot") for gs in gstats.values() if gs.get("rot") is not None),
-            default=None,
-        )
-        active = {
-            gs["group_id"] for gs in gstats.values() if gs.get("group_id")
-        }
-        # Per-group breakdown, merged across reporters. Counters are
-        # volunteer-rounds (a committed group round counts once per member
-        # that saw it commit) — a participation measure, not a round count.
-        per_group: Dict[str, dict] = {}
-        for peer, gs in gstats.items():
-            for gid, rec in (gs.get("recent") or {}).items():
-                g = per_group.setdefault(
-                    gid,
-                    {"volunteers": 0, "rounds_ok": 0, "rounds_skipped": 0,
-                     "rounds_degraded": 0, "last_commit_t": None},
-                )
-                g["volunteers"] += 1
-                for k in ("rounds_ok", "rounds_skipped", "rounds_degraded"):
-                    g[k] += int(rec.get(k) or 0)
-                t = rec.get("last_commit_t")
-                if t is not None and (
-                    g["last_commit_t"] is None or t > g["last_commit_t"]
-                ):
-                    g["last_commit_t"] = t
-        # Slowest ACTIVE group's lag behind its last commit (volunteer
-        # clocks, so skew-accurate only to ClockSync quality): the
-        # "is any group silently stuck" gauge.
-        lags = [
-            now - per_group[gid]["last_commit_t"]
-            for gid in active
-            if gid in per_group and per_group[gid]["last_commit_t"] is not None
-        ]
-        # Per-zone breakdown (hierarchical schedule): volunteers, commit
-        # totals, and each zone's cross-zone byte footprint — so an
-        # operator sees WHICH zone is burning WAN bytes or lagging, not
-        # one flat number averaging a DC slice against a home DSL line.
-        per_zone: Dict[str, dict] = {}
-        per_level: Dict[str, dict] = {}
-        for gs in gstats.values():
-            z = per_zone.setdefault(
-                str(gs.get("zone") or ""),
-                {"volunteers": 0, "rounds_ok": 0,
-                 "cross_zone_bytes_sent": 0, "cross_zone_bytes_received": 0},
-            )
-            z["volunteers"] += 1
-            z["rounds_ok"] += int(gs.get("rounds_ok") or 0)
-            for k in ("cross_zone_bytes_sent", "cross_zone_bytes_received"):
-                z[k] += int(gs.get(k) or 0)
-            for lv, rec in (gs.get("levels") or {}).items():
-                agg = per_level.setdefault(
-                    str(lv),
-                    {"rounds_ok": 0, "rounds_skipped": 0, "rounds_degraded": 0},
-                )
-                for k in agg:
-                    agg[k] += int(rec.get(k) or 0)
-        cutoff = now - self.COMMIT_WINDOW_S
-        commits = sum(d for t, d in self._commit_window if t >= cutoff)
-        xz_bytes = sum(d for t, d in self._xz_window if t >= cutoff)
-        return {
-            "volunteers": len(gstats),
-            "rot": rot,
-            "groups_active": len(active),
-            "rounds_ok_total": sum(
-                int(gs.get("rounds_ok") or 0) for gs in gstats.values()
-            ),
-            "commits_per_min": round(
-                commits * 60.0 / self.COMMIT_WINDOW_S, 2
-            ),
-            "slowest_group_lag_s": round(max(lags), 3) if lags else None,
-            "per_group": per_group,
-            "per_zone": per_zone,
-            "per_level": per_level or None,
-            # The hierarchical schedule's headline metric, live: WAN bytes
-            # that crossed a zone boundary (sent-side counters, each wire
-            # byte counted once — the hierarchy_bench definition) per
-            # committed volunteer-round, over the sliding window (None
-            # until a commit lands in it).
-            "cross_zone_bytes_per_commit": (
-                round(xz_bytes / commits, 1) if commits else None
-            ),
-        }
+        return await self.replica._rpc_report(args, payload)
 
     async def _rpc_status(self, args: dict, payload: bytes):
-        """Swarm-level view: alive peers + aggregate samples/sec."""
-        peers = await self.dht.get(PEERS_KEY)
-        alive = {pid: rec for pid, rec in peers.items() if rec is not None}
-        fresh = [
-            m for m in self.latest_metrics.values() if time.time() - m["recv_t"] < 60.0
-        ]
-        agg_sps = sum(float(m.get("samples_per_sec", 0.0)) for m in fresh)
-        multigroup = self._multigroup_rollup(fresh)
-        return {
-            # Rotating group-schedule rollup (None until some volunteer
-            # reports multi-group gauges): per-group commit health plus
-            # the swarm-wide rate/lag numbers.
-            "multigroup": multigroup,
-            "alive": alive,
-            "n_alive": len(alive),
-            "swarm_samples_per_sec": agg_sps,
-            "uptime_s": time.time() - self._t0,
-            # Transport-level counters (per-peer bytes/RPCs/connects/latency
-            # EWMA): the coordinator's own WAN vantage, one `coord.status`
-            # away for operators.
-            "transport": self.transport.stats(),
-            # Per-volunteer leader-aggregation pipeline gauges (peak bytes
-            # held, tiles aggregated early vs at-deadline, aggregate-thread
-            # busy fraction) from the freshest reports — empty until some
-            # volunteer has led a streaming round.
-            "aggregation": {
-                m.get("peer", "?"): m["aggregation"]
-                for m in fresh
-                if m.get("aggregation")
-            },
-            # Per-volunteer leader-failover gauges (leaders deposed, rounds
-            # recovered by a successor, recovery latency) — empty until a
-            # volunteer has lived through a leader death.
-            "failover": {
-                m.get("peer", "?"): m["failover"]
-                for m in fresh
-                if m.get("failover")
-            },
-        }, b""
+        return await self.replica._rpc_status(args, payload)
 
 
 async def run_coordinator_forever(
@@ -286,12 +121,31 @@ async def run_coordinator_forever(
     secret: Optional[bytes] = None,
 ) -> None:
     coord = Coordinator(host, port, metrics_path, advertise_host=advertise_host, secret=secret)
+    # SIGTERM = preemption notice: retire gracefully (publish the retiring
+    # tombstone so volunteers re-resolve replicas IMMEDIATELY) instead of
+    # vanishing and leaving them to discover the corpse by conn failure.
+    # Installed BEFORE the ready line: a supervisor that kills the moment
+    # the coordinator reports ready must still get the graceful path.
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    try:
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+    except (NotImplementedError, RuntimeError):  # non-main thread / Windows
+        pass
     addr = await coord.start()
     print(f"COORDINATOR_READY {addr[0]}:{addr[1]}", flush=True)
     try:
-        while True:
-            await asyncio.sleep(10.0)
-            status, _ = await coord._rpc_status({}, b"")
-            log.info("swarm status: %s", status)
+        last_log = time.monotonic()
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+            if time.monotonic() - last_log >= 10.0:
+                last_log = time.monotonic()
+                status, _ = await coord._rpc_status({}, b"")
+                log.info("swarm status: %s", status)
+        log.info("SIGTERM: retiring coordinator replica")
+        await coord.retire()
     except asyncio.CancelledError:
         await coord.close()

@@ -39,6 +39,17 @@ K = 8       # bucket size / replication factor
 ALPHA = 3   # lookup parallelism
 
 
+class StaleWriteFenced(RPCError):
+    """A fenced store was rejected because a storage node holds a HIGHER
+    generation watermark for the (key, subkey): the writer has been deposed
+    (the control plane handed its key range to a newer replica). Carries the
+    highest watermark seen so the writer can re-resolve ownership."""
+
+    def __init__(self, key: str, subkey: str, gen: int):
+        super().__init__(f"fenced: {key}/{subkey} watermark gen {gen}")
+        self.key, self.subkey, self.gen = key, subkey, gen
+
+
 def _sha1_int(data: bytes) -> int:
     return int.from_bytes(hashlib.sha1(data).digest(), "big")
 
@@ -122,8 +133,20 @@ class DHTNode:
         self.storage: Dict[str, Dict[str, Tuple[str, float]]] = {}
         # Records THIS node stored via store(): republished to the (possibly
         # changed) k-closest set until their TTL runs out, so a record
-        # survives its original replicas churning away.
-        self._owned: Dict[Tuple[str, str], Tuple[str, float]] = {}
+        # survives its original replicas churning away. Value is
+        # (json, expiry, fence_gen_or_None, fence_owner).
+        self._owned: Dict[Tuple[str, str], Tuple[str, float, Optional[int], str]] = {}
+        # Fencing watermarks for control-plane writes: (key, subkey) ->
+        # (highest generation seen, its writer id, expiry). A store
+        # carrying a LOWER generation is refused — the stale-replica-write
+        # rejection the replicated control plane's shard handoff depends
+        # on (same epoch+generation idea round leadership uses). An EQUAL
+        # generation from a DIFFERENT writer is arbitrated by smallest
+        # writer id (the election idiom): two replicas whose split views
+        # both claimed gen g must converge on one writer, not flip-flop
+        # silently forever. Kept well past the record's own TTL so a
+        # deposed writer stays fenced across a gap.
+        self._fence_gens: Dict[Tuple[str, str], Tuple[int, str, float]] = {}
         # Replica-set cache for stores: target -> (stamp, k-closest). A
         # periodic re-store of the SAME key (membership heartbeats every
         # ttl/3) was paying a full iterative lookup each time for an
@@ -153,6 +176,8 @@ class DHTNode:
                 self.storage[key] = rec
             else:
                 del self.storage[key]
+        for ks in [ks for ks, (_, _, exp) in self._fence_gens.items() if exp <= now]:
+            del self._fence_gens[ks]
 
     async def start(self, bootstrap: Optional[List[Addr]] = None) -> None:
         addr = self.transport.addr
@@ -233,13 +258,63 @@ class DHTNode:
         self._note_sender(args)
         return {"id": str(self.node_id), "addr": list(self.transport.addr)}, b""
 
+    FENCE_TTL = 600.0
+
+    def _store_local(
+        self, key: str, subkey: str, value_json: str, ttl: float,
+        fence: Optional[int] = None, fence_owner: str = "",
+    ) -> Optional[int]:
+        """Apply one subkey store to local storage, honoring the fence
+        watermark. Returns None on success, or the blocking watermark
+        generation when the write is stale-fenced (NOT applied). A write
+        at the CURRENT generation from a different owner is accepted only
+        from a SMALLER owner id (deterministic tiebreak for two replicas
+        whose split views claimed the same generation — the larger id is
+        fenced and escalates, instead of both flip-flopping the record
+        silently)."""
+        now = time.monotonic()
+        if fence is not None:
+            cur = self._fence_gens.get((key, subkey))
+            if cur is not None and cur[2] > now:
+                cur_gen, cur_owner, _ = cur
+                if cur_gen > fence or (
+                    cur_gen == fence
+                    and fence_owner
+                    and cur_owner
+                    and fence_owner > cur_owner
+                ):
+                    return cur_gen
+            self._fence_gens[(key, subkey)] = (
+                int(fence), fence_owner, now + max(self.FENCE_TTL, ttl)
+            )
+        rec = self.storage.setdefault(key, {})
+        rec[subkey] = (value_json, now + ttl)
+        return None
+
     async def _rpc_store(self, args: dict, payload: bytes) -> Tuple[dict, bytes]:
+        """Single-subkey store, or a BATCHED one: ``values`` maps subkey ->
+        [json, ttl] so one RPC can carry a whole membership shard's records
+        (the control plane's heartbeat coalescing — N peers' beats cross as
+        one frame per storage replica instead of N)."""
         self._note_sender(args)
         self._sweep_storage()
-        key, subkey = args["key"], args.get("subkey", "")
-        ttl = float(args.get("ttl", 60.0))
-        rec = self.storage.setdefault(key, {})
-        rec[subkey] = (args["value"], time.monotonic() + ttl)
+        key = args["key"]
+        fence = args.get("fence")
+        fence = int(fence) if fence is not None else None
+        fence_owner = str(args.get("fence_owner") or "")
+        values = args.get("values")
+        if values is None:
+            values = {args.get("subkey", ""): [args["value"], float(args.get("ttl", 60.0))]}
+        blocked = None
+        for sk, (value_json, ttl) in values.items():
+            w = self._store_local(
+                key, sk, value_json, float(ttl),
+                fence=fence, fence_owner=fence_owner,
+            )
+            if w is not None:
+                blocked = max(blocked or 0, w)
+        if blocked is not None:
+            return {"ok": False, "fenced": True, "gen": blocked}, b""
         return {"ok": True}, b""
 
     async def _rpc_find(self, args: dict, payload: bytes) -> Tuple[dict, bytes]:
@@ -334,13 +409,22 @@ class DHTNode:
     async def _republish_owned(self) -> None:
         now = time.monotonic()
         for (key, subkey) in list(self._owned):
-            value_json, expiry = self._owned[(key, subkey)]
+            value_json, expiry, fence, fence_owner = self._owned[(key, subkey)]
             if expiry <= now:
                 del self._owned[(key, subkey)]
                 continue
             # Remaining ttl, not the original: republish must never extend a
             # record's life beyond what its owner asked for.
-            await self._store_raw(key, subkey, value_json, expiry - now)
+            try:
+                await self._store_raw(
+                    key, subkey, value_json, expiry - now,
+                    fence=fence, fence_owner=fence_owner,
+                )
+            except StaleWriteFenced:
+                # Deposed mid-life: a newer generation owns this record now;
+                # republishing it would be exactly the stale write the fence
+                # exists to reject. Drop ownership.
+                del self._owned[(key, subkey)]
 
     async def _refresh_bucket(self) -> None:
         nonempty = [i for i, b in enumerate(self.table.buckets) if b]
@@ -356,7 +440,19 @@ class DHTNode:
     STORE_ROUTE_TTL = 15.0
     MAX_STORE_ROUTES = 64
 
-    async def _store_raw(self, key: str, subkey: str, value_json: str, ttl: float) -> int:
+    async def _store_raw(
+        self,
+        key: str,
+        subkey: str,
+        value_json: str,
+        ttl: float,
+        fence: Optional[int] = None,
+        fence_owner: str = "",
+        batch: Optional[Dict[str, Tuple[str, float]]] = None,
+    ) -> int:
+        """Fan one store (or a ``batch`` of subkeys in ONE RPC per storage
+        replica) to the k-closest set. Raises StaleWriteFenced when any
+        replica (or the local store) holds a higher fence watermark."""
         target = key_id(key)
         now = time.monotonic()
         cached = self._store_routes.get(target)
@@ -367,35 +463,145 @@ class DHTNode:
             if len(self._store_routes) >= self.MAX_STORE_ROUTES:
                 self._store_routes.pop(next(iter(self._store_routes)))
             self._store_routes[target] = (now, closest)
-        payload_args = {
-            "key": key,
-            "subkey": subkey,
-            "value": value_json,
-            "ttl": ttl,
-            "sender": self._self_info(),
-        }
+        entries = batch if batch is not None else {subkey: (value_json, ttl)}
+
+        def _legacy_args(sk: str, vj: str, t: float) -> dict:
+            # The pre-batching wire shape every storage-node version
+            # understands.
+            args = {
+                "key": key, "subkey": sk, "value": vj, "ttl": t,
+                "sender": self._self_info(),
+            }
+            if fence is not None:
+                args["fence"] = int(fence)
+                if fence_owner:
+                    args["fence_owner"] = fence_owner
+            return args
+
+        if batch is not None:
+            payload_args: dict = {
+                "key": key,
+                "values": {sk: [vj, t] for sk, (vj, t) in entries.items()},
+                "sender": self._self_info(),
+            }
+            if fence is not None:
+                payload_args["fence"] = int(fence)
+                if fence_owner:
+                    payload_args["fence_owner"] = fence_owner
+        else:
+            # Single-subkey stores keep the legacy wire shape outright: a
+            # storage node one version behind (no ``values`` support) must
+            # keep accepting ordinary membership/rendezvous stores from
+            # upgraded peers.
+            payload_args = _legacy_args(subkey, value_json, ttl)
         # Always keep a local replica too: tiny swarms (N < K) stay robust.
-        rec = self.storage.setdefault(key, {})
-        rec[subkey] = (value_json, time.monotonic() + ttl)
+        fenced_gen: Optional[int] = None
+        local_blocked: Optional[int] = None
+        for sk, (vj, t) in entries.items():
+            w = self._store_local(key, sk, vj, t, fence=fence, fence_owner=fence_owner)
+            if w is not None:
+                local_blocked = max(local_blocked or 0, w)
+        if local_blocked is not None:
+            # Our own storage already holds a higher watermark: the write
+            # is KNOWN stale — fanning it out would waste K RPCs and seed
+            # laggard replicas with bytes whose rejection is foregone.
+            raise StaleWriteFenced(key, subkey, local_blocked)
         ok = 1
         for nid, addr in closest:
             try:
-                await self.transport.call(addr, "dht.store", payload_args, timeout=5.0)
-                ok += 1
+                try:
+                    ret, _ = await self.transport.call(
+                        addr, "dht.store", payload_args, timeout=5.0
+                    )
+                except RPCError:
+                    if batch is None:
+                        raise
+                    # A storage node one version behind chokes on the
+                    # batched ``values`` shape (its handler KeyErrors on
+                    # args["value"]): it is alive, just old — replay the
+                    # batch as individual legacy frames instead of
+                    # misreading the version skew as death and evicting a
+                    # healthy node from the table every flush.
+                    ret = {"ok": True}
+                    for sk, (vj, t) in entries.items():
+                        r1, _ = await self.transport.call(
+                            addr, "dht.store", _legacy_args(sk, vj, t),
+                            timeout=5.0,
+                        )
+                        if r1.get("fenced"):
+                            ret = r1
+                if ret.get("fenced"):
+                    fenced_gen = max(fenced_gen or 0, int(ret.get("gen", 0)))
+                else:
+                    ok += 1
             except (RPCError, OSError, asyncio.TimeoutError):
                 self.table.remove(nid)
                 # A cached replica died: next store re-walks the keyspace.
                 self._store_routes.pop(target, None)
+        if fenced_gen is not None:
+            # Any higher watermark means a newer generation owns this key
+            # range — the caller must stop writing and re-resolve, even if
+            # some laggard replicas accepted the stale bytes (status
+            # merges break the tie by generation, see control_plane).
+            raise StaleWriteFenced(key, subkey, fenced_gen)
         return ok
 
-    async def store(self, key: str, value: object, subkey: str = "", ttl: float = 60.0) -> int:
+    async def store(
+        self,
+        key: str,
+        value: object,
+        subkey: str = "",
+        ttl: float = 60.0,
+        fence: Optional[int] = None,
+        fence_owner: str = "",
+    ) -> int:
         """Store (replicated to the K closest nodes incl. possibly self).
         Owned records are republished to the current closest set until their
-        TTL expires (see _maintenance_loop)."""
+        TTL expires (see _maintenance_loop). ``fence`` attaches a generation
+        watermark: storage nodes refuse stores whose generation is below the
+        highest they have seen for the (key, subkey) — StaleWriteFenced —
+        the control plane's stale-replica-write rejection. ``fence_owner``
+        (the writer's id) arbitrates EQUAL generations: smallest id wins,
+        so two writers whose split views claimed the same generation
+        resolve deterministically instead of flip-flopping the record."""
         self._sweep_storage()
         value_json = json.dumps(value)
-        self._owned[(key, subkey)] = (value_json, time.monotonic() + ttl)
-        return await self._store_raw(key, subkey, value_json, ttl)
+        self._owned[(key, subkey)] = (
+            value_json, time.monotonic() + ttl, fence, fence_owner
+        )
+        try:
+            return await self._store_raw(
+                key, subkey, value_json, ttl, fence=fence, fence_owner=fence_owner
+            )
+        except StaleWriteFenced:
+            self._owned.pop((key, subkey), None)
+            raise
+
+    async def store_many(
+        self,
+        key: str,
+        values: Dict[str, object],
+        ttl: float = 60.0,
+        ttls: Optional[Dict[str, float]] = None,
+        fence: Optional[int] = None,
+        fence_owner: str = "",
+    ) -> int:
+        """Batched store: ALL subkeys of ``values`` cross in ONE dht.store
+        RPC per storage replica (the dict-valued-key merge makes this
+        natural). The control plane's heartbeat coalescing: a replica
+        flushes a whole membership shard per interval as one frame instead
+        of one RPC per peer. NOT registered as owned — callers re-send at
+        their own cadence."""
+        if not values:
+            return 0
+        self._sweep_storage()
+        batch = {
+            sk: (json.dumps(v), float((ttls or {}).get(sk, ttl)))
+            for sk, v in values.items()
+        }
+        return await self._store_raw(
+            key, "", "", 0.0, fence=fence, fence_owner=fence_owner, batch=batch
+        )
 
     async def get(self, key: str) -> Dict[str, object]:
         """All live subkeys of ``key``, merged across replicas."""

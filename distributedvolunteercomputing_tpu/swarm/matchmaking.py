@@ -382,10 +382,20 @@ class Matchmaker:
         exclude: Optional[Callable[[str], bool]] = None,
         lead_exclude: Optional[Callable[[str], bool]] = None,
         lead_weight: Optional[Callable[[str], Optional[float]]] = None,
+        rendezvous_get=None,
     ):
         self.transport = transport
         self.dht = dht
         self.peer_id = peer_id
+        # Replicated-control-plane rendezvous reader (an async callable:
+        # key -> records dict, or None on failure): form_group's poll loop
+        # reads the round key through a replica's micro-cache — N members
+        # polling one forming round cost the swarm ~one iterative DHT
+        # lookup per cache window instead of one per member per poll. Any
+        # failure (replica churn, no control plane) falls back to the
+        # direct DHT walk, so matchmaking never depends on a coordinator
+        # being alive. Writes stay direct DHT stores either way.
+        self.rendezvous_get = rendezvous_get
         # ``clock`` is the consensus wall clock round deadlines are stamped
         # on (the volunteer passes ClockSync.now). ``exclude`` is the
         # straggler pre-exclusion predicate (resilience policy / phi
@@ -490,6 +500,19 @@ class Matchmaker:
         self._parked_joins[round_key] = (ts, joiners)
         return {"ok": True}, b""
 
+    async def _read_rendezvous(self, round_key: str) -> Dict[str, object]:
+        """One poll of the rendezvous key: via the control plane's cached
+        read when wired (and answering), else the direct DHT lookup."""
+        if self.rendezvous_get is not None:
+            try:
+                rec = await self.rendezvous_get(round_key)
+            except Exception as e:  # noqa: BLE001 — reader is an accelerator
+                log.debug("rendezvous reader failed: %s", errstr(e))
+                rec = None
+            if rec is not None:
+                return rec
+        return await self.dht.get(round_key)
+
     @staticmethod
     def _epoch(round_key: str, member_ids: List[str], nonce: str) -> str:
         return hashlib.sha1(
@@ -535,7 +558,7 @@ class Matchmaker:
             while time.monotonic() < deadline:
                 if fut.done():  # someone elected themselves leader already
                     return self._group_from_begin(fut.result(), round_key)
-                rec = await self.dht.get(round_key)
+                rec = await self._read_rendezvous(round_key)
                 current = sorted(
                     (pid, tuple(info["addr"])) for pid, info in rec.items() if info is not None
                 )

@@ -42,12 +42,40 @@ class SwarmMembership:
         extra_info: Optional[dict] = None,
         failure_detector=None,
         bandwidth_source=None,
+        control_plane=None,
+        report_source=None,
     ):
         self.dht = dht
         self.peer_id = peer_id
         self.ttl = ttl
         self.extra_info = extra_info or {}
         self.failure_detector = failure_detector
+        # Replicated-control-plane client (swarm/control_plane.py): when
+        # attached AND a live replica set is discovered, each heartbeat
+        # interval coalesces announce + metrics report + peers-snapshot
+        # refresh into ONE cp.exchange RPC to this peer's shard-owner
+        # replica (vs a K-replica DHT store fan-out plus an iterative
+        # lookup). Pure accelerator: any failure falls back to the direct
+        # DHT path the same beat, so the record never gaps.
+        self.control_plane = control_plane
+        # Callable returning this volunteer's metrics report (the old
+        # coord.report payload) to piggyback on batched beats; None = the
+        # beat carries membership only.
+        self.report_source = report_source
+        # Message accounting per beat (transport RPC deltas — the honest
+        # counter): proves the batching claim in stats().
+        self.beats = 0
+        self.batched_beats = 0
+        self.direct_beats = 0
+        # Whether the MOST RECENT beat went through a replica: consumers
+        # deciding "is my report already riding the exchange" must read
+        # this, not the cumulative counter — a volunteer that can see
+        # replica records but cannot dial the replicas falls back to
+        # direct beats (which carry no report) for the rest of its life,
+        # and its metrics must flow through the legacy path again.
+        self.last_beat_batched = False
+        self.msgs_last_beat = 0
+        self._msgs_ewma: Optional[float] = None
         # Callable returning this node's measured-bandwidth advertisement
         # fields (Transport.bandwidth_advertisement: {"bw_up": bps,
         # "bw_down": bps}, {} when nothing fresh) — re-evaluated on EVERY
@@ -96,21 +124,136 @@ class SwarmMembership:
         return rec
 
     async def join(self) -> None:
-        """Announce and start heartbeating."""
+        """Announce and start heartbeating. The direct DHT store runs
+        unconditionally (a join must be durable even if every control-plane
+        replica is mid-churn); with a control plane attached, a best-effort
+        join exchange additionally registers us with our shard owner and
+        seeds the first peers snapshot in the same round trip."""
         self._left = False
         await self.dht.store(PEERS_KEY, self._record(), subkey=self.peer_id, ttl=self.ttl)
+        cp = self.control_plane
+        if cp is not None:
+            try:
+                await cp.refresh()
+                if cp.has_replicas:
+                    ret = await cp.exchange(
+                        self._record(), ttl=self.ttl, join=True,
+                        report=self._build_report(),
+                    )
+                    if ret is not None:
+                        self._adopt_records(dict(ret.get("peers") or {}))
+            except Exception as e:  # noqa: BLE001 — join exchange is best-effort
+                log.debug("join exchange failed: %s", errstr(e))
         if self._heartbeat_task is None:
             self._heartbeat_task = asyncio.create_task(self._heartbeat_loop())
         log.info("peer %s joined swarm", self.peer_id)
 
     async def leave(self) -> None:
-        """Graceful leave: tombstone the record (preemption path calls this)."""
+        """Graceful leave: tombstone the record (preemption path calls this).
+        With a control plane, the tombstone also rides one exchange so the
+        shard owner's served snapshots drop us immediately instead of after
+        our last batched record expires."""
         self._left = True
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
         await self.dht.store(PEERS_KEY, None, subkey=self.peer_id, ttl=self.ttl)
+        cp = self.control_plane
+        if cp is not None and cp.has_replicas:
+            try:
+                await cp.exchange(None, ttl=self.ttl)
+            except Exception:
+                pass
         log.info("peer %s left swarm", self.peer_id)
+
+    def _build_report(self) -> Optional[dict]:
+        if self.report_source is None:
+            return None
+        try:
+            return self.report_source()
+        except Exception as e:  # noqa: BLE001 — a gauge bug must not kill beats
+            log.debug("report source failed: %s", errstr(e))
+            return None
+
+    async def _beat_once(self) -> None:
+        """One heartbeat interval's control traffic. Batched path first
+        (one coalesced cp.exchange carrying announce + report, returning
+        the peers snapshot + replica set); ANY failure — no replicas
+        known, all reachable replicas dead, an RPC error — falls back to
+        the direct DHT announce the same beat, so a control-plane outage
+        can neither expire our record nor stall this loop (the client's
+        calls are fast-fail with bounded AIMD backoff per replica)."""
+        transport = self.dht.transport
+        rpcs0 = transport.rpcs_sent
+        batched = False
+        cp = self.control_plane
+        if cp is not None and not self._left:
+            try:
+                if not cp.has_replicas:
+                    # Discovery (TTL'd): one DHT read, only while we know
+                    # of no live replica — steady-state batched beats learn
+                    # the set from exchange replies for free.
+                    await cp.refresh()
+                if cp.has_replicas:
+                    ret = await cp.exchange(
+                        self._record(), ttl=self.ttl,
+                        report=self._build_report(),
+                    )
+                    if ret is None:
+                        # Every replica this client knew refused/died. Its
+                        # view can be corpse-heavy under replica churn
+                        # (reply-confirmed sets lag fresh spawns by one
+                        # serving-replica tick): re-discover from the DHT
+                        # — the authoritative live set, fresh replicas
+                        # announce there first — and retry ONCE within the
+                        # same beat, so a kill-plus-replace costs zero
+                        # batched beats instead of one.
+                        await cp.refresh(force=True)
+                        if cp.has_replicas:
+                            ret = await cp.exchange(
+                                self._record(), ttl=self.ttl,
+                                report=self._build_report(),
+                            )
+                    if ret is not None:
+                        self._adopt_records(dict(ret.get("peers") or {}))
+                        batched = True
+            except Exception as e:  # noqa: BLE001 — exchange is an accelerator
+                log.debug("batched beat failed: %s", errstr(e))
+        if not batched:
+            await self.dht.store(
+                PEERS_KEY, self._record(), subkey=self.peer_id, ttl=self.ttl
+            )
+            if self.failure_detector is not None or self.keep_snapshot_fresh:
+                # Piggyback one observation pass per own beat: the
+                # detector keeps accruing even when nothing else on
+                # this node happens to call alive_peers (an idle
+                # trainer between wall-clock cadence boundaries),
+                # and the snapshot stays one-beat fresh for
+                # max_age readers.
+                await self.alive_peers()
+        self.beats += 1
+        self.last_beat_batched = batched
+        if batched:
+            self.batched_beats += 1
+            # Exact: the client's own attempt count for THIS exchange (1 +
+            # failover tries). A transport-global counter delta would bill
+            # whatever averaging-round RPCs happened to be in flight across
+            # the exchange's await to the beat.
+            self.msgs_last_beat = max(cp.last_call_attempts, 1)
+        else:
+            self.direct_beats += 1
+            # Transport delta: the direct path's store fan-out + snapshot
+            # lookup all issue from this coroutine, so the delta is the
+            # beat's own traffic up to concurrent-round noise (an upper
+            # bound; exactness matters for the batched number above, which
+            # is the one the batching claim rides on).
+            self.msgs_last_beat = transport.rpcs_sent - rpcs0
+        a = 0.2
+        self._msgs_ewma = (
+            float(self.msgs_last_beat)
+            if self._msgs_ewma is None
+            else (1 - a) * self._msgs_ewma + a * self.msgs_last_beat
+        )
 
     async def _heartbeat_loop(self) -> None:
         # Re-announce at TTL/3: two missed beats still leave the record live.
@@ -118,21 +261,31 @@ class SwarmMembership:
             while not self._left:
                 await asyncio.sleep(self.ttl / 3.0)
                 try:
-                    await self.dht.store(
-                        PEERS_KEY, self._record(), subkey=self.peer_id, ttl=self.ttl
-                    )
-                    if self.failure_detector is not None or self.keep_snapshot_fresh:
-                        # Piggyback one observation pass per own beat: the
-                        # detector keeps accruing even when nothing else on
-                        # this node happens to call alive_peers (an idle
-                        # trainer between wall-clock cadence boundaries),
-                        # and the snapshot stays one-beat fresh for
-                        # max_age readers.
-                        await self.alive_peers()
+                    await self._beat_once()
                 except Exception as e:
                     log.warning("heartbeat store failed: %s", errstr(e))
         except asyncio.CancelledError:
             pass
+
+    def stats(self) -> dict:
+        """Control-traffic accounting: RPC messages this node spent per
+        heartbeat interval (transport-counter deltas, so DHT store fan-out
+        and lookups are all counted) — the number the batched control
+        plane exists to shrink (one coalesced exchange vs ~K store RPCs +
+        a lookup per beat)."""
+        out = {
+            "mode": "batched" if self.batched_beats > self.direct_beats else "direct",
+            "beats": self.beats,
+            "batched_beats": self.batched_beats,
+            "direct_beats": self.direct_beats,
+            "msgs_last_beat": self.msgs_last_beat,
+            "msgs_per_interval_ewma": (
+                round(self._msgs_ewma, 2) if self._msgs_ewma is not None else None
+            ),
+        }
+        if self.control_plane is not None:
+            out["client"] = self.control_plane.stats()
+        return out
 
     def _observe_beats(self, records: Dict[str, dict]) -> None:
         """Feed the phi-accrual detector: a peer whose announce timestamp
@@ -196,6 +349,23 @@ class SwarmMembership:
                 out.pop(self.peer_id, None)
             return out
         rec = await self.dht.get(PEERS_KEY)
+        out = self._adopt_records(rec)
+        if self.failure_detector is not None:
+            if exclude_suspected:
+                out = {
+                    pid: info
+                    for pid, info in out.items()
+                    if pid == self.peer_id or not self.failure_detector.suspect(pid)
+                }
+        if not include_self:
+            out.pop(self.peer_id, None)
+        return out
+
+    def _adopt_records(self, rec: Dict[str, Optional[dict]]) -> Dict[str, dict]:
+        """Adopt one live view of the peers key (a DHT read, or a batched
+        exchange reply's snapshot): filter tombstones, refresh the cached
+        snapshot, feed the failure detector, and forget departed peers so
+        they stop accruing suspicion. Returns the live records."""
         out = {pid: info for pid, info in rec.items() if info is not None}
         self._snapshot = dict(out)
         self._snapshot_t = time.monotonic()
@@ -206,14 +376,6 @@ class SwarmMembership:
             for pid in [p for p in self._seen_beats if p not in out]:
                 self._seen_beats.pop(pid, None)
                 self.failure_detector.forget(pid)
-            if exclude_suspected:
-                out = {
-                    pid: info
-                    for pid, info in out.items()
-                    if pid == self.peer_id or not self.failure_detector.suspect(pid)
-                }
-        if not include_self:
-            out.pop(self.peer_id, None)
         return out
 
     def peer_record(self, peer_id: str) -> Optional[dict]:

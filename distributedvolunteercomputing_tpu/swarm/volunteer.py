@@ -185,6 +185,13 @@ class VolunteerConfig:
     fsdp: bool = False
     seq_sharded: bool = False
     sp_impl: str = "ring"  # ring | ulysses (all-to-all seq<->heads)
+    # Host a control-plane replica on this volunteer (swarm/control_plane.py):
+    # the process serves coord.status / batched cp.exchange heartbeat
+    # traffic and becomes an election candidate for the replicated,
+    # key-range-sharded control plane — with a few of these in the swarm,
+    # coordinator death is a non-event (volunteers fail their control
+    # traffic over to a surviving replica within one heartbeat).
+    host_replica: bool = False
     # Shared-secret frame authentication (transport-level HMAC): path to a
     # file holding the swarm secret. Every member (coordinator included)
     # must use the same secret; peers without it can't join, spoof
@@ -417,6 +424,8 @@ class Volunteer:
         )
         self.dht = DHTNode(self.transport)
         self.membership: Optional[SwarmMembership] = None
+        self.control_plane = None  # ControlPlaneClient (failover routing)
+        self.replica = None        # ControlPlaneReplica when host_replica
         self.clocksync = None
         self.failure_detector = None
         self.resilience_policy = None
@@ -479,6 +488,26 @@ class Volunteer:
         await self.transport.start()
         bootstrap = _parse_addrs(self.cfg.coordinator) or None
         await self.dht.start(bootstrap=bootstrap)
+        from distributedvolunteercomputing_tpu.swarm.control_plane import (
+            ControlPlaneClient,
+            ControlPlaneReplica,
+        )
+
+        # Control-plane failover client: discovers the elected replica set
+        # from DHT soft state and routes this volunteer's batched
+        # heartbeat/report traffic to its key-range shard owner, failing
+        # over on conn failure (fast-fail + bounded AIMD backoff). Always
+        # constructed — it costs nothing until a replica answers, and the
+        # direct DHT path remains the fallback every beat.
+        self.control_plane = ControlPlaneClient(
+            self.transport, self.dht, self.cfg.peer_id
+        )
+        if self.cfg.host_replica:
+            # This volunteer is an election candidate for the replicated
+            # control plane: it serves status/exchange traffic and owns a
+            # key range when elected into the active set.
+            self.replica = ControlPlaneReplica(self.transport, self.dht)
+            await self.replica.start()
         if self.cfg.resilience:
             # Resilience layer: phi-accrual liveness fed by membership
             # heartbeats, and the adaptive policy (learned round deadlines,
@@ -530,6 +559,11 @@ class Volunteer:
             # estimates age out to absent fields): the input to
             # bandwidth-weighted leader election.
             bandwidth_source=self.transport.bandwidth_advertisement,
+            # Batched control plane: announce + metrics report + peers
+            # snapshot coalesce into one cp.exchange per heartbeat interval
+            # while any replica is reachable (direct DHT fallback per beat).
+            control_plane=self.control_plane,
+            report_source=self._build_report,
         )
         await self.membership.join()
         if self.cfg.average_interval_s > 0:
@@ -566,6 +600,10 @@ class Volunteer:
                 round_deadline_s=self.cfg.round_deadline_s or None,
                 resilience=self.resilience_policy,
                 failure_detector=self.failure_detector,
+                # Matchmaking rendezvous reads ride the replicated control
+                # plane's micro-cache when a replica answers (direct DHT
+                # fallback otherwise).
+                control_plane=self.control_plane,
             )
             if self.cfg.group_size:
                 from distributedvolunteercomputing_tpu.swarm.matchmaking import (
@@ -793,6 +831,56 @@ class Volunteer:
             self.cfg.peer_id, *self.transport.addr, self.cfg.model, self.cfg.averaging,
         )
 
+    def _build_report(self) -> dict:
+        """This volunteer's metrics report (the coord.report payload).
+        Piggybacked on every batched control-plane exchange by the
+        membership heartbeat loop, and sent standalone by the legacy
+        report loop while no replica is reachable. May raise when the
+        trainer's buffers are donated mid-step — callers skip that report
+        rather than die."""
+        report = {
+            "peer": self.cfg.peer_id,
+            "step": int(self.trainer.state.step) if self.trainer else 0,
+            "samples_per_sec": self.trainer.metrics.samples_per_sec()
+            if self.trainer
+            else 0.0,
+            **{k: v for k, v in self.summary.items()},
+        }
+        if self.averager is not None and self.averager._agg_gauges:
+            # Live leader-aggregation pipeline gauges (peak bytes
+            # held, early/deadline tiles, busy fraction) — reported
+            # mid-run so coord.status sees them before the final
+            # summary lands.
+            report["aggregation"] = dict(self.averager._agg_gauges)
+        if self.averager is not None:
+            # On-mesh data-path backend + degrade evidence: a slice
+            # failure mid-run shows up in coord.status as
+            # backend=host/configured=mesh while training continues.
+            report["mesh_codec"] = self.averager.mesh_codec.stats()
+        if (
+            self.averager is not None
+            and getattr(self.averager, "group_schedule", None) is not None
+        ):
+            # Multi-group schedule gauges (current rotation/group,
+            # per-group round counters): coord.status rolls these
+            # up per group swarm-wide instead of silently averaging
+            # across groups.
+            report["groups"] = self.averager.group_stats()
+        failover_stats = getattr(self.averager, "failover_stats", None)
+        if failover_stats is not None:
+            fo = failover_stats()
+            if (
+                fo["leaders_deposed"]
+                or fo["rounds_recovered"]
+                or fo["recoveries_failed"]
+            ):
+                # Leader-failover gauges (depositions, recovered
+                # rounds, recovery latency): reported mid-run —
+                # recovery is exactly the event an operator wants
+                # to see from coord.status while it happens.
+                report["failover"] = fo
+        return report
+
     async def _report_loop(self) -> None:
         caddrs = _parse_addrs(self.cfg.coordinator)
         caddr = caddrs[0] if caddrs else None
@@ -807,53 +895,28 @@ class Volunteer:
                     pass
             if caddr is None:
                 continue
+            if self.membership is not None and self.membership.last_beat_batched:
+                # The LAST heartbeat went through a replica carrying our
+                # report — a standalone coord.report here would double the
+                # message cost back up. Gated on the last beat, not the
+                # lifetime counter: a volunteer that loses the batched path
+                # (asymmetric reachability, replica churn) must resume
+                # legacy reports or its metrics age out of coord.status.
+                continue
             try:
                 # Built INSIDE the try: reading trainer.state from this
                 # thread can hit a donated (deleted) buffer mid-step on a
                 # real accelerator — that must skip one report, not kill
                 # the loop (which also carries the announce() refresh).
-                report = {
-                    "peer": self.cfg.peer_id,
-                    "step": int(self.trainer.state.step) if self.trainer else 0,
-                    "samples_per_sec": self.trainer.metrics.samples_per_sec()
-                    if self.trainer
-                    else 0.0,
-                    **{k: v for k, v in self.summary.items()},
-                }
-                if self.averager is not None and self.averager._agg_gauges:
-                    # Live leader-aggregation pipeline gauges (peak bytes
-                    # held, early/deadline tiles, busy fraction) — reported
-                    # mid-run so coord.status sees them before the final
-                    # summary lands.
-                    report["aggregation"] = dict(self.averager._agg_gauges)
-                if self.averager is not None:
-                    # On-mesh data-path backend + degrade evidence: a slice
-                    # failure mid-run shows up in coord.status as
-                    # backend=host/configured=mesh while training continues.
-                    report["mesh_codec"] = self.averager.mesh_codec.stats()
-                if (
-                    self.averager is not None
-                    and getattr(self.averager, "group_schedule", None) is not None
-                ):
-                    # Multi-group schedule gauges (current rotation/group,
-                    # per-group round counters): coord.status rolls these
-                    # up per group swarm-wide instead of silently averaging
-                    # across groups.
-                    report["groups"] = self.averager.group_stats()
-                failover_stats = getattr(self.averager, "failover_stats", None)
-                if failover_stats is not None:
-                    fo = failover_stats()
-                    if (
-                        fo["leaders_deposed"]
-                        or fo["rounds_recovered"]
-                        or fo["recoveries_failed"]
-                    ):
-                        # Leader-failover gauges (depositions, recovered
-                        # rounds, recovery latency): reported mid-run —
-                        # recovery is exactly the event an operator wants
-                        # to see from coord.status while it happens.
-                        report["failover"] = fo
-                await self.transport.call(caddr, "coord.report", report, timeout=5.0)
+                report = self._build_report()
+                # Fast-fail dial: a dead coordinator costs the connect
+                # budget, never the generic call timeout (the heartbeat
+                # loop has its own AIMD-backed fast path; this legacy loop
+                # must not lag behind it).
+                await self.transport.call(
+                    caddr, "coord.report", report, timeout=5.0,
+                    connect_timeout=1.5,
+                )
             except Exception:
                 # Coordinator reachability is not correctness-critical; with
                 # several bootstrap coordinators, rotate to the next one so
@@ -924,6 +987,14 @@ class Volunteer:
                 await self.membership.leave()
             except Exception:
                 pass
+            if self.replica is not None:
+                try:
+                    # Graceful exit of a replica-hosting volunteer: the
+                    # retiring tombstone makes the rest of the swarm
+                    # re-resolve the active set immediately.
+                    await self.replica.retire(grace=0.0)
+                except Exception:
+                    pass
             await self.dht.stop()
             if getattr(self, "_loop_monitor", None) is not None:
                 await self._loop_monitor.stop()

@@ -95,6 +95,10 @@ from distributedvolunteercomputing_tpu.swarm.transport import (  # noqa: E402
 
 STRAGGLER = "v3"  # sorts last: v0 always leads
 
+from distributedvolunteercomputing_tpu.swarm.control_plane import (  # noqa: E402
+    ControlPlaneClient,
+    ControlPlaneReplica,
+)
 from distributedvolunteercomputing_tpu.swarm.matchmaking import GroupSchedule  # noqa: E402
 
 
@@ -800,6 +804,254 @@ async def multigroup_campaign(args):
     return out
 
 
+# -- control-plane campaign (ISSUE 9 acceptance) ----------------------------
+
+
+async def _spawn_replica(rid, boot, interval=0.5):
+    t = Transport()
+    d = DHTNode(t)
+    await d.start(bootstrap=[boot] if boot else None)
+    rep = ControlPlaneReplica(t, d, rid=rid, interval=interval)
+    await rep.start()
+    return {"rid": rid, "t": t, "dht": d, "rep": rep}
+
+
+async def _kill_replica(r):
+    """SIGKILL at the protocol level: no retire, no tombstone — the socket
+    just goes away mid-service."""
+    try:
+        await r["rep"].stop()
+    except Exception:
+        pass
+    try:
+        await r["dht"].stop()
+    except Exception:
+        pass
+    await r["t"].close()
+
+
+async def _make_cp_vol(pid, boot, rot_cell, target, gather_timeout):
+    """A multigroup volunteer wired to the replicated control plane:
+    batched heartbeats through its shard-owner replica, report gauges
+    riding each beat, rendezvous reads through the replica cache."""
+    v = await _make_mg_node(pid, boot, rot_cell, target, gather_timeout)
+    cp = ControlPlaneClient(v["t"], v["dht"], pid)
+    v["mem"].control_plane = cp
+
+    def report(v=v, pid=pid):
+        return {
+            "peer": pid, "step": 0, "samples_per_sec": 1.0,
+            "groups": v["avg"].group_stats(),
+        }
+
+    v["mem"].report_source = report
+    v["avg"].control_plane = cp
+    v["avg"].matchmaker.rendezvous_get = cp.rendezvous_get
+    v["cp"] = cp
+    await cp.refresh(force=True)
+    return v
+
+
+async def controlplane_campaign(args):
+    """Control-plane arm (``--controlplane``): 8 volunteers on a rotating
+    group schedule, batched-heartbeating through 3 elected coordinator
+    replicas. Each kill round, the ACTIVE replica (election rank 0 — the
+    one owning the first key range and serving the most traffic) is
+    SIGKILLed while that rotation's averaging rounds are IN FLIGHT. The
+    acceptance bar: every rotation's groups keep matching and committing
+    (zero missed rotations), every volunteer's next heartbeat stays
+    batched (failover, not direct-DHT regression), and a COMPLETE
+    coord.status (all 8 alive + multigroup rollup) is served by a
+    surviving replica within one heartbeat interval of the kill.
+    Artifact: experiments/results/chaos_controlplane.json."""
+    gather_timeout = 8.0
+    target = 3
+    heartbeat_ttl = 10.0  # _make_mg_node's membership ttl
+    hb_interval = heartbeat_ttl / 3.0
+    rot_cell = {"rot": 0}
+    boot_t = Transport()
+    boot_dht = DHTNode(boot_t)
+    await boot_dht.start(bootstrap=None)
+    reps = []
+    vols = []
+    out = {
+        "seed": args.seed,
+        "kill_rounds": args.controlplane_rounds,
+        "n_volunteers": 8,
+        "n_replicas": 3,
+        "heartbeat_interval_s": hb_interval,
+        "per_round": [],
+    }
+    try:
+        rep0 = ControlPlaneReplica(boot_t, boot_dht, rid="cp-r00", interval=0.5)
+        await rep0.start()
+        reps.append({"rid": "cp-r00", "t": boot_t, "dht": boot_dht, "rep": rep0})
+        for i in (1, 2):
+            reps.append(await _spawn_replica(f"cp-r{i:02d}", boot_t.addr))
+        for i in range(8):
+            vols.append(await _make_cp_vol(
+                f"c{i}", boot_t.addr, rot_cell, target, gather_timeout
+            ))
+        # Beat until every volunteer's snapshot shows the full swarm: the
+        # first beat round registers everyone with its shard owner, but a
+        # snapshot is only complete once each replica's flush has reached
+        # the DHT and the serving replicas' views refreshed (tick-paced
+        # with 3 replicas) — the group schedule needs ALIGNED views
+        # before the first rotation, and fixed beat counts race the ticks.
+        for _ in range(30):
+            for v in vols:
+                await v["mem"]._beat_once()
+            snaps = [
+                await v["mem"].alive_peers(max_age=30.0) for v in vols
+            ]
+            if all(len(s) == len(vols) for s in snaps):
+                break
+            await asyncio.sleep(0.4)
+        else:
+            raise AssertionError("volunteer snapshots never converged")
+        assert all(v["mem"].batched_beats >= 1 for v in vols), (
+            "control-plane campaign requires batched beats from round one"
+        )
+
+        pids = [v["pid"] for v in vols]
+        rot = 1
+        # Healthy warmup rotations: schedule + batched control plane
+        # commit together before any kill.
+        for r in range(2):
+            rot, _ = _find_rot(pids, target, rot, need_big=False)
+            rot_cell["rot"] = rot
+            results = await asyncio.gather(
+                *(_timed_average(v, i, r) for i, v in enumerate(vols))
+            )
+            assert all(
+                res is not None and not isinstance(res, BaseException)
+                for _, res in results
+            ), f"healthy control-plane warmup round {r} failed"
+            for v in vols:
+                await v["mem"]._beat_once()
+            rot += 1
+
+        next_rid = 3
+        for k in range(args.controlplane_rounds):
+            rot, groups = _find_rot(pids, target, rot, need_big=False)
+            rot_cell["rot"] = rot
+            # The ACTIVE replica = election rank 0 among the live set.
+            reps.sort(key=lambda r: r["rid"])
+            victim, survivors = reps[0], reps[1:]
+            beats_before = {v["pid"]: v["mem"].batched_beats for v in vols}
+            # Fire the rotation's rounds, then SIGKILL the active replica
+            # while they are in flight.
+            round_tasks = [
+                asyncio.ensure_future(_timed_average(v, i, 100 + k))
+                for i, v in enumerate(vols)
+            ]
+            await asyncio.sleep(0.15)
+            t_kill = time.monotonic()
+            await _kill_replica(victim)
+            results = await asyncio.gather(*round_tasks)
+            committed = sum(
+                res is not None and not isinstance(res, BaseException)
+                for _, res in results
+            )
+            # Every volunteer's next beat must fail over and STAY batched.
+            for v in vols:
+                await v["mem"]._beat_once()
+            still_batched = sum(
+                v["mem"].batched_beats > beats_before[v["pid"]] for v in vols
+            )
+            # Probe a surviving replica until it serves a COMPLETE status.
+            surv_addr = survivors[0]["t"].addr
+            status = None
+            status_dt = None
+            while time.monotonic() - t_kill < 4 * hb_interval:
+                try:
+                    ret, _ = await vols[0]["t"].call(
+                        surv_addr, "coord.status", {},
+                        timeout=3.0, connect_timeout=1.0,
+                    )
+                    if ret.get("n_alive", 0) >= 8:
+                        status = ret
+                        status_dt = time.monotonic() - t_kill
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+            out["per_round"].append({
+                "round": k,
+                "rot": rot,
+                "n_groups": len(groups),
+                "killed_rid": victim["rid"],
+                "vols_committed": int(committed),
+                "rotation_all_committed": committed == len(vols),
+                "beats_failed_over_batched": int(still_batched),
+                "status_failover_s": (
+                    round(status_dt, 3) if status_dt is not None else None
+                ),
+                "status_alive": status["n_alive"] if status else None,
+                "status_rollup_ok": bool(
+                    status and status.get("multigroup")
+                    and status["multigroup"].get("rounds_ok_total", 0) > 0
+                ),
+                "served_by": (
+                    status["control_plane"]["rid"] if status else None
+                ),
+            })
+            reps.remove(victim)
+            # Replace the corpse (bootstrapped via a volunteer — the dead
+            # replica may have been the original bootstrap node) so the
+            # set stays at 3 for the next kill.
+            reps.append(await _spawn_replica(
+                f"cp-r{next_rid:02d}", vols[0]["t"].addr
+            ))
+            next_rid += 1
+            rot += 1
+
+        recs = out["per_round"]
+        out["verdict_inputs"] = {
+            "rounds": len(recs),
+            "rotations_all_committed": sum(
+                r["rotation_all_committed"] for r in recs
+            ),
+            "beats_all_failed_over": sum(
+                r["beats_failed_over_batched"] == len(vols) for r in recs
+            ),
+            "status_served_rounds": sum(
+                r["status_failover_s"] is not None for r in recs
+            ),
+            "status_within_heartbeat_rounds": sum(
+                r["status_failover_s"] is not None
+                and r["status_failover_s"] <= hb_interval
+                for r in recs
+            ),
+            "max_status_failover_s": max(
+                (r["status_failover_s"] for r in recs
+                 if r["status_failover_s"] is not None),
+                default=None,
+            ),
+            "rollup_ok_rounds": sum(r["status_rollup_ok"] for r in recs),
+        }
+    finally:
+        for v in vols:
+            try:
+                await v["mem"].leave()
+            except Exception:
+                pass
+            try:
+                await v["dht"].stop()
+            except Exception:
+                pass
+            try:
+                await v["t"].close()
+            except Exception:
+                pass
+        for r in reps:
+            try:
+                await _kill_replica(r)
+            except Exception:
+                pass
+    return out
+
+
 # -- training phase (subprocess volunteers, real entrypoints) --------------
 
 
@@ -978,6 +1230,16 @@ def main():
                          "burst mid-campaign")
     ap.add_argument("--multigroup-rounds", type=int, default=6,
                     help="kill rounds in the multigroup arm")
+    ap.add_argument("--controlplane", action="store_true",
+                    help="run the control-plane arm instead: volunteers "
+                         "batch-heartbeating through 3 elected coordinator "
+                         "replicas; the ACTIVE replica is SIGKILLed while "
+                         "each rotation's averaging rounds are in flight "
+                         "(swarm must keep matching/committing with zero "
+                         "missed rotations; coord.status must be served by "
+                         "a survivor within one heartbeat interval)")
+    ap.add_argument("--controlplane-rounds", type=int, default=4,
+                    help="replica-kill rounds in the control-plane arm")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     if args.out is None:
@@ -986,6 +1248,7 @@ def main():
             "chaos_failover.json" if args.failover
             else "chaos_mesh_degrade.json" if args.mesh_degrade
             else "chaos_multigroup.json" if args.multigroup
+            else "chaos_controlplane.json" if args.controlplane
             else "chaos_soak.json",
         )
     if args.quick:
@@ -995,7 +1258,41 @@ def main():
         args.failover_rounds = 5
         args.mesh_degrade_rounds = 4
         args.multigroup_rounds = 3
+        args.controlplane_rounds = 2
         args.no_train = True
+
+    if args.controlplane:
+        result = {
+            "controlplane_campaign": asyncio.run(controlplane_campaign(args))
+        }
+        cp = result["controlplane_campaign"]["verdict_inputs"]
+        result["verdict"] = {
+            # The acceptance bar: a coordinator-replica SIGKILL mid-round
+            # is a NON-EVENT for the data plane...
+            "pass_zero_missed_rotations": (
+                cp["rotations_all_committed"] == cp["rounds"]
+            ),
+            # ...heartbeats fail over (stay batched) instead of regressing
+            # to per-message DHT traffic...
+            "pass_beats_fail_over": (
+                cp["beats_all_failed_over"] == cp["rounds"]
+            ),
+            # ...and a surviving replica serves a complete status (all
+            # volunteers alive + multigroup rollup) within one heartbeat
+            # interval of the kill.
+            "pass_status_within_heartbeat": (
+                cp["status_within_heartbeat_rounds"] == cp["rounds"]
+            ),
+            "pass_rollup_served": cp["rollup_ok_rounds"] == cp["rounds"],
+            "max_status_failover_s": cp["max_status_failover_s"],
+        }
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[done] artifact -> {args.out}")
+        print(json.dumps(result["verdict"], indent=2))
+        ok = all(v for k, v in result["verdict"].items() if k.startswith("pass_"))
+        sys.exit(0 if ok else 1)
 
     if args.multigroup:
         result = {"multigroup_campaign": asyncio.run(multigroup_campaign(args))}

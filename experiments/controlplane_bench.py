@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Control-plane load bench (ISSUE 9 acceptance): joins/sec and
+heartbeats/sec served, per-message vs batched, plus the volunteer-side
+message-count reduction from heartbeat batching.
+
+Three measurements over one in-process mesh (real localhost TCP, real DHT —
+the same stack the swarm tests drive), N=16 volunteers + one coordinator
+replica:
+
+1. **msgs/interval** — RPC messages ONE volunteer spends per heartbeat
+   interval: the direct path (K-replica DHT store fan-out + peers-snapshot
+   lookup) vs the batched path (one coalesced ``cp.exchange``). The
+   acceptance bar is a >= 4x reduction at N=16.
+2. **joins/sec** — sustained join throughput the control plane serves
+   (announce + first snapshot), C concurrent clients: per-message
+   (``dht.store`` + ``dht.get``) vs batched (one join exchange).
+3. **heartbeats/sec** — sustained beat throughput: per-message
+   (``dht.store`` + ``coord.report``) vs batched (one exchange carrying
+   both).
+
+Artifact: experiments/results/controlplane_bench.json (the numbers quoted
+in docs/PERFORMANCE.md). The default-suite smoke twin lives in
+tests/test_control_plane.py (message counts only — deterministic).
+
+Usage:
+    python experiments/controlplane_bench.py            # full bench
+    python experiments/controlplane_bench.py --quick
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from distributedvolunteercomputing_tpu.swarm.control_plane import (  # noqa: E402
+    ControlPlaneClient,
+    ControlPlaneReplica,
+)
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.membership import (  # noqa: E402
+    PEERS_KEY,
+    SwarmMembership,
+)
+from distributedvolunteercomputing_tpu.swarm.transport import Transport  # noqa: E402
+
+N_VOLUNTEERS = 16
+
+
+async def _mesh(n):
+    nodes = []
+    boot = None
+    for _ in range(n):
+        t = Transport()
+        d = DHTNode(t, maintenance_interval=0.0)
+        await d.start(bootstrap=[boot] if boot else None)
+        if boot is None:
+            boot = t.addr
+        nodes.append((t, d))
+    return nodes
+
+
+async def _teardown(nodes):
+    for t, d in nodes:
+        try:
+            await d.stop()
+        except Exception:
+            pass
+        try:
+            await t.close()
+        except Exception:
+            pass
+
+
+def _report_for(pid):
+    return {"peer": pid, "step": 3, "samples_per_sec": 10.0}
+
+
+async def bench_msgs_per_interval(nodes, rep):
+    """One volunteer's RPC spend per heartbeat interval, both modes, all
+    N=16 volunteers measured (the batching headline number)."""
+    members = []
+    for i, (t, d) in enumerate(nodes[1:]):
+        m = SwarmMembership(d, f"vol-{i:02d}", ttl=60.0,
+                            report_source=lambda pid=f"vol-{i:02d}": _report_for(pid))
+        m.keep_snapshot_fresh = True
+        await m.join()
+        members.append(m)
+    direct = []
+    for m in members:
+        await m._beat_once()
+        direct.append(m.msgs_last_beat)
+    for m in members:
+        cp = ControlPlaneClient(m.dht.transport, m.dht, m.peer_id)
+        await cp.refresh(force=True)
+        m.control_plane = cp
+    # One warm round so every peer is registered, then the measured round.
+    for m in members:
+        await m._beat_once()
+    batched = []
+    for m in members:
+        await m._beat_once()
+        batched.append(m.msgs_last_beat)
+    return {
+        "n_volunteers": len(members),
+        "permsg_msgs_per_interval_mean": round(sum(direct) / len(direct), 2),
+        "batched_msgs_per_interval_mean": round(sum(batched) / len(batched), 2),
+        "permsg_msgs_total": sum(direct),
+        "batched_msgs_total": sum(batched),
+        "reduction_x": round(sum(direct) / max(sum(batched), 1), 2),
+    }
+
+
+async def _throughput(op, n_ops, concurrency):
+    """Run ``op(i)`` n_ops times across ``concurrency`` workers; ops/sec."""
+    idx = {"i": 0}
+
+    async def worker():
+        done = 0
+        while True:
+            i = idx["i"]
+            if i >= n_ops:
+                return done
+            idx["i"] = i + 1
+            await op(i)
+            done += 1
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(worker() for _ in range(concurrency)))
+    dt = time.monotonic() - t0
+    return n_ops / dt, dt
+
+
+async def bench_joins(nodes, rep, n_ops, concurrency):
+    """Join = announce + first peers snapshot. Per-message: a DHT store
+    fan-out plus an iterative lookup. Batched: one join exchange (the
+    reply carries the snapshot)."""
+    t, d = nodes[1]
+    rep_addr = rep.transport.addr
+
+    async def join_permsg(i):
+        pid = f"jp-{i:05d}"
+        await d.store(PEERS_KEY, {"addr": list(t.addr), "t": float(i)},
+                      subkey=pid, ttl=30.0)
+        await d.get(PEERS_KEY)
+
+    async def join_batched(i):
+        pid = f"jb-{i:05d}"
+        await t.call(rep_addr, "cp.exchange", {
+            "peer": pid, "record": {"addr": list(t.addr), "t": float(i)},
+            "ttl": 30.0, "join": True, "report": _report_for(pid),
+        }, timeout=10.0)
+
+    permsg, dt_p = await _throughput(join_permsg, n_ops, concurrency)
+    batched, dt_b = await _throughput(join_batched, n_ops, concurrency)
+    return {
+        "ops": n_ops, "concurrency": concurrency,
+        "permsg_joins_per_sec": round(permsg, 1),
+        "batched_joins_per_sec": round(batched, 1),
+        "speedup_x": round(batched / permsg, 2),
+    }
+
+
+async def bench_heartbeats(nodes, rep, n_ops, concurrency):
+    """Steady-state beat = announce refresh + metrics report. Per-message:
+    DHT store fan-out + a standalone coord.report RPC. Batched: one
+    exchange carrying both."""
+    t, d = nodes[1]
+    rep_addr = rep.transport.addr
+    pids = [f"hb-{i:03d}" for i in range(concurrency)]
+
+    async def beat_permsg(i):
+        pid = pids[i % concurrency]
+        await d.store(PEERS_KEY, {"addr": list(t.addr), "t": float(i)},
+                      subkey=pid, ttl=30.0)
+        await t.call(rep_addr, "coord.report", _report_for(pid), timeout=10.0)
+
+    async def beat_batched(i):
+        pid = pids[i % concurrency]
+        await t.call(rep_addr, "cp.exchange", {
+            "peer": pid, "record": {"addr": list(t.addr), "t": float(i)},
+            "ttl": 30.0, "report": _report_for(pid),
+        }, timeout=10.0)
+
+    permsg, _ = await _throughput(beat_permsg, n_ops, concurrency)
+    batched, _ = await _throughput(beat_batched, n_ops, concurrency)
+    return {
+        "ops": n_ops, "concurrency": concurrency,
+        "permsg_heartbeats_per_sec": round(permsg, 1),
+        "batched_heartbeats_per_sec": round(batched, 1),
+        "speedup_x": round(batched / permsg, 2),
+    }
+
+
+async def run_bench(args):
+    nodes = await _mesh(N_VOLUNTEERS + 1)
+    boot_t, boot_d = nodes[0]
+    # Long interval: the bench measures the SERVING paths, not tick noise.
+    rep = ControlPlaneReplica(boot_t, boot_d, rid="bench-r0", interval=30.0)
+    await rep.start()
+    try:
+        out = {"n_volunteers": N_VOLUNTEERS}
+        out["msgs_per_interval"] = await bench_msgs_per_interval(nodes, rep)
+        out["joins"] = await bench_joins(
+            nodes, rep, args.join_ops, args.concurrency
+        )
+        out["heartbeats"] = await bench_heartbeats(
+            nodes, rep, args.heartbeat_ops, args.concurrency
+        )
+        out["replica_counters"] = dict(rep.counters)
+        return out
+    finally:
+        await rep.stop()
+        await _teardown(nodes)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--join-ops", type=int, default=400)
+    ap.add_argument("--heartbeat-ops", type=int, default=600)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "experiments", "results", "controlplane_bench.json"
+    ))
+    args = ap.parse_args()
+    if args.quick:
+        args.join_ops, args.heartbeat_ops = 100, 150
+
+    result = asyncio.run(run_bench(args))
+    result["verdict"] = {
+        # The acceptance bar: heartbeat batching cuts a volunteer's
+        # control-plane message count >= 4x at N=16.
+        "pass_batching_4x_msg_reduction": (
+            result["msgs_per_interval"]["reduction_x"] >= 4.0
+        ),
+        # Batched throughput must BEAT the per-message paths outright —
+        # the default-suite smoke fails loudly if this regresses.
+        "pass_batched_joins_faster": result["joins"]["speedup_x"] > 1.0,
+        "pass_batched_heartbeats_faster": (
+            result["heartbeats"]["speedup_x"] > 1.0
+        ),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"[done] artifact -> {args.out}")
+    print(json.dumps(result, indent=2))
+    sys.exit(0 if all(result["verdict"].values()) else 1)
+
+
+if __name__ == "__main__":
+    main()

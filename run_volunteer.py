@@ -170,6 +170,14 @@ def main() -> None:
                     help="sequence-parallel implementation: ring (ppermute "
                          "K/V rotation, any head count) or ulysses "
                          "(all-to-all seq<->heads; needs n_heads %% sp == 0)")
+    ap.add_argument("--host-replica", action="store_true",
+                    help="host a control-plane replica on this volunteer: "
+                         "serve coord.status and batched heartbeat/report "
+                         "traffic and stand for election into the "
+                         "key-range-sharded replica set — with a few of "
+                         "these, coordinator death is a non-event "
+                         "(volunteers fail over to a surviving replica "
+                         "within one heartbeat)")
     ap.add_argument("--secret-file", default=None,
                     help="file holding the shared swarm secret; enables "
                          "HMAC frame authentication (must match the "
@@ -295,6 +303,7 @@ def main() -> None:
         fsdp=args.fsdp,
         seq_sharded=args.seq_sharded,
         sp_impl=args.sp_impl,
+        host_replica=args.host_replica,
         secret_file=args.secret_file,
         data_path=args.data,
         optimizer=args.optimizer,

@@ -68,6 +68,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "controlplane: replicated control-plane tests (replica election + "
+        "key-range shard handoff, fenced stale-write rejection, batched "
+        "heartbeat exchange, failover client + AIMD backoff, retiring "
+        "tombstone, coordinator-kill chaos smoke, batching-vs-per-message "
+        "bench smoke) — in the default lane, and selectable on their own "
+        "with -m controlplane",
+    )
+    config.addinivalue_line(
+        "markers",
         "hierarchy: hierarchical (zone-aware) scheduling tests (two-level "
         "grid, per-level mixing bound, zone-local failover, bandwidth-"
         "weighted leader election, per-pair link model, per-zone rollups, "

@@ -1,0 +1,474 @@
+"""Replicated control plane (ISSUE 9): replica election + key-range
+sharding, batched heartbeat exchange, failover client, retiring tombstone,
+and the batching-vs-per-message smoke.
+
+Everything here runs in-process over real localhost transports (the swarm
+test idiom): abrupt `transport.close()` + `dht.stop()` without leave() is
+protocol-equivalent to kill -9.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm.control_plane import (
+    MAX_REPLICAS,
+    N_SHARDS,
+    ControlPlaneClient,
+    ControlPlaneReplica,
+    active_replicas,
+    owner_index,
+    shard_of,
+)
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.membership import PEERS_KEY, SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+pytestmark = pytest.mark.controlplane
+
+
+def run(coro, timeout=180):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+async def _mesh(n, bootstrap=None, maintenance_interval=0.0):
+    nodes = []
+    boot = bootstrap
+    for _ in range(n):
+        t = Transport()
+        d = DHTNode(t, maintenance_interval=maintenance_interval)
+        await d.start(bootstrap=[boot] if boot else None)
+        if boot is None:
+            boot = t.addr
+        nodes.append((t, d))
+    return nodes
+
+
+async def _teardown(nodes):
+    for t, d in nodes:
+        try:
+            await d.stop()
+        except Exception:
+            pass
+        try:
+            await t.close()
+        except Exception:
+            pass
+
+
+async def _kill(t, d):
+    """kill -9 at the protocol level: no leave, no tombstone."""
+    await d.stop()
+    await t.close()
+
+
+class TestElection:
+    def test_active_set_is_sorted_capped_and_skips_retiring(self):
+        recs = {
+            f"r{i}": {"addr": ["h", 1000 + i], "t": 0.0} for i in range(8)
+        }
+        recs["r2"]["retiring"] = True
+        recs["bad"] = {"t": 0.0}  # no addr: not a candidate
+        active = active_replicas(recs)
+        rids = [rid for rid, _ in active]
+        assert len(rids) == MAX_REPLICAS
+        assert "r2" not in rids and "bad" not in rids
+        assert rids == sorted(rids)
+        assert rids[0] == "r0"
+
+    def test_key_ranges_are_contiguous_and_cover(self):
+        for n_replicas in range(1, MAX_REPLICAS + 1):
+            owners = [owner_index(s, n_replicas) for s in range(N_SHARDS)]
+            # Every shard owned, owners form a non-decreasing sequence
+            # (contiguous key ranges), every replica owns something.
+            assert all(0 <= o < n_replicas for o in owners)
+            assert owners == sorted(owners)
+            assert set(owners) == set(range(n_replicas))
+
+    def test_shard_of_stable_and_in_range(self):
+        for pid in ("vol-a", "vol-b", "x" * 40):
+            s = shard_of(pid)
+            assert 0 <= s < N_SHARDS
+            assert shard_of(pid) == s
+
+
+class TestBatchedExchange:
+    def test_batching_beats_per_message_rpcs_4x_at_n16(self):
+        """THE batching smoke (fails loudly if batching stops beating the
+        per-message path): at N=16, one volunteer's per-interval control
+        traffic must shrink >= 4x — one coalesced cp.exchange vs the
+        direct path's K-replica store fan-out + snapshot lookup."""
+
+        async def scenario():
+            nodes = await _mesh(17)
+            boot_t, boot_d = nodes[0]
+            rep = ControlPlaneReplica(boot_t, boot_d, rid="r0", interval=60.0)
+            await rep.start()
+            members = []
+            try:
+                for i, (t, d) in enumerate(nodes[1:]):
+                    m = SwarmMembership(d, f"vol-{i:02d}", ttl=30.0)
+                    m.keep_snapshot_fresh = True
+                    await m.join()
+                    members.append(m)
+                # Per-message phase: a direct beat = K store RPCs + the
+                # snapshot lookup.
+                direct = []
+                for m in members:
+                    await m._beat_once()
+                    direct.append(m.msgs_last_beat)
+                # Batched phase: same memberships, control plane attached.
+                for m in members:
+                    cp = ControlPlaneClient(m.dht.transport, m.dht, m.peer_id)
+                    await cp.refresh(force=True)
+                    m.control_plane = cp
+                batched = []
+                for m in members:
+                    await m._beat_once()
+                    batched.append(m.msgs_last_beat)
+                assert all(b > 0 for b in batched)
+                d_sum, b_sum = sum(direct), sum(batched)
+                assert d_sum >= 4 * b_sum, (
+                    f"batching stopped beating per-message RPCs: "
+                    f"direct {d_sum} msgs vs batched {b_sum} over "
+                    f"{len(members)} volunteers"
+                )
+                assert all(m.batched_beats == 1 for m in members)
+                # After one full beat round every peer has exchanged
+                # through the replica, so the NEXT round's replies carry
+                # the complete snapshot — alive_peers then needs no DHT
+                # walk at all.
+                for m in members:
+                    await m._beat_once()
+                snap = await members[0].alive_peers(max_age=5.0)
+                assert len(snap) == 16
+                assert rep.counters["exchanges"] == 32
+            finally:
+                await rep.stop()
+                await _teardown(nodes)
+
+        run(scenario())
+
+    def test_exchange_report_reaches_status(self):
+        """A report piggybacked on the batched beat must land in
+        coord.status exactly like a legacy coord.report."""
+
+        async def scenario():
+            nodes = await _mesh(3)
+            boot_t, boot_d = nodes[0]
+            rep = ControlPlaneReplica(boot_t, boot_d, rid="r0", interval=60.0)
+            await rep.start()
+            try:
+                t, d = nodes[1]
+                m = SwarmMembership(
+                    d, "vol-x", ttl=30.0,
+                    report_source=lambda: {
+                        "peer": "vol-x", "step": 7, "samples_per_sec": 123.0,
+                    },
+                )
+                m.control_plane = ControlPlaneClient(t, d, "vol-x")
+                await m.join()
+                await m.control_plane.refresh(force=True)
+                await m._beat_once()
+                assert m.batched_beats == 1
+                status, _ = await rep._rpc_status({}, b"")
+                assert status["swarm_samples_per_sec"] == 123.0
+                assert "vol-x" in status["alive"]
+            finally:
+                await rep.stop()
+                await _teardown(nodes)
+
+        run(scenario())
+
+
+class TestFailover:
+    def test_status_survives_replica_kill_within_one_heartbeat(self):
+        """Acceptance bar: SIGKILL the replica serving a cohort's batched
+        beats; surviving replica serves a COMPLETE coord.status (all peers
+        alive, metrics merged from the replicated rollups) within one
+        heartbeat interval, and the cohort's next beat fails over without
+        losing cadence."""
+        heartbeat_ttl = 15.0
+
+        async def scenario():
+            nodes = await _mesh(8)
+            boot_t, boot_d = nodes[0]
+            repA = ControlPlaneReplica(boot_t, boot_d, rid="a", interval=0.4)
+            await repA.start()
+            tB, dB = nodes[1]
+            repB = ControlPlaneReplica(tB, dB, rid="b", interval=0.4)
+            await repB.start()
+            members = []
+            try:
+                for i, (t, d) in enumerate(nodes[2:]):
+                    pid = f"vol-{i}"
+                    m = SwarmMembership(
+                        d, pid, ttl=heartbeat_ttl,
+                        report_source=(
+                            lambda pid=pid: {
+                                "peer": pid, "step": 5, "samples_per_sec": 10.0,
+                            }
+                        ),
+                    )
+                    m.control_plane = ControlPlaneClient(t, d, pid)
+                    await m.join()
+                    await m.control_plane.refresh(force=True)
+                    await m._beat_once()
+                    assert m.batched_beats == 1
+                    members.append(m)
+                # Both replicas saw traffic (key-range routing splits the
+                # cohort), and a tick flushed rollups to the DHT.
+                await asyncio.sleep(0.9)
+                assert repA.counters["exchanges"] + repB.counters["exchanges"] >= 6
+
+                # kill -9 the first replica.
+                t_kill = time.monotonic()
+                await repA.stop()
+                await _kill(boot_t, boot_d)
+
+                # Every volunteer's next beat must stay batched (failover
+                # to B on conn failure), not fall back to direct stores.
+                for m in members:
+                    await m._beat_once()
+                    assert m.batched_beats == 2, m.stats()
+
+                status, _ = await repB._rpc_status({}, b"")
+                elapsed = time.monotonic() - t_kill
+                assert elapsed <= heartbeat_ttl / 3.0, (
+                    f"status took {elapsed:.1f}s, over one heartbeat interval"
+                )
+                assert status["n_alive"] == 6, sorted(status["alive"])
+                assert status["swarm_samples_per_sec"] == pytest.approx(60.0)
+                assert status["control_plane"]["rid"] == "b"
+            finally:
+                await repB.stop()
+                await _teardown(nodes[2:] + [nodes[1]])
+
+        run(scenario())
+
+    def test_heartbeat_cadence_holds_through_dead_coordinator(self):
+        """Satellite regression: with every known replica unreachable, each
+        beat must (a) stay FAST — fail-fast dial, never the generic call
+        timeout — (b) fall back to the direct DHT announce so the record
+        stays alive, and (c) put the corpse on AIMD backoff so later beats
+        stop dialing it entirely."""
+
+        async def scenario():
+            nodes = await _mesh(4)
+            # A dead replica address: bind a port, then close it.
+            probe = Transport()
+            dead_addr = await probe.start()
+            await probe.close()
+            t, d = nodes[1]
+            m = SwarmMembership(d, "vol-hb", ttl=2.4)
+            cp = ControlPlaneClient(t, d, "vol-hb")
+            cp.update_replicas({"corpse": {"addr": list(dead_addr), "t": 0.0}})
+            m.control_plane = cp
+            try:
+                durations = []
+                for _ in range(4):
+                    t0 = time.monotonic()
+                    await m._beat_once()
+                    durations.append(time.monotonic() - t0)
+                # Cadence holds: every beat completes well inside the
+                # ttl/3 = 0.8s interval (fast-fail dial + direct store).
+                assert max(durations) < 2.0, durations
+                assert m.direct_beats == 4 and m.batched_beats == 0
+                # The record stayed alive through the outage: another node
+                # sees it.
+                rec = await nodes[2][1].get(PEERS_KEY)
+                assert rec.get("vol-hb") is not None
+                # AIMD backoff engaged: after the first failures the
+                # corpse is skipped, so failures stop accruing 1:1 with
+                # beats.
+                assert cp.counters["calls_failed"] >= 1
+                assert cp.counters["calls_failed"] < len(durations)
+                assert "corpse" in cp.stats()["backed_off"]
+            finally:
+                await _teardown(nodes)
+
+        run(scenario())
+
+    def test_backoff_is_aimd_bounded(self):
+        async def scenario():
+            nodes = await _mesh(2)
+            t, d = nodes[0]
+            cp = ControlPlaneClient(t, d, "x")
+            try:
+                delays = []
+                for _ in range(8):
+                    cp._note_fail("r")
+                    delays.append(cp._backoff["r"][1])
+                # Multiplicative increase, bounded at the cap.
+                assert delays[0] == cp.BACKOFF_START
+                assert delays[1] == 2 * delays[0]
+                assert max(delays) == cp.BACKOFF_CAP
+                # Additive decrease on recovery.
+                cp._note_ok("r")
+                assert cp._backoff["r"][1] == cp.BACKOFF_CAP - cp.BACKOFF_DECREASE
+                assert cp._backoff["r"][0] == 0.0  # unblocked immediately
+            finally:
+                await _teardown(nodes)
+
+        run(scenario())
+
+
+class TestFencingRecovery:
+    def test_reclaim_escalates_past_watermark_of_expired_rollup(self):
+        """A fence watermark outlives the rollup record (600s vs 75s): a
+        replica acquiring a shard after an ownership gap cannot learn the
+        old generation from the record — its first claim gets fenced, and
+        the reported watermark must FLOOR the re-claim so the shard
+        recovers next tick instead of livelocking (claim 1, fenced by 5,
+        drop, repeat) until the watermark expires."""
+
+        async def scenario():
+            from distributedvolunteercomputing_tpu.swarm.control_plane import (
+                ROLLUP_KEY,
+            )
+
+            nodes = await _mesh(3)
+            try:
+                # A long-dead owner's watermark at gen 5; its rollup
+                # record itself has expired.
+                await nodes[1][1].store(
+                    ROLLUP_KEY, {"gen": 5, "rid": "old"}, subkey="s3",
+                    ttl=0.2, fence=5,
+                )
+                await asyncio.sleep(0.4)
+                rep = ControlPlaneReplica(
+                    nodes[0][0], nodes[0][1], rid="new", interval=60.0
+                )
+                # start() makes the initial claims: shard 3's gen-1 write
+                # is fenced and dropped, but the watermark is recorded.
+                await rep.start()
+                assert 3 not in rep._shard_gens
+                assert rep._gen_floor.get(3) == 5
+                # The very next tick's recompute+write recovers the shard
+                # ABOVE the watermark.
+                await rep._refresh_views()
+                await rep._recompute_ownership()
+                await rep._write_rollups()
+                assert rep._shard_gens.get(3) == 6
+                rec = await nodes[2][1].get(ROLLUP_KEY)
+                assert rec.get("s3", {}).get("gen") == 6
+                await rep.stop()
+            finally:
+                await _teardown(nodes)
+
+        run(scenario())
+
+
+class TestRetiring:
+    def test_retiring_tombstone_reresolves_immediately(self):
+        """Satellite: a SIGTERM'd replica publishes a retiring tombstone;
+        clients drop it from the active set at the very next exchange or
+        refresh — no TTL wait, no suspicion accrual."""
+
+        async def scenario():
+            nodes = await _mesh(5)
+            repA = ControlPlaneReplica(nodes[0][0], nodes[0][1], rid="a", interval=60.0)
+            repB = ControlPlaneReplica(nodes[1][0], nodes[1][1], rid="b", interval=60.0)
+            await repA.start()
+            await repB.start()
+            try:
+                t, d = nodes[2]
+                cp = ControlPlaneClient(t, d, "vol-r")
+                await cp.refresh(force=True)
+                assert [rid for rid, _ in cp.active()] == ["a", "b"]
+                # Graceful SIGTERM path: tombstone + drain, socket STAYS
+                # OPEN briefly (the point: re-resolve must not need a conn
+                # failure).
+                await repA.retire(grace=0.0)
+                await cp.refresh(force=True)
+                assert [rid for rid, _ in cp.active()] == ["b"]
+                # Exchange routes straight to B, no failover/conn failure.
+                ret = await cp.exchange({"addr": list(t.addr), "t": 1.0}, ttl=10.0)
+                assert ret is not None and ret["rid"] == "b"
+                assert cp.counters["failovers"] == 0
+                assert cp.counters["calls_failed"] == 0
+                # B's own ownership recompute absorbs the whole key range.
+                await repB._refresh_views()
+                await repB._recompute_ownership()
+                assert sorted(repB._shard_gens) == list(range(N_SHARDS))
+            finally:
+                await repB.stop()
+                await _teardown(nodes)
+
+        run(scenario())
+
+    @pytest.mark.slow
+    def test_sigterm_retires_coordinator_subprocess(self):
+        """run_coordinator_forever end-to-end: SIGTERM exits cleanly after
+        publishing the retiring tombstone (the in-process half is covered
+        above; this pins the signal wiring)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "coordinator.py", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        try:
+            line = ""
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if line.startswith("COORDINATOR_READY"):
+                    break
+            assert line.startswith("COORDINATOR_READY"), line
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+            assert rc == 0, rc
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+class TestRendezvousReads:
+    def test_rendezvous_via_replica_with_dht_fallback(self):
+        async def scenario():
+            nodes = await _mesh(4)
+            rep_t, rep_d = nodes[0]
+            rep = ControlPlaneReplica(rep_t, rep_d, rid="r0", interval=60.0)
+            await rep.start()
+            try:
+                t, d = nodes[1]
+                await d.store("avg/test-round", {"addr": ["h", 1]}, subkey="p1", ttl=30)
+                cp = ControlPlaneClient(t, d, "p1")
+                await cp.refresh(force=True)
+                rec = await cp.rendezvous_get("avg/test-round")
+                assert rec == {"p1": {"addr": ["h", 1]}}
+                assert rep.counters["rendezvous_served"] == 1
+                # Second read inside the cache window: served without a
+                # second DHT lookup.
+                await cp.rendezvous_get("avg/test-round")
+                assert rep.counters["rendezvous_served"] == 2
+                assert rep.counters["rendezvous_lookups"] == 1
+                # Replica dies: reader returns None; the matchmaker-level
+                # wrapper falls back to the direct DHT walk.
+                await rep.stop()
+                await _kill(rep_t, rep_d)
+                assert await cp.rendezvous_get("avg/test-round") is None
+                from distributedvolunteercomputing_tpu.swarm.matchmaking import (
+                    Matchmaker,
+                )
+
+                mm = Matchmaker(
+                    t, d, "p1", rendezvous_get=cp.rendezvous_get
+                )
+                rec = await mm._read_rendezvous("avg/test-round")
+                assert rec == {"p1": {"addr": ["h", 1]}}
+            finally:
+                try:
+                    await rep.stop()
+                except Exception:
+                    pass
+                await _teardown(nodes[1:])
+
+        run(scenario())

@@ -5,11 +5,24 @@ The round-1/2 table used blind LRS-drop and never republished, which is fine
 at n=4 but silently loses live records at 16+ under churn: a record's
 original k-closest replica set can be entirely restarted away while the
 owner still considers the record live.
+
+Control-plane extensions (ISSUE 9): FENCED stores (per-(key,subkey)
+generation watermarks; stale writers refused — the replicated control
+plane's shard-handoff fencing), BATCHED multi-subkey stores (one RPC frame
+per storage replica for a whole membership shard), and key-range ownership
+transfer across replica join/leave/kill.
 """
 
 import asyncio
 
-from distributedvolunteercomputing_tpu.swarm.dht import DHTNode, RoutingTable, K
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm.dht import (
+    DHTNode,
+    K,
+    RoutingTable,
+    StaleWriteFenced,
+)
 from distributedvolunteercomputing_tpu.swarm.transport import Transport
 
 
@@ -77,6 +90,251 @@ class TestPingBeforeEvict:
                 await live_peer.stop()
                 await t_self.close()
                 await t_live.close()
+
+        run(scenario())
+
+
+async def _mesh(n, maintenance_interval=0.0):
+    """n DHT nodes, all bootstrapped via the first."""
+    nodes = []
+    boot = None
+    for _ in range(n):
+        t = Transport()
+        d = DHTNode(t, maintenance_interval=maintenance_interval)
+        await d.start(bootstrap=[boot] if boot else None)
+        if boot is None:
+            boot = t.addr
+        nodes.append((t, d))
+    return nodes
+
+
+async def _teardown_mesh(nodes):
+    for t, d in nodes:
+        try:
+            await d.stop()
+        except Exception:
+            pass
+        try:
+            await t.close()
+        except Exception:
+            pass
+
+
+@pytest.mark.controlplane
+class TestFencedStores:
+    """Generation-watermarked stores: the stale-replica-write rejection the
+    control plane's key-range handoff rides on (the PR-4 fencing idea
+    applied to DHT records)."""
+
+    def test_stale_write_rejected_across_replicas(self):
+        async def scenario():
+            nodes = await _mesh(4)
+            try:
+                a, b = nodes[1][1], nodes[2][1]
+                # Replica A owns the record at gen 1.
+                await a.store("cp/rollup", {"rid": "A"}, subkey="s3", ttl=30, fence=1)
+                # Handoff: B claims the key range at gen 2.
+                await b.store("cp/rollup", {"rid": "B"}, subkey="s3", ttl=30, fence=2)
+                # A's late write (still at gen 1) must be REFUSED loudly...
+                with pytest.raises(StaleWriteFenced) as ei:
+                    await a.store("cp/rollup", {"rid": "A2"}, subkey="s3", ttl=30, fence=1)
+                assert ei.value.gen >= 2
+                # ...and every reader still sees B's record.
+                for _, d in nodes:
+                    rec = await d.get("cp/rollup")
+                    assert rec.get("s3") == {"rid": "B"}, rec
+                # B (current gen) keeps writing fine; a re-claim at gen 3
+                # then fences B out in turn.
+                await b.store("cp/rollup", {"rid": "B2"}, subkey="s3", ttl=30, fence=2)
+                await a.store("cp/rollup", {"rid": "A3"}, subkey="s3", ttl=30, fence=3)
+                with pytest.raises(StaleWriteFenced):
+                    await b.store("cp/rollup", {"rid": "B3"}, subkey="s3", ttl=30, fence=2)
+            finally:
+                await _teardown_mesh(nodes)
+
+        run(scenario())
+
+    def test_equal_generation_tie_resolves_to_smallest_owner(self):
+        """Two replicas whose split views claim the SAME generation must
+        resolve deterministically (smallest writer id wins — the election
+        idiom), not flip-flop the record as silent co-writers."""
+
+        async def scenario():
+            nodes = await _mesh(3)
+            try:
+                a, b = nodes[0][1], nodes[1][1]
+                await b.store("cp/rollup", {"rid": "r-b"}, subkey="s2",
+                              ttl=30, fence=2, fence_owner="r-b")
+                # Smaller id at the same generation takes the slot...
+                await a.store("cp/rollup", {"rid": "r-a"}, subkey="s2",
+                              ttl=30, fence=2, fence_owner="r-a")
+                # ...and the larger id is now fenced at that generation.
+                with pytest.raises(StaleWriteFenced):
+                    await b.store("cp/rollup", {"rid": "r-b2"}, subkey="s2",
+                                  ttl=30, fence=2, fence_owner="r-b")
+                rec = await nodes[2][1].get("cp/rollup")
+                assert rec.get("s2") == {"rid": "r-a"}
+                # A HIGHER generation still beats the tiebreak outright.
+                await b.store("cp/rollup", {"rid": "r-b3"}, subkey="s2",
+                              ttl=30, fence=3, fence_owner="r-b")
+            finally:
+                await _teardown_mesh(nodes)
+
+        run(scenario())
+
+    def test_fence_watermark_outlives_record_ttl(self):
+        async def scenario():
+            nodes = await _mesh(3)
+            try:
+                a, b = nodes[0][1], nodes[1][1]
+                await b.store("cp/rollup", {"rid": "B"}, subkey="s0", ttl=0.2, fence=5)
+                await asyncio.sleep(0.4)  # record expires; watermark must not
+                for _, d in nodes:
+                    assert (await d.get("cp/rollup")).get("s0") is None, (
+                        "premise: the record itself must have expired"
+                    )
+                with pytest.raises(StaleWriteFenced):
+                    await a.store("cp/rollup", {"rid": "A"}, subkey="s0", ttl=30, fence=4)
+            finally:
+                await _teardown_mesh(nodes)
+
+        run(scenario())
+
+    def test_deposed_owner_stops_republishing(self):
+        """A fenced-out owned record must drop out of the republish loop:
+        republishing it IS the stale write the fence exists to reject."""
+
+        async def scenario():
+            nodes = await _mesh(3, maintenance_interval=0.3)
+            try:
+                a, b = nodes[0][1], nodes[1][1]
+                await a.store("cp/rollup", {"rid": "A"}, subkey="s1", ttl=30, fence=1)
+                assert ("cp/rollup", "s1") in a._owned
+                await b.store("cp/rollup", {"rid": "B"}, subkey="s1", ttl=30, fence=2)
+                # A's next republish hits the watermark and drops ownership.
+                for _ in range(30):
+                    if ("cp/rollup", "s1") not in a._owned:
+                        break
+                    await asyncio.sleep(0.1)
+                assert ("cp/rollup", "s1") not in a._owned
+                rec = await nodes[2][1].get("cp/rollup")
+                assert rec.get("s1") == {"rid": "B"}
+            finally:
+                await _teardown_mesh(nodes)
+
+        run(scenario())
+
+
+@pytest.mark.controlplane
+class TestBatchedStores:
+    def test_store_many_one_frame_per_storage_replica(self):
+        """A whole cohort of subkeys must cross as ONE dht.store RPC per
+        storage replica (the heartbeat-coalescing primitive), and read
+        back identically to individual stores."""
+
+        async def scenario():
+            nodes = await _mesh(5)
+            try:
+                t0, d0 = nodes[0]
+                values = {f"peer-{i}": {"addr": ["h", i], "t": float(i)} for i in range(12)}
+                rpcs_before = t0.rpcs_sent
+                await d0.store_many("peers-batch", values, ttl=30.0)
+                batched_rpcs = t0.rpcs_sent - rpcs_before
+                # One lookup walk (<= a few finds) + one store per replica;
+                # definitely NOT 12 * replicas.
+                assert batched_rpcs <= 4 + 12, batched_rpcs
+                for _, d in nodes:
+                    rec = await d.get("peers-batch")
+                    assert rec == values, (len(rec), len(values))
+                # Per-subkey TTLs: a short-lived entry expires alone.
+                await d0.store_many(
+                    "peers-batch2", {"a": 1, "b": 2}, ttl=30.0, ttls={"b": 0.2}
+                )
+                await asyncio.sleep(0.4)
+                rec = await nodes[2][1].get("peers-batch2")
+                assert rec == {"a": 1}
+            finally:
+                await _teardown_mesh(nodes)
+
+        run(scenario())
+
+
+@pytest.mark.controlplane
+class TestShardOwnershipTransfer:
+    """Key-range ownership across replica churn: join/leave/kill move
+    shard ownership with a GENERATION bump, and the deposed owner is
+    fenced out (extends the DHT hardening suite per ISSUE 9)."""
+
+    def test_ownership_transfer_on_join_leave_kill(self):
+        from distributedvolunteercomputing_tpu.swarm.control_plane import (
+            N_SHARDS,
+            ControlPlaneReplica,
+        )
+
+        async def scenario():
+            nodes = await _mesh(2)
+            reps = []
+            try:
+                # One replica owns everything.
+                r1 = ControlPlaneReplica(nodes[0][1].transport, nodes[0][1], rid="r1")
+                await r1.start()
+                reps.append(r1)
+                assert sorted(r1._shard_gens) == list(range(N_SHARDS))
+                gens_before = dict(r1._shard_gens)
+
+                # JOIN: a second replica takes over half the key range at a
+                # bumped generation; r1 releases those shards on its next
+                # ownership recompute.
+                t2 = Transport()
+                d2 = DHTNode(t2, maintenance_interval=0)
+                await d2.start(bootstrap=[nodes[0][1].transport.addr])
+                r2 = ControlPlaneReplica(t2, d2, rid="r2")
+                await r2.start()
+                reps.append(r2)
+                await r1._refresh_views()
+                await r1._recompute_ownership()
+                owned1, owned2 = set(r1._shard_gens), set(r2._shard_gens)
+                assert owned1 and owned2
+                assert owned1.isdisjoint(owned2)
+                assert owned1 | owned2 == set(range(N_SHARDS))
+                # The acquiring replica claimed gen+1 over what r1 wrote.
+                await r1._write_rollups()
+                await r2._write_rollups()
+                for s in owned2:
+                    assert r2._shard_gens[s] > gens_before[s]
+
+                # A deposed write from r1 for one of r2's shards is fenced.
+                s = min(owned2)
+                r1._shard_gens[s] = gens_before[s]  # simulate a stale view
+                await r1._write_rollups()
+                assert s not in r1._shard_gens, "fenced write must drop ownership"
+                assert r1.counters["rollups_fenced"] >= 1
+
+                # KILL r2 abruptly (no retire): once its record expires,
+                # r1 re-acquires the whole range at a higher generation.
+                r2_gens = dict(r2._shard_gens)
+                await r2.stop()
+                await d2.stop()
+                await t2.close()
+                # Expire r2's replica record from every storage node's
+                # view (TTL'd soft state; force-expire for test speed).
+                for _, d in nodes:
+                    rec = d.storage.get("cp/replicas", {})
+                    if "r2" in rec:
+                        v, _exp = rec["r2"]
+                        rec["r2"] = (v, 0.0)
+                await r1._refresh_views()
+                await r1._recompute_ownership()
+                assert sorted(r1._shard_gens) == list(range(N_SHARDS))
+                for s, g in r2_gens.items():
+                    assert r1._shard_gens[s] > g
+            finally:
+                for r in reps:
+                    try:
+                        await r.stop()
+                    except Exception:
+                        pass
+                await _teardown_mesh(nodes)
 
         run(scenario())
 
