@@ -182,6 +182,10 @@ class MeshCodec:
         self.degraded = False
         self.degrade_reason = ""
         self._fail_injected = 0
+        # Optional flight recorder (anything with .record(kind, **fields));
+        # attached by the volunteer so a degrade event lands in the
+        # telemetry plane's ring buffer beside the depositions and fences.
+        self.recorder = None
         # gauges
         self.ops_mesh = 0
         self.ops_host = 0
@@ -273,6 +277,13 @@ class MeshCodec:
             "mesh codec degraded to host backend: %s — this volunteer "
             "continues on the host data path", errstr(e),
         )
+        if self.recorder is not None:
+            # Flight recorder (swarm/telemetry.py): a slice loss mid-round
+            # is front-page post-mortem material.
+            try:
+                self.recorder.record("codec_degraded", reason=errstr(e))
+            except Exception:  # noqa: BLE001 — recording must not affect the fallback
+                pass
 
     def _run(self, op: Callable, host: Callable):
         """Run ``op`` on device, falling back to ``host`` (and permanently

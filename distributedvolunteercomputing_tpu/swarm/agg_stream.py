@@ -197,6 +197,7 @@ class StreamingAggregator:
         kw_fn: Optional[Callable[[int], dict]] = None,
         pool: Optional[TilePool] = None,
         codec: Optional[mesh_codec_mod.MeshCodec] = None,
+        telemetry=None,
     ):
         if wire not in ("f32", "bf16"):
             raise ValueError(f"streaming aggregation needs an elementwise wire, got {wire!r}")
@@ -264,6 +265,17 @@ class StreamingAggregator:
         self._out = (
             np.zeros(0, np.float32) if self._folder is not None
             else np.zeros(self.n_elems, np.float32)
+        )
+
+        # Telemetry plane (swarm/telemetry.py): per-tile fold latency lands
+        # in the unified registry's ``swarm.tile_fold_seconds`` histogram —
+        # the in-pipeline evidence behind the leader's ``fold`` span.
+        self._tile_hist = (
+            telemetry.registry.histogram(
+                "swarm.tile_fold_seconds", "window-tile aggregation latency"
+            )
+            if telemetry is not None and getattr(telemetry, "enabled", False)
+            else None
         )
 
         # -- gauges (surfaced via Averager.stats()/volunteer summary) ------
@@ -620,8 +632,11 @@ class StreamingAggregator:
                     np.ascontiguousarray(stack), self.method, **kw
                 )
         finally:
+            dt = time.perf_counter() - t0
+            if self._tile_hist is not None:
+                self._tile_hist.observe(dt, method=self.method)
             with self._lock:
-                self.busy_s += time.perf_counter() - t0
+                self.busy_s += dt
                 self._note_free(win.buf.nbytes)
                 self.pool.put(win.buf)
 

@@ -51,6 +51,7 @@ leader itself; see docs/RESILIENCE.md).
 from __future__ import annotations
 
 import asyncio
+import copy
 import hashlib
 import os
 import random
@@ -73,13 +74,14 @@ from distributedvolunteercomputing_tpu.swarm.matchmaking import (
     Matchmaker,
 )
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm import telemetry as telemetry_mod
 from distributedvolunteercomputing_tpu.swarm.transport import (
     Addr,
     RPCError,
     StreamPayload,
     Transport,
 )
-from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
+from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger, log_context
 from distributedvolunteercomputing_tpu.utils.pytree import flatten_to_buffer, unflatten_from_buffer
 
 log = get_logger(__name__)
@@ -191,6 +193,7 @@ class AveragerBase:
         mesh_codec=None,
         group_schedule: Optional[GroupSchedule] = None,
         control_plane=None,
+        telemetry=None,
     ):
         if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -402,6 +405,47 @@ class AveragerBase:
         # intra/cross cadence actually happening, per level, not folded
         # into one gauge.
         self._level_totals: Dict[str, Dict[str, int]] = {}
+        # Telemetry plane (swarm/telemetry.py): round tracing, the unified
+        # metrics registry, and the flight recorder. The volunteer passes a
+        # shared per-process bundle (ClockSync-aligned clock, RPCs
+        # registered); bare averagers get a private enabled one so the
+        # surfaces exist in every test/bench construction.
+        self.telemetry = (
+            telemetry
+            if telemetry is not None
+            else telemetry_mod.Telemetry(peer_id=self.peer_id, clock=self.clock)
+        )
+        self._register_telemetry()
+
+    def _register_telemetry(self) -> None:
+        """Re-register the pre-existing stats() surfaces into the unified
+        registry as callback sources: every scrape flattens their numeric
+        leaves into gauges under a stable dotted namespace, so the ad-hoc
+        dicts PRs 1-9 accreted are all reachable from one scrape without
+        rewriting the code that fills them."""
+        reg = self.telemetry.registry
+        reg.gauge_fn("swarm.rounds_ok", lambda: self.rounds_ok)
+        reg.gauge_fn("swarm.rounds_skipped", lambda: self.rounds_skipped)
+        reg.gauge_fn("swarm.rounds_degraded", lambda: self.rounds_degraded)
+        reg.source("transport", self.transport.stats)
+        reg.source("mesh_codec", lambda: self.mesh_codec.stats())
+        if self._mesh_codec is not None and getattr(self._mesh_codec, "recorder", None) is None:
+            # Slice-loss degrades land in this volunteer's flight recorder.
+            # (The lazily-resolved process default is hooked by the
+            # volunteer, which configures it.)
+            self._mesh_codec.recorder = self.telemetry.recorder
+        reg.source("aggregation", lambda: dict(self._agg_gauges))
+        if self.group_schedule is not None:
+            reg.source("groups", self.group_stats)
+        if self.resilience is not None:
+            reg.source("resilience", self.resilience.stats)
+            if getattr(self.resilience, "recorder", None) is None:
+                # Escalation/backoff transitions land in this volunteer's
+                # flight recorder (resilience event hooks).
+                self.resilience.recorder = self.telemetry.recorder
+        mem_stats = getattr(self.membership, "stats", None)
+        if mem_stats is not None:
+            reg.source("control_plane", mem_stats)
 
     MAX_GROUP_GAUGES = 16
 
@@ -746,6 +790,9 @@ class AveragerBase:
             delay = self.resilience.backoff_s()
             if delay > 0:
                 log.info("%s round backoff %.1fs after failures", self.mode, delay)
+                self.telemetry.event(
+                    "backoff", mode=self.mode, delay_s=round(delay, 3)
+                )
                 await asyncio.sleep(delay)
 
     def _flush_round_outcome(self, duration_s: float, ok: bool) -> None:
@@ -1367,7 +1414,13 @@ class AveragerBase:
             cp_stats.get("beats") or self.control_plane is not None
         ):
             out["control_plane"] = cp_stats
-        return out
+        out["telemetry"] = self.telemetry.summary()
+        # SNAPSHOT semantics: several sub-dicts above are filled in place by
+        # background work (round paths, the aggregation worker, heartbeat
+        # loops), and before this deep-copy a held stats() reference kept
+        # mutating under the reader — a bench could record one number and
+        # report another. A stats() return is now frozen at read time.
+        return copy.deepcopy(out)
 
     def _note_agg_round(self, stream: Optional[StreamingAggregator]) -> None:
         """Roll one led round's streaming-aggregation gauges into the
@@ -1546,7 +1599,43 @@ class SyncAverager(AveragerBase):
         except (TypeError, ValueError):
             return -1
 
+    def _note_fence_rejected(self, rpc: str, args: dict, have_gen: int) -> None:
+        """Flight-record + count one fenced-off request: the post-mortem
+        evidence a chaos verdict wants when stale traffic was refused."""
+        if not self.telemetry.enabled:
+            return  # --no-telemetry: every record path is a no-op
+        self.telemetry.event(
+            "fence_rejected",
+            rpc=rpc,
+            epoch=str(args.get("epoch", "?")),
+            have_gen=have_gen,
+            got_gen=self._fence_of(args),
+            peer_from=str(args.get("peer", "?")),
+        )
+        self.telemetry.registry.counter(
+            "swarm.fences_rejected_total", "stale-generation requests refused"
+        ).inc(rpc=rpc)
+
     async def _rpc_contribute(self, args: dict, payload: bytes):
+        # Handler-side span: the member's push carried its round trace in
+        # the frame meta, so this span stitches into the member's tree —
+        # the leader-side evidence of where a push's bytes went. Wrapped
+        # here (not inline) so REJECTED pushes record too: the error paths
+        # are exactly what a post-mortem wants timed.
+        push_sp = self.telemetry.tracer.start(
+            "fold.push", role="leader", peer_from=str(args.get("peer", "?"))
+        )
+        try:
+            ret = await self._contribute_inner(args, payload)
+        except BaseException:
+            if push_sp is not None:
+                push_sp.end(ok=False)
+            raise
+        if push_sp is not None:
+            push_sp.end(ok=True)
+        return ret
+
+    async def _contribute_inner(self, args: dict, payload: bytes):
         if not self._check_schema(args):
             raise RPCError("schema mismatch")
         # Members can push before the leader enters its round: park it
@@ -1559,6 +1648,9 @@ class SyncAverager(AveragerBase):
             # not mix into this round. Unarmed (parked) rounds skip the
             # check — their entries are re-filtered against the token
             # table at arming anyway.
+            self._note_fence_rejected(
+                "sync.contribute", args, have_gen=st.gen
+            )
             raise RPCError(
                 f"fencing mismatch: round epoch is at generation {st.gen}, "
                 f"push carries {self._fence_of(args)} (deposed/stale)"
@@ -1670,6 +1762,7 @@ class SyncAverager(AveragerBase):
             # refuse to serve its stale generation-(st.gen) result to a
             # member that has moved on — and refuse fast, not after the
             # gather-deadline wait below.
+            self._note_fence_rejected("sync.fetch", args, have_gen=st.gen)
             raise RPCError(
                 f"fencing mismatch: round epoch is at generation {st.gen}, "
                 f"fetch asks for {self._fence_of(args)} (leader deposed?)"
@@ -1692,12 +1785,19 @@ class SyncAverager(AveragerBase):
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
         await self._maybe_backoff()
+        tele = self.telemetry
+        # Round-trace bookkeeping: the JOIN phase (rendezvous + formation)
+        # runs before the trace id — the matchmaking epoch — exists, so its
+        # wall/duration are captured here and the span recorded
+        # retroactively once the group (and therefore the epoch) is known.
+        t_round_wall, t_round_pc = tele.clock(), time.perf_counter()
         # Group-scoped rendezvous when a rotating schedule is attached:
         # many groups form this round, each running THIS protocol under
         # its own epoch; we only ever see our own — and the schedule's
         # determinism lets formation skip the DHT entirely (_form_group).
         round_key = await self._rendezvous()
         group = await self._form_group(round_key)
+        join_dur = time.perf_counter() - t_round_pc
         if group is None:
             # No group formed (too few peers / no begin): a matchmaking
             # skip, not a round — the policy only learns from rounds that
@@ -1721,52 +1821,92 @@ class SyncAverager(AveragerBase):
             self._last_outcomes = None
             self._note_group_round(None)
             return None
-        if group.my_index == 0 and self._specs is not None:
-            # Arm the streaming round BEFORE packing our own contribution:
-            # members push the instant formation completes, and the pack at
-            # param scale is exactly the window their first chunks land in.
-            await self._prepare_lead_round(group)
-        # One compression per round, leader or member: the leader's own
-        # contribution enters the aggregate exactly as a peer would see it.
-        buf, wire_bytes, sent = await self._pack_and_compress(tree)
-        t0 = time.monotonic()
+        # The trace id IS the round's existing key: the matchmaking epoch,
+        # which already hashes the group-scoped rendezvous key (rotation,
+        # group index, hierarchy level). Recovery generations ride as span
+        # attributes so a recovered round stays ONE trace.
+        trace = group.epoch
+        asg = self._last_group
+        level = asg.level if asg is not None else "flat"
+        group_id = group.group_id or (asg.group_id if asg is not None else "")
+        role = "leader" if group.my_index == 0 else "member"
+        ok = False
+        # Reset BEFORE any awaitable can raise: the round span's finally
+        # reads this, and a round dying in arm/encode must not inherit the
+        # previous round's degraded verdict.
         self._round_degraded = False
-        # The leader's own contribution always enters the aggregate; a
-        # member's may be dropped in a degraded round (late push), in which
-        # case its shipped top-k mass never landed and committing the
-        # residual would lose both. _member_round flips this from the
-        # leader-reported included set.
-        self._contribution_included = True
-        try:
-            if group.my_index == 0:
-                result = await self._lead_round(
-                    group, await asyncio.to_thread(sent), weight, wire_bytes
+        with tele.tracer.trace_scope(trace), log_context(
+            peer=self.peer_id, round_key=round_key, trace=trace,
+            round_level=level, group=group_id or None,
+            zone=self.zone or None,
+        ):
+            tele.tracer.record(
+                "join", trace, t_round_wall, join_dur,
+                role=role, key=round_key, size=group.size,
+            )
+            try:
+                if group.my_index == 0 and self._specs is not None:
+                    # Arm the streaming round BEFORE packing our own
+                    # contribution: members push the instant formation
+                    # completes, and the pack at param scale is exactly the
+                    # window their first chunks land in.
+                    await self._prepare_lead_round(group)
+                # One compression per round, leader or member: the leader's
+                # own contribution enters the aggregate exactly as a peer
+                # would see it.
+                with tele.span("encode", role=role):
+                    buf, wire_bytes, sent = await self._pack_and_compress(tree)
+                t0 = time.monotonic()
+                # The leader's own contribution always enters the aggregate;
+                # a member's may be dropped in a degraded round (late push),
+                # in which case its shipped top-k mass never landed and
+                # committing the residual would lose both. _member_round
+                # flips this from the leader-reported included set.
+                self._contribution_included = True
+                try:
+                    if group.my_index == 0:
+                        result = await self._lead_round(
+                            group, await asyncio.to_thread(sent), weight, wire_bytes
+                        )
+                    else:
+                        result = await self._member_round(group, weight, wire_bytes, sent)
+                except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
+                    log.info(
+                        "sync round %d failed (%s); continuing local",
+                        round_no, errstr(e),
+                    )
+                    tele.event("round_failed", key=round_key, error=errstr(e))
+                    self.rounds_skipped += 1
+                    self._observe_round_failure()
+                    self._commit_ef(False)
+                    self._flush_round_outcome(time.monotonic() - t0, ok=False)
+                    self._note_group_round(False, size=group.size)
+                    return None
+                self._commit_ef(result is not None and self._contribution_included)
+                if result is None:
+                    self._observe_round_failure()
+                elif self._round_degraded:
+                    self.rounds_degraded += 1
+                    tele.event("round_degraded", key=round_key)
+                else:
+                    self._observe_round_time(time.monotonic() - t0)
+                self._flush_round_outcome(time.monotonic() - t0, ok=result is not None)
+                self._note_group_round(
+                    result is not None,
+                    degraded=self._round_degraded,
+                    led=group.my_index == 0,
+                    size=group.size,
                 )
-            else:
-                result = await self._member_round(group, weight, wire_bytes, sent)
-        except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
-            log.info("sync round %d failed (%s); continuing local", round_no, errstr(e))
-            self.rounds_skipped += 1
-            self._observe_round_failure()
-            self._commit_ef(False)
-            self._flush_round_outcome(time.monotonic() - t0, ok=False)
-            self._note_group_round(False, size=group.size)
-            return None
-        self._commit_ef(result is not None and self._contribution_included)
-        if result is None:
-            self._observe_round_failure()
-        elif self._round_degraded:
-            self.rounds_degraded += 1
-        else:
-            self._observe_round_time(time.monotonic() - t0)
-        self._flush_round_outcome(time.monotonic() - t0, ok=result is not None)
-        self._note_group_round(
-            result is not None,
-            degraded=self._round_degraded,
-            led=group.my_index == 0,
-            size=group.size,
-        )
-        return result
+                ok = result is not None
+                return result
+            finally:
+                tele.tracer.record(
+                    "round", trace, t_round_wall,
+                    time.perf_counter() - t_round_pc,
+                    role=role, key=round_key, level=level, ok=ok,
+                    degraded=self._round_degraded, gen=group.gen,
+                    **({"group": group_id} if group_id else {}),
+                )
 
     async def _prepare_lead_round(self, group: Group) -> _Round:
         """The leader-side round prologue, idempotent per epoch: fix the
@@ -1786,64 +1926,77 @@ class SyncAverager(AveragerBase):
             st = self._rounds[group.epoch] = _Round([])
         if st.armed:
             return st
-        await self._phase("pre_arm")
-        st.armed = True
-        st.gen = group.gen
-        member_ids = [pid for pid, _ in group.members]
-        st.expected = set(member_ids)
-        tokens = group.member_tokens or {}
-        st.tokens = tokens
-        # Keep only parked entries under the exact (peer, token) pairs we
-        # issued at begin — everything else is noise or forgery.
-        st.contribs = {
-            (p, t): c for (p, t), c in st.contribs.items() if tokens.get(p) == t
-        }
-        st.payloads = {
-            k: pl for k, pl in st.payloads.items() if k in st.contribs
-        }
-        # The estimator is fixed at ARMING (not commit): streamed tiles
-        # aggregate while contributions are still arriving, so the method
-        # must be known before the first chunk lands. Safe to fix early
-        # because the METHOD choice is count-insensitive — _effective_method
-        # picks it from resilience.recommend_method(self.method), which
-        # never sees the peer count — so members dropping between arming
-        # and commit cannot change it. Only the kwargs depend on row count,
-        # and those ARE recomputed per arrived count via kw_fn below. What
-        # did move is the escalation-state read: a resilience state change
-        # mid-round is seen one round later than the commit-time call saw it.
-        method, _ = self._effective_method(len(member_ids))
-        kw_cache: Dict[int, dict] = {}
+        arm_span = self.telemetry.tracer.start(
+            "arm", trace=group.epoch, role="leader", gen=group.gen
+        )
+        try:
+            await self._phase("pre_arm")
+            st.armed = True
+            st.gen = group.gen
+            member_ids = [pid for pid, _ in group.members]
+            st.expected = set(member_ids)
+            tokens = group.member_tokens or {}
+            st.tokens = tokens
+            # Keep only parked entries under the exact (peer, token) pairs
+            # we issued at begin — everything else is noise or forgery.
+            st.contribs = {
+                (p, t): c for (p, t), c in st.contribs.items() if tokens.get(p) == t
+            }
+            st.payloads = {
+                k: pl for k, pl in st.payloads.items() if k in st.contribs
+            }
+            # The estimator is fixed at ARMING (not commit): streamed tiles
+            # aggregate while contributions are still arriving, so the
+            # method must be known before the first chunk lands. Safe to
+            # fix early because the METHOD choice is count-insensitive —
+            # _effective_method picks it from
+            # resilience.recommend_method(self.method), which never sees
+            # the peer count — so members dropping between arming and
+            # commit cannot change it. Only the kwargs depend on row
+            # count, and those ARE recomputed per arrived count via kw_fn
+            # below. What did move is the escalation-state read: a
+            # resilience state change mid-round is seen one round later
+            # than the commit-time call saw it.
+            method, _ = self._effective_method(len(member_ids))
+            kw_cache: Dict[int, dict] = {}
 
-        def kw_fn(n: int, _m=method) -> dict:
-            # Memoized per row count: a per-tile recompute would re-log the
-            # infeasible-trim clamp warning once per tile.
-            if n not in kw_cache:
-                kw_cache[n] = self._robust_kw(n, method=_m)
-            return kw_cache[n]
+            def kw_fn(n: int, _m=method) -> dict:
+                # Memoized per row count: a per-tile recompute would
+                # re-log the infeasible-trim clamp warning once per tile.
+                if n not in kw_cache:
+                    kw_cache[n] = self._robust_kw(n, method=_m)
+                return kw_cache[n]
 
-        st.method, st.kw_fn = method, kw_fn
-        n_elems = sum(s.size for s in self._specs)
-        esz = 4 if self.wire == "f32" else 2
-        if self.wire in ("f32", "bf16") and self.transport.chunk_bytes % esz == 0:
-            # Arm the streaming pipeline: from here on, chunked pushes fold
-            # tile-by-tile as they arrive (transport request sink), inline
-            # pushes fold at decode, and the deadline commit reduces to
-            # closing whatever is still open.
-            st.stream = StreamingAggregator(
-                n_elems, member_ids, method, self.wire,
-                self.transport.chunk_bytes, kw_fn=kw_fn,
-                codec=self.mesh_codec,
-            )
-            # Fold every pre-arming parked buffer; fed entries drop their
-            # dense copy — the aggregator owns that mass now.
-            for k, (w_k, b_k) in [
-                (k, c) for k, c in st.contribs.items()
-                if c[1] is not None and c[1] is not STREAMED
-                and c[1].size == n_elems
-            ]:
-                fed = await asyncio.to_thread(st.stream.add_dense, k[0], w_k, b_k)
-                if fed:
-                    st.contribs[k] = (w_k, STREAMED)
+            st.method, st.kw_fn = method, kw_fn
+            n_elems = sum(s.size for s in self._specs)
+            esz = 4 if self.wire == "f32" else 2
+            if self.wire in ("f32", "bf16") and self.transport.chunk_bytes % esz == 0:
+                # Arm the streaming pipeline: from here on, chunked pushes
+                # fold tile-by-tile as they arrive (transport request
+                # sink), inline pushes fold at decode, and the deadline
+                # commit reduces to closing whatever is still open.
+                st.stream = StreamingAggregator(
+                    n_elems, member_ids, method, self.wire,
+                    self.transport.chunk_bytes, kw_fn=kw_fn,
+                    codec=self.mesh_codec,
+                    telemetry=self.telemetry,
+                )
+                # Fold every pre-arming parked buffer; fed entries drop
+                # their dense copy — the aggregator owns that mass now.
+                for k, (w_k, b_k) in [
+                    (k, c) for k, c in st.contribs.items()
+                    if c[1] is not None and c[1] is not STREAMED
+                    and c[1].size == n_elems
+                ]:
+                    fed = await asyncio.to_thread(st.stream.add_dense, k[0], w_k, b_k)
+                    if fed:
+                        st.contribs[k] = (w_k, STREAMED)
+        except BaseException:
+            if arm_span is not None:
+                arm_span.end(ok=False)
+            raise
+        if arm_span is not None:
+            arm_span.end(streaming=st.stream is not None)
         return st
 
     async def _lead_round(
@@ -1879,6 +2032,12 @@ class SyncAverager(AveragerBase):
                 st, timeout=min(5.0, self._deadline_wait(group))
             )
             await self._phase("mid_stream")
+        # FOLD phase: the gather wait plus the streaming pipeline's commit
+        # tail (close open windows, await in-flight tile jobs, re-normalize).
+        fold_sp = self.telemetry.tracer.start(
+            "fold", trace=group.epoch, role="leader", gen=group.gen
+        )
+        commit_sp = None
         try:
             try:
                 # The group DEADLINE bounds the gather: begin fan-out time
@@ -1952,6 +2111,12 @@ class SyncAverager(AveragerBase):
             }
             self._last_outcomes_epoch = group.epoch
             if len(good) < self.min_group:
+                if fold_sp is not None:
+                    fold_sp.end(ok=False, arrived=len(good))
+                self.telemetry.event(
+                    "round_failed", epoch=group.epoch,
+                    reason=f"leader skipped: {len(good)}/{self.min_group} contributions",
+                )
                 self.rounds_skipped += 1
                 # Fail members' pending fetches fast, then free the buffers.
                 st.result_ready.set()  # with st.result None -> fetch raises
@@ -2003,6 +2168,15 @@ class SyncAverager(AveragerBase):
                 # (members' fetches park on result_ready; heartbeats must
                 # keep flowing).
                 st.result = await asyncio.to_thread(_aggregate)
+            if fold_sp is not None:
+                fold_sp.end(
+                    ok=True, arrived=len(peers),
+                    expected=len(st.expected),
+                    degraded=self._round_degraded,
+                )
+            commit_sp = self.telemetry.tracer.start(
+                "commit", trace=group.epoch, role="leader", gen=group.gen
+            )
             # Encode the wire form ONCE before releasing the fetch waiters.
             if self.wire == "powersgd" and method == "mean":
                 # Serve the EXACT factored mean (concatenated weighted
@@ -2044,6 +2218,8 @@ class SyncAverager(AveragerBase):
                 st.result_wire = await self._encode_wire(st.result)
             await self._phase("pre_fetch")
             st.result_ready.set()
+            if commit_sp is not None:
+                commit_sp.end(wire=self.wire)
             self.rounds_ok += 1
             # Keep state around long enough for members to fetch.
             asyncio.get_running_loop().call_later(
@@ -2051,6 +2227,13 @@ class SyncAverager(AveragerBase):
             )
             return self._unpack(st.result)
         except Exception:
+            # Idempotent ends: whichever phase the failure interrupted is
+            # the one still open — record it ok=False instead of dropping
+            # exactly the span a post-mortem needs.
+            if fold_sp is not None:
+                fold_sp.end(ok=False)
+            if commit_sp is not None:
+                commit_sp.end(ok=False)
             failed = self._rounds.pop(group.epoch, None)
             if failed is not None:
                 self._release_round(failed)
@@ -2080,17 +2263,24 @@ class SyncAverager(AveragerBase):
         re-pushes exactly the bytes this round compressed, with no second
         error-feedback staging."""
         leader_id, leader_addr = group.members[0]
+        tele = self.telemetry
         try:
-            await self._push_contribution(leader_addr, group, weight, wire_bytes)
-            return await self._fetch_round_result(leader_addr, leader_id, group)
+            # WIRE phase: the push leg (encode overlapped with send on
+            # StreamPayload wires); FETCH parks on the leader's commit
+            # point by design, so its span brackets the leader's fold.
+            with tele.span("wire", role="member", leader=leader_id, gen=group.gen):
+                await self._push_contribution(leader_addr, group, weight, wire_bytes)
+            with tele.span("fetch", role="member", leader=leader_id, gen=group.gen):
+                return await self._fetch_round_result(leader_addr, leader_id, group)
         except _LeaderDown as e:
             log.warning(
                 "sync round: leader %s down (%s); attempting failover recovery",
                 leader_id, e,
             )
-            return await self._recover_round(
-                group, weight, wire_bytes, dense_fn, reason=str(e)
-            )
+            with tele.span("recover", role="member", deposed=leader_id, gen=group.gen):
+                return await self._recover_round(
+                    group, weight, wire_bytes, dense_fn, reason=str(e)
+                )
 
     async def _push_contribution(
         self, leader_addr, group: Group, weight: float, wire_bytes
@@ -2236,6 +2426,7 @@ class SyncAverager(AveragerBase):
         flaky outbound link (a dropped call in a 2-peer swarm) must not
         blacklist a healthy leader for the whole strike window."""
         log.warning("sync round: deposing leader %s (%s)", leader_id, reason)
+        self.telemetry.event("leader_deposed", leader=leader_id, reason=reason)
         self.leaders_deposed += 1
         if self.failure_detector is not None:
             self.failure_detector.report_failure(leader_id)
@@ -2315,9 +2506,17 @@ class SyncAverager(AveragerBase):
             raise
         if result is None:
             self.recoveries_failed += 1
+            self.telemetry.event(
+                "recovery_failed", epoch=group.epoch, gen=gen,
+                deposed=deposed_id, reason="recovery round skipped",
+            )
             return None
         dt = time.monotonic() - t_rec
         self.rounds_recovered += 1
+        self.telemetry.event(
+            "round_recovered", epoch=group.epoch, gen=gen,
+            deposed=deposed_id, successor=successor, dt_s=round(dt, 3),
+        )
         self._recovery_lat_last = dt
         self._recovery_lat_ewma = (
             dt if self._recovery_lat_ewma is None

@@ -63,6 +63,7 @@ from distributedvolunteercomputing_tpu.swarm.dht import (
     key_id,
 )
 from distributedvolunteercomputing_tpu.swarm.membership import PEERS_KEY
+from distributedvolunteercomputing_tpu.swarm import telemetry as telemetry_mod
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, Transport
 from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
@@ -140,6 +141,7 @@ class ControlPlaneReplica:
         rid: Optional[str] = None,
         interval: Optional[float] = None,
         metrics_path: Optional[str] = None,
+        telemetry=None,
     ):
         self.transport = transport
         self.dht = dht
@@ -210,6 +212,20 @@ class ControlPlaneReplica:
         transport.register("cp.exchange", self._rpc_exchange)
         transport.register("cp.rendezvous", self._rpc_rendezvous)
         transport.register("cp.ping", self._rpc_ping)
+        # Replica-side telemetry: the load counters re-register into a
+        # scrapeable registry, and the telemetry.* debug RPCs answer on
+        # the replica's transport too (a coordinator is also a fleet
+        # member). A volunteer hosting a replica passes its OWN bundle —
+        # the shared transport already serves that bundle's RPCs, so the
+        # replica source lands in the registry every scrape reaches (and
+        # honors the host's --no-telemetry); a standalone coordinator
+        # gets a private bundle plus the RPC registration.
+        if telemetry is not None:
+            self.telemetry = telemetry
+        else:
+            self.telemetry = telemetry_mod.Telemetry(peer_id=self.rid)
+            self.telemetry.register_rpcs(transport)
+        self.telemetry.registry.source("control_plane.replica", self.stats)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -841,6 +857,11 @@ class ControlPlaneReplica:
             # Rotating group-schedule rollup (None until some volunteer
             # reports multi-group gauges).
             "multigroup": multigroup,
+            # Telemetry-plane rollup (versioned; None until some volunteer
+            # reports a telemetry summary): per-span count/sum merged
+            # swarm-wide plus every reporter's verbatim summary — the
+            # schema tests/test_telemetry.py pins per version.
+            "telemetry": telemetry_mod.rollup_status(fresh),
             "alive": alive,
             "n_alive": len(alive),
             "swarm_samples_per_sec": agg_sps,

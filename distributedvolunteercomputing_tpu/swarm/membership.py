@@ -44,6 +44,7 @@ class SwarmMembership:
         bandwidth_source=None,
         control_plane=None,
         report_source=None,
+        telemetry=None,
     ):
         self.dht = dht
         self.peer_id = peer_id
@@ -76,6 +77,21 @@ class SwarmMembership:
         self.last_beat_batched = False
         self.msgs_last_beat = 0
         self._msgs_ewma: Optional[float] = None
+        # Telemetry plane (swarm/telemetry.py): per-beat control traffic
+        # lands in the unified registry — beats and messages as labeled
+        # counters (msgs_total/beats_total = the live mean the batching
+        # claim rides on; the registry's histograms keep duration-scaled
+        # buckets, so a message COUNT belongs in a counter, not there).
+        # The volunteer report's telemetry SUMMARY rides the batched
+        # exchange itself via report_source; this is the beat-side half.
+        self._beat_ctr = self._beat_msgs_ctr = None
+        if telemetry is not None and getattr(telemetry, "enabled", False):
+            self._beat_ctr = telemetry.registry.counter(
+                "swarm.beats_total", "heartbeat intervals by path"
+            )
+            self._beat_msgs_ctr = telemetry.registry.counter(
+                "swarm.beat_msgs_total", "control messages spent across beats"
+            )
         # Callable returning this node's measured-bandwidth advertisement
         # fields (Transport.bandwidth_advertisement: {"bw_up": bps,
         # "bw_down": bps}, {} when nothing fresh) — re-evaluated on EVERY
@@ -254,6 +270,10 @@ class SwarmMembership:
             if self._msgs_ewma is None
             else (1 - a) * self._msgs_ewma + a * self.msgs_last_beat
         )
+        if self._beat_ctr is not None:
+            path = "batched" if batched else "direct"
+            self._beat_ctr.inc(path=path)
+            self._beat_msgs_ctr.inc(float(self.msgs_last_beat), path=path)
 
     async def _heartbeat_loop(self) -> None:
         # Re-announce at TTL/3: two missed beats still leave the record live.

@@ -76,6 +76,7 @@ class ResiliencePolicy:
         escalate_rejections: float = 3.0,
         failure_detector=None,
         clock=time.monotonic,
+        recorder=None,
     ):
         if min_deadline_s <= 0 or max_deadline_s < min_deadline_s:
             raise ValueError(
@@ -95,6 +96,12 @@ class ResiliencePolicy:
         self.escalate_rejections = float(escalate_rejections)
         self.failure_detector = failure_detector
         self.clock = clock
+        # Flight recorder (swarm/telemetry.py FlightRecorder, or anything
+        # with .record(kind, **fields)): escalation/backoff transitions are
+        # exactly the events a chaos post-mortem wants timestamped. The
+        # averager attaches its telemetry bundle's recorder when one isn't
+        # supplied; None = transitions are logged only.
+        self.recorder = recorder
         self.peers: Dict[str, PeerOutcomes] = {}
         # Adaptive-deadline estimate over COMPLETE (non-degraded) rounds.
         self._rt_ewma: Optional[float] = None
@@ -346,13 +353,28 @@ class ResiliencePolicy:
                 "resilience: escalating aggregation to %s "
                 "(peer rejection score %.1f)", _METHOD_LADDER[level], worst,
             )
+            self._record_event(
+                "method_escalated",
+                method=_METHOD_LADDER[level],
+                rejection_score=round(worst, 2),
+            )
             self._method_level = level
         elif level < self._method_level and worst < 0.5:
             # De-escalate only once the evidence has decayed away entirely —
             # flapping between estimators round-to-round helps nobody.
             log.info("resilience: rejection evidence decayed; back to %s",
                      _METHOD_LADDER[level])
+            self._record_event(
+                "method_deescalated", method=_METHOD_LADDER[level]
+            )
             self._method_level = level
+
+    def _record_event(self, kind: str, **fields) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.record(kind, **fields)
+            except Exception:  # noqa: BLE001 — recording must not affect policy
+                pass
 
     def recommend_method(self, configured: str) -> str:
         """Estimator to aggregate with THIS round. Only ever escalates an

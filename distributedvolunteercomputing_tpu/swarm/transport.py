@@ -95,6 +95,7 @@ from typing import (
     Union,
 )
 
+from distributedvolunteercomputing_tpu.swarm import telemetry
 from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
@@ -1071,6 +1072,15 @@ class Transport:
         the client vanished (its call timed out / conn dropped) — the
         handler's state effects stand, the response is simply lost, exactly
         as with the old per-call connections."""
+        # Round-trace propagation (swarm/telemetry.py): the caller's
+        # ambient trace id rides the MAC'd frame meta (``tr``); restoring
+        # it around this handler task is what lets a leader's handler-side
+        # spans and flight events stitch into the member's round trace
+        # without any new RPC.
+        tr = meta.get("tr")
+        tr_token = (
+            telemetry.set_current_trace(tr) if isinstance(tr, str) and tr else None
+        )
         try:
             method = meta.get("method", "")
             rid = meta.get("rid", "")
@@ -1113,6 +1123,8 @@ class Transport:
         except Exception as e:  # noqa: BLE001 — a request task must never die loudly
             log.debug("request task failed: %s", errstr(e))
         finally:
+            if tr_token is not None:
+                telemetry.reset_current_trace(tr_token)
             sem.release()
 
     # -- client ------------------------------------------------------------
@@ -1205,6 +1217,16 @@ class Transport:
             conn.sinks[rid] = chunk_sink
         t0 = time.monotonic()
         started: list = []
+        req_meta = {
+            "rid": rid, "method": method, "args": args or {},
+            "dst": [addr[0], addr[1]],
+        }
+        # Ambient round-trace id (swarm/telemetry.py) rides the frame meta:
+        # the server half restores it around the handler, stitching the
+        # remote spans into this round's trace with zero extra RPCs.
+        tr = telemetry.current_trace()
+        if tr:
+            req_meta["tr"] = tr
         try:
             try:
                 # dst (the dialed address) rides inside the MAC'd meta so an
@@ -1212,9 +1234,7 @@ class Transport:
                 # sent to (see module doc: cross-node replay).
                 await self._write_message(
                     conn.writer, conn.wlock, TYPE_REQ,
-                    {"rid": rid, "method": method, "args": args or {},
-                     "dst": [addr[0], addr[1]]},
-                    payload, peer=addr, started=started,
+                    req_meta, payload, peer=addr, started=started,
                 )
             except BaseException:
                 # A failure (or cancellation) mid-write leaves the

@@ -203,6 +203,12 @@ class VolunteerConfig:
     # would carry the name "byzantine" with ZERO robustness. Refused unless
     # this flag says the caller understands that trade.
     allow_unrobust_topk: bool = False
+    # Telemetry plane (swarm/telemetry.py): round tracing, unified metrics
+    # registry, flight recorder, and the telemetry.* debug RPCs. On by
+    # default (the record paths are ring-buffer appends; the overhead smoke
+    # in tests/test_telemetry.py bounds the cost at <5% of commit latency);
+    # --no-telemetry turns every record path into a no-op.
+    telemetry: bool = True
 
     def __post_init__(self):
         if not self.peer_id:
@@ -418,6 +424,28 @@ def _parse_addrs(spec: Optional[str]) -> list:
 class Volunteer:
     def __init__(self, cfg: VolunteerConfig):
         self.cfg = cfg
+        # Telemetry plane: one bundle per volunteer process, shared by the
+        # averager, membership, resilience policy, and mesh codec. Built
+        # first so every later subsystem can register into it; adopts the
+        # ClockSync-corrected clock once one exists (start()).
+        from distributedvolunteercomputing_tpu.swarm.telemetry import Telemetry
+
+        self.telemetry = Telemetry(
+            peer_id=cfg.peer_id, enabled=cfg.telemetry
+        )
+        # Structured-log identity: with DVC_LOG_JSON=1 every line this
+        # process emits carries who/where, join-able against traces.
+        # First volunteer wins — the fields are process-global, and in a
+        # multi-volunteer test process a later construction must not
+        # relabel earlier volunteers' lines (round-scoped lines always
+        # carry the exact peer via the averager's ambient log_context).
+        from distributedvolunteercomputing_tpu.utils.logging import (
+            current_log_context,
+            set_log_fields,
+        )
+
+        if "peer" not in current_log_context():
+            set_log_fields(peer=cfg.peer_id, zone=cfg.zone or None)
         self.transport = Transport(
             cfg.host, cfg.port, advertise_host=cfg.advertise_host,
             secret=read_secret(cfg.secret_file),
@@ -486,6 +514,10 @@ class Volunteer:
         # DVC_ASYNC_DEBUG=1: loop stall/race detectors (stopped at teardown)
         self._loop_monitor = maybe_enable_from_env()
         await self.transport.start()
+        # Debug/collection surface: telemetry.scrape / telemetry.trace /
+        # telemetry.flight answer on this volunteer's transport (operators
+        # and experiments/trace_report.py dial them directly).
+        self.telemetry.register_rpcs(self.transport)
         bootstrap = _parse_addrs(self.cfg.coordinator) or None
         await self.dht.start(bootstrap=bootstrap)
         from distributedvolunteercomputing_tpu.swarm.control_plane import (
@@ -506,7 +538,9 @@ class Volunteer:
             # This volunteer is an election candidate for the replicated
             # control plane: it serves status/exchange traffic and owns a
             # key range when elected into the active set.
-            self.replica = ControlPlaneReplica(self.transport, self.dht)
+            self.replica = ControlPlaneReplica(
+                self.transport, self.dht, telemetry=self.telemetry
+            )
             await self.replica.start()
         if self.cfg.resilience:
             # Resilience layer: phi-accrual liveness fed by membership
@@ -535,6 +569,8 @@ class Volunteer:
                 min_deadline_s=min(2.0, float(self.cfg.gather_timeout)),
                 initial_deadline_s=self.cfg.round_deadline_s or None,
                 failure_detector=self.failure_detector,
+                # Escalation/backoff transitions land in the flight recorder.
+                recorder=self.telemetry.recorder,
             )
         extra_info = {
             "model": self.cfg.model,
@@ -564,6 +600,7 @@ class Volunteer:
             # while any replica is reachable (direct DHT fallback per beat).
             control_plane=self.control_plane,
             report_source=self._build_report,
+            telemetry=self.telemetry,
         )
         await self.membership.join()
         if self.cfg.average_interval_s > 0:
@@ -581,6 +618,9 @@ class Volunteer:
             # arms must already be on swarm time.
             await self.clocksync.estimate()
             self.clocksync.start(interval_s=max(self.cfg.heartbeat_ttl, 15.0))
+            # Span timestamps align to swarm-consensus time: cross-volunteer
+            # traces stitch even when volunteer clocks are skewed.
+            self.telemetry.set_clock(self.clocksync.now)
         if self.cfg.averaging != "none":
             kw = dict(
                 min_group=self.cfg.min_group,
@@ -604,6 +644,9 @@ class Volunteer:
                 # plane's micro-cache when a replica answers (direct DHT
                 # fallback otherwise).
                 control_plane=self.control_plane,
+                # Shared telemetry bundle: round spans, the unified metrics
+                # registry, and the flight recorder all live here.
+                telemetry=self.telemetry,
             )
             if self.cfg.group_size:
                 from distributedvolunteercomputing_tpu.swarm.matchmaking import (
@@ -704,6 +747,8 @@ class Volunteer:
         from distributedvolunteercomputing_tpu.ops import mesh_codec as mesh_codec_mod
 
         codec = mesh_codec_mod.configure(mesh=mesh, backend=self.cfg.mesh_codec)
+        # Slice-loss degrades land in this volunteer's flight recorder.
+        codec.recorder = self.telemetry.recorder
         log.info(
             "swarm data path: %s backend (mesh=%s)",
             codec.backend, self.cfg.mesh or "single-device",
@@ -857,6 +902,12 @@ class Volunteer:
             # failure mid-run shows up in coord.status as
             # backend=host/configured=mesh while training continues.
             report["mesh_codec"] = self.averager.mesh_codec.stats()
+        if self.telemetry.enabled:
+            # Compact telemetry summary (schema version, per-span count/sum
+            # pairs, flight-recorder high-water): rides the batched
+            # cp.exchange beat via report_source and is rolled up by the
+            # control-plane replicas into coord.status["telemetry"].
+            report["telemetry"] = self.telemetry.summary()
         if (
             self.averager is not None
             and getattr(self.averager, "group_schedule", None) is not None

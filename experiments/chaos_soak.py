@@ -319,6 +319,7 @@ async def campaign(args):
         print(f"[faulted/blocking] mean round {mean_blocking:.2f}s vs "
               f"deadline-bounded {mean_deadline:.2f}s "
               f"({out['round_time_ratio_blocking_over_deadline']}x)")
+        out["flight_recorders"] = _flight_dumps(vols)
     finally:
         for v in vols:
             try:
@@ -411,6 +412,21 @@ async def _timed_average(v, i, r):
     return time.monotonic() - t0, res
 
 
+def _flight_dumps(vols, max_events: int = 200) -> dict:
+    """Per-volunteer flight-recorder dumps (swarm/telemetry.py) attached to
+    every campaign artifact: a failed verdict ships its own post-mortem —
+    depositions, fence rejections, degrades, backoff transitions — instead
+    of asking the operator to reproduce the run with more logging."""
+    out = {}
+    for v in vols:
+        avg = v.get("avg")
+        if avg is None or getattr(avg, "telemetry", None) is None:
+            continue
+        events = avg.telemetry.recorder.dump()
+        out[v["pid"]] = events[-max_events:]
+    return out
+
+
 async def failover_campaign(args):
     gather_timeout = 8.0
     out = {
@@ -468,6 +484,7 @@ async def failover_campaign(args):
                 })
                 await _revive_leader(vols)
                 await asyncio.sleep(0.3)  # let the re-announce settle
+            flight = _flight_dumps(vols)
         finally:
             for v in vols:
                 try:
@@ -496,6 +513,9 @@ async def failover_campaign(args):
             "within_stall_bound": len(within),
             "overhead_allowance_s": round(overhead, 3),
             "per_round": recs,
+            # Post-mortem evidence: every survivor's flight-recorder ring
+            # (leader_deposed / round_recovered / fence_rejected events).
+            "flight_recorders": flight,
         }
         print(f"[failover/{phase}] {len(ok)}/{len(recs)} rounds committed "
               f"via recovery, {len(within)}/{len(recs)} within stall bound")
@@ -555,6 +575,9 @@ async def fencing_scenario():
             except RPCError as e:
                 res["stale_push_rejected"] = "fencing mismatch" in str(e)
     finally:
+        # The fencing proof's own post-mortem: the successor's recorder
+        # shows the fence_rejected events the assertions above rode on.
+        res["flight_recorders"] = _flight_dumps(vols)
         for v in vols:
             try:
                 await v["mem"].leave()
@@ -782,6 +805,7 @@ async def multigroup_campaign(args):
             "burst_rounds": sum(1 for r in recs if r["after_join_burst"]),
             "max_groups_seen": max(r["n_groups"] for r in recs),
         }
+        out["flight_recorders"] = _flight_dumps(vols)
     finally:
         for v in vols:
             try:
@@ -1030,6 +1054,7 @@ async def controlplane_campaign(args):
             ),
             "rollup_ok_rounds": sum(r["status_rollup_ok"] for r in recs),
         }
+        out["flight_recorders"] = _flight_dumps(vols)
     finally:
         for v in vols:
             try:

@@ -1,0 +1,497 @@
+#!/usr/bin/env python
+"""Trace report: per-round critical-path breakdown from a live swarm.
+
+Runs three REAL multi-process scenarios (volunteers sharded over worker
+subprocesses, one DHT over localhost TCP — the group_scale_bench layout),
+collects every volunteer's round spans via the ``telemetry.trace`` RPC,
+stitches them by trace id (the round key: matchmaking epoch), and emits a
+per-round breakdown of where the wall time went:
+
+  committed  — 4 volunteers, plain sync rounds. Leader vantage:
+               join -> arm -> encode -> fold -> commit must sum to ~the
+               round's wall time (the acceptance bar: coverage >= the
+               verdict threshold).
+  recovered  — the leader (a0, sorts first, isolated in its own worker)
+               SIGKILLs itself mid-stream (DVC_CHAOS_LEADER_DIE_PHASE);
+               survivors depose it and commit via a fenced recovery round.
+               Member vantage: join -> encode -> wire -> fetch -> recover,
+               plus the survivors' flight-recorder events
+               (leader_deposed / round_recovered) attached as post-mortem.
+  cross_zone — 6 volunteers in 2 zones under the hierarchical schedule
+               (cross_zone_every_k=2): intra- and cross-zone rounds appear
+               in the same report, labeled by the round span's level attr.
+
+Artifact: experiments/results/trace_report.json (committed). This is the
+observability the benches previously asserted blind: when a bench says
+"commit latency is X", the report says which phase it lives in.
+
+Usage:
+    python experiments/trace_report.py            # full campaign
+    python experiments/trace_report.py --quick    # fewer rounds
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from distributedvolunteercomputing_tpu.swarm import telemetry as telemetry_mod  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.matchmaking import GroupSchedule  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.transport import Transport  # noqa: E402
+
+TREE_ELEMS = 300_000  # ~1.2 MB f32 per contribution: chunked wire, fast rounds
+RESULTS = os.path.join(REPO, "experiments", "results")
+
+# Leader-vantage phases, protocol order: sequential by construction in
+# SyncAverager.average, so their sum bounds the round wall from below.
+LEADER_PHASES = ("join", "arm", "encode", "fold", "commit")
+# Member vantage (the recovered scenario reports from a survivor).
+MEMBER_PHASES = ("join", "encode", "wire", "fetch", "recover")
+
+
+def _tree(i: int):
+    return {"w": np.full((TREE_ELEMS,), float(i % 5), np.float32)}
+
+
+# -- worker half -------------------------------------------------------------
+
+
+async def _worker_main(args) -> None:
+    pids = args.pids.split(",")
+    boot = tuple(args.boot.split(":"))
+    boot = (boot[0], int(boot[1]))
+    vols = []
+    for pid in pids:
+        t = Transport()
+        dht = DHTNode(t, maintenance_interval=120.0)
+        await dht.start(bootstrap=[boot])
+        extra = {"zone": args.zone} if args.zone else None
+        mem = SwarmMembership(dht, pid, ttl=30.0, extra_info=extra)
+        await mem.join()
+        schedule = None
+        if args.group_size:
+            schedule = GroupSchedule(
+                target_size=args.group_size,
+                rotation_s=args.rotation_s,
+                min_size=2,
+                cross_zone_every_k=args.cross_zone_every_k,
+            )
+        avg = SyncAverager(
+            t, dht, mem,
+            min_group=2, max_group=args.max_group,
+            join_timeout=8.0, gather_timeout=12.0,
+            group_schedule=schedule,
+        )
+        avg.telemetry.register_rpcs(t)
+        vols.append({"pid": pid, "t": t, "dht": dht, "mem": mem, "avg": avg})
+    print(
+        "WORKER_ADDRS "
+        + json.dumps({v["pid"]: list(v["t"].addr) for v in vols}),
+        flush=True,
+    )
+    # Synchronized start: the driver sends "GO <start_at>" on stdin once
+    # EVERY worker has advertised (jax import time varies by tens of
+    # seconds under sandbox load, so a spawn-time timestamp would skew
+    # round rendezvous past the join timeout).
+    line = await asyncio.to_thread(sys.stdin.readline)
+    try:
+        start_at = float(line.split()[1])
+    except (IndexError, ValueError):
+        start_at = time.time()
+    delay = start_at - time.time()
+    if delay > 0:
+        await asyncio.sleep(delay)
+    for r in range(args.rounds):
+        res = await asyncio.gather(
+            *(
+                asyncio.wait_for(
+                    v["avg"].average(_tree(i), round_no=r), timeout=60.0
+                )
+                for i, v in enumerate(vols)
+            ),
+            return_exceptions=True,
+        )
+        ok = sum(1 for x in res if x is not None and not isinstance(x, BaseException))
+        print(f"WORKER_ROUND {r} ok={ok}/{len(vols)}", flush=True)
+        if args.round_gap_s:
+            await asyncio.sleep(args.round_gap_s)
+    print("WORKER_DONE", flush=True)
+    # Stay alive for the driver's telemetry.trace scrapes; the driver
+    # SIGTERMs us when it has what it needs.
+    try:
+        await asyncio.sleep(120.0)
+    finally:
+        for v in vols:
+            try:
+                await v["mem"].leave()
+            except Exception:
+                pass
+            try:
+                await v["dht"].stop()
+            except Exception:
+                pass
+            await v["t"].close()
+
+
+# -- driver half -------------------------------------------------------------
+
+
+def _spawn_worker(extra, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"] + extra,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env, cwd=REPO,
+    )
+
+
+def _read_until(proc, tag, timeout=120.0):
+    """Read worker stdout lines until one starts with ``tag`` (returned
+    without the tag) or the process dies/timeout expires (returns None)."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        line = proc.stdout.readline()
+        if not line:
+            return None
+        line = line.strip()
+        if line.startswith(tag):
+            return line[len(tag):].strip()
+    return None
+
+
+async def _collect_spans(addrs, timeout=8.0):
+    """Dial every (live) volunteer's telemetry.trace RPC; dead volunteers
+    (the killed leader) simply contribute nothing."""
+    t = Transport()
+    spans, flights = [], {}
+    try:
+        for pid, addr in addrs.items():
+            addr = (addr[0], int(addr[1]))
+            try:
+                ret, _ = await t.call(
+                    addr, telemetry_mod.TRACE_METHOD, {}, b"",
+                    timeout=timeout, connect_timeout=2.0,
+                )
+                spans.extend(ret.get("spans") or [])
+                ret, _ = await t.call(
+                    addr, telemetry_mod.FLIGHT_METHOD, {}, b"",
+                    timeout=timeout, connect_timeout=2.0,
+                )
+                flights[pid] = ret.get("events") or []
+            except Exception as e:  # noqa: BLE001 — a dead volunteer is expected here
+                print(f"  (no telemetry from {pid}: {type(e).__name__})")
+    finally:
+        await t.close()
+    return spans, flights
+
+
+def _phase_durs(spans, phases):
+    """name -> summed duration over this vantage's spans (fold.push and
+    repeated attempts merge by sum — the phase's total residency)."""
+    out = {}
+    for s in spans:
+        if s["name"] in phases and s.get("dur_s") is not None:
+            out[s["name"]] = round(out.get(s["name"], 0.0) + s["dur_s"], 6)
+    return out
+
+
+def _breakdown(all_spans):
+    """Stitch spans by trace id and emit one record per round that has a
+    root 'round' span; coverage = sum(vantage phases)/wall from the
+    vantage (leader when one committed, else the first member) whose
+    phases are sequential by construction."""
+    by_trace = {}
+    for s in all_spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+    rounds = []
+    for trace, spans in by_trace.items():
+        roots = [s for s in spans if s["name"] == "round"]
+        if not roots:
+            continue
+        leader_roots = [
+            s for s in roots if (s.get("attrs") or {}).get("role") == "leader"
+        ]
+        root = leader_roots[0] if leader_roots else roots[0]
+        attrs = root.get("attrs") or {}
+        vantage_peer = root["peer"]
+        vantage = "leader" if leader_roots else "member"
+        mine = [s for s in spans if s["peer"] == vantage_peer]
+        phases = _phase_durs(
+            mine, LEADER_PHASES if vantage == "leader" else MEMBER_PHASES
+        )
+        wall = root["dur_s"] or 0.0
+        covered = sum(phases.values())
+        recovered = any(s["name"] == "recover" for s in spans)
+        rounds.append({
+            "trace": trace,
+            "key": attrs.get("key"),
+            "level": attrs.get("level", "flat"),
+            "ok": bool(attrs.get("ok")),
+            "recovered": recovered,
+            "vantage": vantage,
+            "vantage_peer": vantage_peer,
+            "n_peers_traced": len({s["peer"] for s in spans}),
+            "wall_s": round(wall, 6),
+            "phases_s": phases,
+            "coverage": round(covered / wall, 4) if wall > 0 else None,
+            "members": {
+                "wire_mean_s": _mean(
+                    [s["dur_s"] for s in spans if s["name"] == "wire"]
+                ),
+                "fetch_mean_s": _mean(
+                    [s["dur_s"] for s in spans if s["name"] == "fetch"]
+                ),
+            },
+        })
+    rounds.sort(key=lambda r: r["trace"])
+    return rounds
+
+
+def _mean(xs):
+    xs = [x for x in xs if x is not None]
+    return round(sum(xs) / len(xs), 6) if xs else None
+
+
+async def _run_scenario(name, workers, rounds, expect_addrs, scrape_grace=2.0):
+    """Spawn the driver-side bootstrap DHT + the worker fleet, wait for the
+    rounds, scrape spans + flight recorders, tear everything down."""
+    boot_t = Transport()
+    boot_dht = DHTNode(boot_t)
+    await boot_dht.start(bootstrap=None)
+    boot = f"{boot_t.addr[0]}:{boot_t.addr[1]}"
+    procs = []
+    addrs = {}
+    try:
+        for spec in workers:
+            extra = [
+                "--pids", ",".join(spec["pids"]),
+                "--boot", boot,
+                "--rounds", str(rounds),
+                "--zone", spec.get("zone", ""),
+                "--group-size", str(spec.get("group_size", 0)),
+                "--rotation-s", str(spec.get("rotation_s", 3.0)),
+                "--cross-zone-every-k", str(spec.get("cross_zone_every_k", 0)),
+                "--max-group", str(spec.get("max_group", 16)),
+                "--round-gap-s", str(spec.get("round_gap_s", 0.0)),
+            ]
+            procs.append(
+                (_spawn_worker(extra, spec.get("env")), spec)
+            )
+        # Blocking pipe reads ride worker threads: the driver's own loop
+        # must stay free to serve the bootstrap DHT the workers join.
+        got_all = await asyncio.gather(
+            *(
+                asyncio.to_thread(_read_until, proc, "WORKER_ADDRS", 90.0)
+                for proc, _ in procs
+            )
+        )
+        for (proc, spec), got in zip(procs, got_all):
+            if got is None:
+                if spec.get("may_die"):
+                    continue
+                raise RuntimeError(f"{name}: worker {spec['pids']} never came up")
+            addrs.update({p: a for p, a in json.loads(got).items()})
+        missing = expect_addrs - set(addrs)
+        if missing:
+            raise RuntimeError(f"{name}: volunteers never advertised: {missing}")
+        start_at = time.time() + 3.0  # membership/announce settle margin
+        for proc, _ in procs:
+            try:
+                proc.stdin.write(f"GO {start_at}\n")
+                proc.stdin.flush()
+            except Exception:
+                pass
+        # Wait for round completion on workers that are expected to survive.
+        done_all = await asyncio.gather(
+            *(
+                asyncio.to_thread(_read_until, proc, "WORKER_DONE", 240.0)
+                for proc, spec in procs
+                if not spec.get("may_die")
+            )
+        )
+        for (proc, spec), done in zip(
+            [(p, s) for p, s in procs if not s.get("may_die")], done_all
+        ):
+            if done is None:
+                raise RuntimeError(f"{name}: worker {spec['pids']} died mid-campaign")
+        await asyncio.sleep(scrape_grace)  # let trailing spans land
+        spans, flights = await _collect_spans(addrs)
+    finally:
+        for proc, _ in procs:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except Exception:
+                pass
+        for proc, _ in procs:
+            try:
+                await asyncio.to_thread(proc.wait, 10.0)
+            except Exception:
+                proc.kill()
+        await boot_dht.stop()
+        await boot_t.close()
+    return spans, flights
+
+
+async def campaign(args):
+    rounds = 2 if args.quick else 4
+    out = {"schema_version": 1, "tree_elems": TREE_ELEMS, "scenarios": {}}
+
+    # -- committed: plain sync rounds, leader-vantage critical path --------
+    print("[committed] 4 volunteers / 2 workers ...")
+    spans, _ = await _run_scenario(
+        "committed",
+        [
+            {"pids": ["v0", "v1"]},
+            {"pids": ["v2", "v3"]},
+        ],
+        rounds,
+        expect_addrs={"v0", "v1", "v2", "v3"},
+    )
+    recs = [r for r in _breakdown(spans) if r["ok"]]
+    lead = [r for r in recs if r["vantage"] == "leader"]
+    out["scenarios"]["committed"] = {
+        "rounds": recs,
+        "committed_rounds": len(lead),
+        "coverage_min": min((r["coverage"] for r in lead), default=None),
+        "phase_means_s": {
+            p: _mean([r["phases_s"].get(p) for r in lead]) for p in LEADER_PHASES
+        },
+    }
+    print(f"[committed] {len(lead)} leader-vantage rounds, coverage_min="
+          f"{out['scenarios']['committed']['coverage_min']}")
+
+    # -- recovered: leader SIGKILL mid-stream, survivors' vantage ----------
+    print("[recovered] leader a0 dies mid_stream ...")
+    spans, flights = await _run_scenario(
+        "recovered",
+        [
+            {
+                "pids": ["a0"], "may_die": True,
+                "env": {"DVC_CHAOS_LEADER_DIE_PHASE": "mid_stream"},
+            },
+            {"pids": ["v1", "v2", "v3"]},
+        ],
+        1,
+        expect_addrs={"v1", "v2", "v3"},
+    )
+    recs = _breakdown(spans)
+    recovered = [r for r in recs if r["recovered"] and r["ok"]]
+    out["scenarios"]["recovered"] = {
+        "rounds": recs,
+        "recovered_rounds": len(recovered),
+        "flight_events": {
+            pid: [
+                {k: e[k] for k in ("t", "kind") if k in e}
+                | {
+                    k: e[k]
+                    for k in ("leader", "successor", "gen", "reason")
+                    if k in e
+                }
+                for e in evs
+                if e["kind"] in (
+                    "leader_deposed", "round_recovered", "fence_rejected",
+                    "recovery_failed",
+                )
+            ]
+            for pid, evs in flights.items()
+        },
+    }
+    print(f"[recovered] {len(recovered)} rounds committed via recovery")
+
+    # -- cross_zone: hierarchical schedule, intra + cross rounds -----------
+    print("[cross_zone] 6 volunteers / 2 zones, cross_zone_every_k=2 ...")
+    zone_spec = {
+        "group_size": 3, "rotation_s": 3.0, "cross_zone_every_k": 2,
+        "max_group": 9, "round_gap_s": 1.0,
+    }
+    spans, _ = await _run_scenario(
+        "cross_zone",
+        [
+            dict(zone_spec, pids=["z0a", "z0b", "z0c"], zone="dc-a"),
+            dict(zone_spec, pids=["z1a", "z1b", "z1c"], zone="dc-b"),
+        ],
+        max(rounds, 4),
+        expect_addrs={"z0a", "z0b", "z0c", "z1a", "z1b", "z1c"},
+        scrape_grace=3.0,
+    )
+    recs = [r for r in _breakdown(spans) if r["ok"]]
+    levels = sorted({r["level"] for r in recs})
+    out["scenarios"]["cross_zone"] = {
+        "rounds": recs,
+        "levels_seen": levels,
+        "per_level_wall_mean_s": {
+            lv: _mean([r["wall_s"] for r in recs if r["level"] == lv])
+            for lv in levels
+        },
+    }
+    print(f"[cross_zone] {len(recs)} committed rounds, levels={levels}")
+
+    # -- verdict -----------------------------------------------------------
+    committed = out["scenarios"]["committed"]
+    cov = committed["coverage_min"]
+    out["verdict"] = {
+        # Leader-vantage phases are sequential by construction, so their
+        # sum must account for (nearly) the whole round wall; the slack is
+        # scheduler gaps between awaits on a loaded box.
+        "pass_committed_critical_path": bool(
+            committed["committed_rounds"] >= 1 and cov is not None and cov >= 0.8
+        ),
+        "pass_recovered_round_traced": bool(
+            out["scenarios"]["recovered"]["recovered_rounds"] >= 1
+        ),
+        "pass_cross_zone_levels": (
+            "cross" in out["scenarios"]["cross_zone"]["levels_seen"]
+            and "intra" in out["scenarios"]["cross_zone"]["levels_seen"]
+        ),
+    }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=os.path.join(RESULTS, "trace_report.json"))
+    # worker mode
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--pids", default="")
+    ap.add_argument("--boot", default="")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--zone", default="")
+    ap.add_argument("--group-size", type=int, default=0)
+    ap.add_argument("--rotation-s", type=float, default=3.0)
+    ap.add_argument("--cross-zone-every-k", type=int, default=0)
+    ap.add_argument("--max-group", type=int, default=16)
+    ap.add_argument("--round-gap-s", type=float, default=0.0)
+    args = ap.parse_args()
+    if args.worker:
+        asyncio.run(_worker_main(args))
+        return
+    result = asyncio.run(campaign(args))
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2)
+    print(json.dumps(result["verdict"], indent=2))
+    print(f"wrote {args.out}")
+    sys.exit(0 if all(result["verdict"].values()) else 1)
+
+
+if __name__ == "__main__":
+    main()

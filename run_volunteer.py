@@ -170,6 +170,11 @@ def main() -> None:
                     help="sequence-parallel implementation: ring (ppermute "
                          "K/V rotation, any head count) or ulysses "
                          "(all-to-all seq<->heads; needs n_heads %% sp == 0)")
+    ap.add_argument("--no-telemetry", action="store_true",
+                    help="disable the telemetry plane (round tracing, "
+                         "unified metrics registry, flight recorder): every "
+                         "record path becomes a no-op; the telemetry.* RPCs "
+                         "still answer with empty views")
     ap.add_argument("--host-replica", action="store_true",
                     help="host a control-plane replica on this volunteer: "
                          "serve coord.status and batched heartbeat/report "
@@ -328,6 +333,7 @@ def main() -> None:
         outer_optimizer=args.outer_optimizer,
         outer_lr=args.outer_lr,
         outer_momentum=args.outer_momentum,
+        telemetry=not args.no_telemetry,
     )
     if cfg.averaging != "none":
         # Build/load the native host core BEFORE the event loop exists: the

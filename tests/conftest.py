@@ -83,6 +83,14 @@ def pytest_configure(config):
         "cross-zone-bytes bench smoke) — in the default lane, and "
         "selectable on their own with -m hierarchy",
     )
+    config.addinivalue_line(
+        "markers",
+        "telemetry: telemetry-plane tests (metrics registry + scrape, "
+        "cross-volunteer round tracing / frame-meta trace propagation, "
+        "flight recorder, stats() snapshot semantics, coord.status "
+        "telemetry schema, structured JSONL logging, overhead smoke) — in "
+        "the default lane, and selectable on their own with -m telemetry",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
