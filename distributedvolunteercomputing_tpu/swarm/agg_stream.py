@@ -74,6 +74,7 @@ import numpy as np
 from distributedvolunteercomputing_tpu import native
 from distributedvolunteercomputing_tpu.ops import mesh_codec as mesh_codec_mod
 from distributedvolunteercomputing_tpu.ops import robust
+from distributedvolunteercomputing_tpu.swarm import health as health_mod
 from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
 log = get_logger(__name__)
@@ -277,6 +278,18 @@ class StreamingAggregator:
             if telemetry is not None and getattr(telemetry, "enabled", False)
             else None
         )
+        # Training-health layer (swarm/health.py): per-slot squared
+        # distance to the robust aggregate, accumulated tile-by-tile as
+        # windows close — the raw material for per-peer contribution-
+        # quality attribution. Needs per-peer rows next to the aggregate,
+        # so the mean mode (rows released on arrival) can't attribute.
+        health = getattr(telemetry, "health", None) if telemetry is not None else None
+        self._quality_on = bool(
+            health is not None
+            and getattr(health, "enabled", False)
+            and self.mode != "mean"
+        )
+        self._q_d2: Dict[int, float] = {}  # slot -> summed d² vs aggregate
 
         # -- gauges (surfaced via Averager.stats()/volunteer summary) ------
         self.t0 = time.monotonic()
@@ -620,6 +633,7 @@ class StreamingAggregator:
         t0 = time.perf_counter()
         e0 = tile * self.tile_elems
         n = min(self.tile_elems, self.n_elems - e0)
+        q: Optional[np.ndarray] = None
         try:
             if rows.size:
                 stack = win.buf[: len(self.slots) * self.tile_elems].reshape(
@@ -628,15 +642,25 @@ class StreamingAggregator:
                 kw = self._kw_fn(rows.size)
                 # On-mesh window fold when the codec is active (sorting
                 # network over the peer axis); ops.robust numpy otherwise.
-                self._out[e0 : e0 + n] = self.codec.aggregate(
+                agg = self.codec.aggregate(
                     np.ascontiguousarray(stack), self.method, **kw
                 )
+                self._out[e0 : e0 + n] = agg
+                if self._quality_on and rows.size >= 3:
+                    # Quality attribution: each arrived row's squared
+                    # distance to the tile's robust aggregate — one extra
+                    # O(rows·tile) pass next to the fold's sort, gated off
+                    # with the health probe.
+                    q = health_mod.row_d2(stack, agg)
         finally:
             dt = time.perf_counter() - t0
             if self._tile_hist is not None:
                 self._tile_hist.observe(dt, method=self.method)
             with self._lock:
                 self.busy_s += dt
+                if q is not None:
+                    for slot, d2 in zip(rows, q):
+                        self._q_d2[int(slot)] = self._q_d2.get(int(slot), 0.0) + float(d2)
                 self._note_free(win.buf.nbytes)
                 self.pool.put(win.buf)
 
@@ -732,6 +756,37 @@ class StreamingAggregator:
         with self._lock:
             return [self.slots[s] for s in sorted(self._sealed)]
 
+    def mass_report(self) -> dict:
+        """Balanced gradient-mass classification for this round (training-
+        health layer, swarm/health.py): every armed slot lands in exactly
+        one of included (sealed) / aborted (died mid-payload or tainted) /
+        excluded (never sealed by the freeze — late, partial, or silent),
+        with the weight it DECLARED (0 for a slot that never spoke — its
+        undelivered mass is unknowable to the leader, so it balances as
+        one excluded slot at weight 0). included + excluded + aborted
+        weight sums to the total armed weight by construction; the
+        property test exercises the classification across the deadline /
+        abort / fence matrix."""
+        with self._lock:
+            per_peer: Dict[str, dict] = {}
+            for slot, pid in enumerate(self.slots):
+                w = float(self._weights.get(slot, 0.0))
+                if slot in self._sealed:
+                    oc = "included"
+                elif slot in self._aborted or slot in self._tainted:
+                    oc = "aborted"
+                else:
+                    oc = "excluded"
+                per_peer[pid] = {"outcome": oc, "weight": w}
+        return health_mod.mass_report_from_per_peer(per_peer)
+
+    def quality_d2(self) -> Dict[str, float]:
+        """Per-peer summed squared distance to the committed aggregate
+        (accumulated across window tiles / the dense fold); empty when the
+        health probe is off or the method is ``mean``."""
+        with self._lock:
+            return {self.slots[s]: d2 for s, d2 in self._q_d2.items()}
+
     async def finalize(self, included: Optional[List[str]] = None) -> np.ndarray:
         """Freeze arrivals, close open windows over the arrived subsets,
         await in-flight tile jobs, and return the committed buffer; every
@@ -793,9 +848,13 @@ class StreamingAggregator:
                         stack = np.stack(
                             [self._resident[s][e0 : e0 + n] for s in rows]
                         )
-                        self._out[e0 : e0 + n] = self.codec.aggregate(
+                        agg = self.codec.aggregate(
                             stack, self.method, **self._kw_fn(len(rows))
                         )
+                        self._out[e0 : e0 + n] = agg
+                        if self._quality_on and len(rows) >= 3:
+                            for s, d2 in zip(rows, health_mod.row_d2(stack, agg)):
+                                self._q_d2[s] = self._q_d2.get(s, 0.0) + float(d2)
                         self._win_done[tile] = True
                         self.tiles_deadline += 1
                 return self._out
@@ -814,6 +873,12 @@ class StreamingAggregator:
             if self.mode == "d2_dense" and self._d2 is not None:
                 kw = dict(kw, d2=self._d2[np.ix_(slots, slots)].astype(np.float32))
             self._out = self.codec.aggregate(stack, self.method, **kw)
+            if self._quality_on and len(slots) >= 3:
+                # Dense-path quality attribution (krum/bulyan/geomedian/
+                # centered_clip): one O(n·D) distance pass against the
+                # aggregate the estimator just selected.
+                for s, d2 in zip(slots, health_mod.row_d2(stack, self._out)):
+                    self._q_d2[s] = self._q_d2.get(s, 0.0) + float(d2)
             return self._out
         finally:
             self.busy_s += time.perf_counter() - t0

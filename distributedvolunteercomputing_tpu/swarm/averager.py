@@ -74,6 +74,7 @@ from distributedvolunteercomputing_tpu.swarm.matchmaking import (
     Matchmaker,
 )
 from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm import health as health_mod
 from distributedvolunteercomputing_tpu.swarm import telemetry as telemetry_mod
 from distributedvolunteercomputing_tpu.swarm.transport import (
     Addr,
@@ -416,6 +417,47 @@ class AveragerBase:
             else telemetry_mod.Telemetry(peer_id=self.peer_id, clock=self.clock)
         )
         self._register_telemetry()
+        # Training-health layer (swarm/health.py): sketch seed fixed to the
+        # averaging namespace (every peer in a namespace projects into the
+        # SAME space), the zone joined from membership for the per-zone
+        # dispersion rollup, and quality flags surfaced into the membership
+        # record so the swarm can see who this vantage distrusts.
+        self.health = getattr(self.telemetry, "health", None)
+        if self.health is not None and self.health.enabled:
+            self.health.configure(self.namespace)
+            self.health.zone_fn = lambda: self.zone
+            if self.health.on_flag is None:
+                self.health.on_flag = self._surface_quality_flags
+
+    def _surface_quality_flags(self, flagged: List[str]) -> None:
+        """Carry this vantage's flagged-peer list in the next heartbeat
+        record (bounded: the flag set is a few ids)."""
+        update = getattr(self.membership, "update_info", None)
+        if update is not None:
+            update(health_flagged=list(flagged))
+
+    def _health_note_commit(
+        self,
+        buf: Optional[np.ndarray],
+        trace: str,
+        mass: Optional[dict] = None,
+        quality: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """One committed round's health bookkeeping (runs off the event
+        loop): per-peer quality votes, the balanced mass report, and the
+        post-round parameter sketch. Advisory — never fails the round."""
+        h = self.health
+        if h is None or not h.enabled:
+            return
+        try:
+            if quality:
+                h.observe_round_quality(quality, trace=trace)
+            if mass is not None:
+                h.note_round_mass(mass, trace=trace)
+            if buf is not None:
+                h.note_sketch(buf, trace=trace)
+        except Exception as e:  # noqa: BLE001 — health must never fail a round
+            log.debug("health commit bookkeeping failed: %s", errstr(e))
 
     def _register_telemetry(self) -> None:
         """Re-register the pre-existing stats() surfaces into the unified
@@ -1082,6 +1124,7 @@ class AveragerBase:
         factory re-iterates for the byzantine full-mesh fan-out (one lazy
         encoding per push, none of them materializing the whole buffer)."""
         if self.wire not in ("topk", "powersgd", "sign"):
+            self._note_codec_distortion(buf)
             if self.wire == "f32":
                 return self._wire_stream(buf), lambda: buf
             if self.wire == "bf16":
@@ -1113,7 +1156,43 @@ class AveragerBase:
             # exemption as the powersgd branch above.
             sent = native.topk_decode(wire, max_floats=buf.size)
         self._ef_pending = buf - sent
+        self._note_codec_distortion(buf, residual=self._ef_pending)
         return wire, lambda: sent
+
+    def _note_codec_distortion(
+        self, buf: np.ndarray, residual: Optional[np.ndarray] = None
+    ) -> None:
+        """Per-round relative compression error for the configured wire
+        (training-health layer): the EF-residual norm over the gradient
+        norm on the lossy wires — exactly the mass error feedback
+        re-stages — and a sampled round-trip estimate on bf16/q8 (f32 is
+        exact). The raw material for ranking wire formats by
+        convergence-per-byte (ROADMAP item 1)."""
+        h = self.health
+        if h is None or not h.enabled:
+            return
+        try:
+            if residual is not None:
+                den = float(np.linalg.norm(buf))
+                rel = float(np.linalg.norm(residual)) / den if den > 0 else 0.0
+                h.note_codec_error(self.wire, rel)
+                return
+            if self.wire == "f32":
+                h.note_codec_error("f32", 0.0)
+                return
+            s = buf[: min(buf.size, 65_536)]
+            if self.wire == "bf16":
+                mc = self.mesh_codec
+                rt = mc.decode_bf16(mc.encode_bf16(s))
+            elif self.wire == "q8":
+                rt = native.q8_decode(native.q8_encode(s))
+            else:
+                return
+            den = float(np.linalg.norm(s))
+            rel = float(np.linalg.norm(rt - s)) / den if den > 0 else 0.0
+            h.note_codec_error(self.wire, rel)
+        except Exception as e:  # noqa: BLE001 — a gauge bug must not fail the encode
+            log.debug("codec distortion gauge failed: %s", errstr(e))
 
     def _robust_kw(self, n_peers: int, method: Optional[str] = None) -> dict:
         """Estimator kwargs adjusted to THIS round's group size — shared by
@@ -2139,6 +2218,8 @@ class SyncAverager(AveragerBase):
             peers = sorted(good)
             st.included = peers
             method_kw = kw_fn(len(peers))
+            health_on = self.health is not None and self.health.enabled
+            dense_q: Dict[str, float] = {}
 
             def _aggregate() -> np.ndarray:
                 if method == "mean":
@@ -2153,7 +2234,14 @@ class SyncAverager(AveragerBase):
                         native.weighted_sum_inplace(acc, buf_p, w_p / total_w)
                     return acc
                 stack = np.stack([good[p][1] for p in peers])
-                return self.mesh_codec.aggregate(stack, method, **method_kw)
+                out = self.mesh_codec.aggregate(stack, method, **method_kw)
+                if health_on and len(peers) >= 3:
+                    # Quality attribution for the non-streaming wires
+                    # (q8/topk/powersgd/sign take this branch): the byz
+                    # flagging contract must not depend on the wire codec.
+                    for p, d2 in zip(peers, health_mod.row_d2(stack, out)):
+                        dense_q[p] = float(d2)
+                return out
 
             if st.stream is not None:
                 # The pipeline already decoded and (for mean/window methods)
@@ -2168,11 +2256,36 @@ class SyncAverager(AveragerBase):
                 # (members' fetches park on result_ready; heartbeats must
                 # keep flowing).
                 st.result = await asyncio.to_thread(_aggregate)
+            # Training-health: the balanced mass classification for this
+            # commit (streaming rounds classify per slot; dense rounds
+            # from the arrived-weight map) plus the per-peer quality
+            # distances the tile folds (or the dense branch above)
+            # accumulated. Gated on the health probe alone — under
+            # --no-health-probe NO health tally runs and the fold span
+            # carries no mass column, honoring the "disabled end-to-end"
+            # contract even while the rest of telemetry stays on.
+            mass = quality = None
+            if health_on:
+                mass = (
+                    st.stream.mass_report()
+                    if st.stream is not None
+                    else health_mod.mass_from_outcomes(
+                        st.expected, {p: float(good[p][0]) for p in good}
+                    )
+                )
+                quality = (
+                    st.stream.quality_d2() if st.stream is not None
+                    else dense_q or None
+                )
             if fold_sp is not None:
                 fold_sp.end(
                     ok=True, arrived=len(peers),
                     expected=len(st.expected),
                     degraded=self._round_degraded,
+                    **(
+                        {"mass_frac": mass["mass_committed_frac"]}
+                        if mass is not None else {}
+                    ),
                 )
             commit_sp = self.telemetry.tracer.start(
                 "commit", trace=group.epoch, role="leader", gen=group.gen
@@ -2225,6 +2338,17 @@ class SyncAverager(AveragerBase):
             asyncio.get_running_loop().call_later(
                 self.gather_timeout * 2, self._rounds.pop, group.epoch, None
             )
+            if self.health is not None and self.health.enabled:
+                # Post-commit health bookkeeping off the loop (members are
+                # already fetching — result_ready is set): quality votes,
+                # mass gauges + flight event, post-round sketch. Its own
+                # span so the leader's critical-path coverage contract
+                # (trace_report) still accounts for the round's wall.
+                with self.telemetry.span("health", trace=group.epoch, role="leader"):
+                    await asyncio.to_thread(
+                        self._health_note_commit, st.result, group.epoch,
+                        mass, quality,
+                    )
             return self._unpack(st.result)
         except Exception:
             # Idempotent ends: whichever phase the failure interrupted is
@@ -2400,6 +2524,14 @@ class SyncAverager(AveragerBase):
                 "contribution (push arrived late or was dropped)"
             )
         self.rounds_ok += 1
+        def _finish(b: Optional[np.ndarray]):
+            # Member-side health: sketch the committed aggregate we are
+            # about to adopt (the post-round parameters), so the member's
+            # heartbeat report carries the same-round sketch the mixing-
+            # error rollup compares across peers.
+            self._health_note_commit(b, group.epoch)
+            return self._unpack(b)
+
         if (
             sink_state is not None
             and sink_state["out"] is not None
@@ -2407,10 +2539,10 @@ class SyncAverager(AveragerBase):
         ):
             # The streamed sink already decoded the result: unpack only.
             buf = sink_state["out"]
-            return await asyncio.to_thread(lambda: self._unpack(buf))
+            return await asyncio.to_thread(_finish, buf)
         # Inline (small) response, or a wire the sink doesn't cover.
         return await asyncio.to_thread(
-            lambda: self._unpack(self._buf_from_payload(payload))
+            lambda: _finish(self._buf_from_payload(payload))
         )
 
     # -- leader failover recovery ------------------------------------------
@@ -3258,24 +3390,40 @@ class ByzantineAverager(AveragerBase):
 
         def _aggregate_and_flag():
             out = self.mesh_codec.aggregate(stack, method, **kw)
+            qmap: Dict[str, float] = {}
             if method != "mean" and len(peers) >= 3:
                 # Estimator-rejection feedback for the policy: rows far from
-                # the robust aggregate (>3x the median row distance) were
+                # the robust aggregate (>3x the median row DISTANCE) were
                 # effectively voted out — Chameleon's observed-failure
-                # signal for escalating/keeping the estimator.
-                d = np.linalg.norm(stack - out[None, :], axis=1)
-                med = float(np.median(d))
-                if med > 0:
+                # signal for escalating/keeping the estimator. The median
+                # is taken in distance space (even group sizes average two
+                # middle values, so median(d²) would be a strictly looser
+                # bar than median(d)²); the squared distances double as
+                # the contribution-quality votes.
+                d2 = health_mod.row_d2(stack, out)
+                qmap = {peers[i]: float(d2[i]) for i in range(len(peers))}
+                med2 = float(np.median(np.sqrt(d2))) ** 2
+                if med2 > 0:
                     return out, [
-                        peers[i] for i in np.nonzero(d > 3.0 * med)[0]
+                        peers[i] for i in np.nonzero(d2 > 9.0 * med2)[0]
                         if peers[i] != self.peer_id
-                    ]
-            return out, []
+                    ], qmap
+            return out, [], qmap
 
-        agg, outliers = await asyncio.to_thread(_aggregate_and_flag)
+        agg, outliers, qmap = await asyncio.to_thread(_aggregate_and_flag)
         if outliers and self.resilience is not None:
             for p in outliers:
                 self.resilience.record_rejection(p)
+        if self.health is not None and self.health.enabled:
+            # Full-mesh vantage: every member attributes quality and mass
+            # independently (no trusted leader — that is the point).
+            await asyncio.to_thread(
+                self._health_note_commit, agg, group.epoch,
+                health_mod.mass_from_outcomes(
+                    st.expected, {p: float(received[p][0]) for p in received}
+                ),
+                qmap or None,
+            )
         self._flush_round_outcome(time.monotonic() - t0, ok=True)
         self._note_group_round(True, degraded=degraded, size=group.size)
         return await asyncio.to_thread(lambda: self._unpack(agg))

@@ -63,6 +63,7 @@ from distributedvolunteercomputing_tpu.swarm.dht import (
     key_id,
 )
 from distributedvolunteercomputing_tpu.swarm.membership import PEERS_KEY
+from distributedvolunteercomputing_tpu.swarm import health as health_mod
 from distributedvolunteercomputing_tpu.swarm import telemetry as telemetry_mod
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, Transport
 from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
@@ -862,6 +863,13 @@ class ControlPlaneReplica:
             # swarm-wide plus every reporter's verbatim summary — the
             # schema tests/test_telemetry.py pins per version.
             "telemetry": telemetry_mod.rollup_status(fresh),
+            # Training-health rollup (versioned; None until some volunteer
+            # reports a health summary): cross-peer sketch dispersion —
+            # the LIVE mixing error, global / per zone / across zone
+            # means — plus mass-accounting stats, merged per-peer quality
+            # scores, the flagged-peer union, and per-wire codec
+            # distortion. Pinned by health.STATUS_HEALTH_SCHEMA.
+            "health": health_mod.rollup_status(fresh),
             "alive": alive,
             "n_alive": len(alive),
             "swarm_samples_per_sec": agg_sps,

@@ -61,7 +61,11 @@ log = get_logger(__name__)
 # coord.status rollup. Bump when the SHAPE of the telemetry surfaces
 # changes; tests/test_telemetry.py pins the documented schema per version
 # so rollup drift breaks CI instead of dashboards.
-TELEMETRY_SCHEMA_VERSION = 1
+# v2: the training-health layer (swarm/health.py) — health summaries ride
+# the report beat, scrapes carry the health view, and the status rollup
+# counts health reporters (the full health rollup is coord.status["health"],
+# pinned by its own STATUS_HEALTH_SCHEMA).
+TELEMETRY_SCHEMA_VERSION = 2
 
 # RPC method names (registered by Telemetry.register_rpcs).
 SCRAPE_METHOD = "telemetry.scrape"
@@ -480,6 +484,10 @@ class FlightRecorder:
     - ``backoff`` — the resilience backoff engaged/changed after failures.
     - ``method_escalated`` / ``method_deescalated`` — estimator ladder moves.
     - ``codec_degraded`` — the on-mesh data path fell back to host.
+    - ``peer_quality_flagged`` — the contribution-quality score crossed
+      the flag threshold for a peer (swarm/health.py).
+    - ``mass_lost_at_deadline`` — a committed round excluded/aborted
+      nonzero gradient mass (swarm/health.py).
     """
 
     MAX_EVENTS = 2048
@@ -552,6 +560,7 @@ class Telemetry:
         peer_id: str = "",
         clock: Callable[[], float] = time.time,
         enabled: bool = True,
+        health_enabled: Optional[bool] = None,
     ):
         self.peer_id = peer_id
         self.enabled = enabled
@@ -559,6 +568,19 @@ class Telemetry:
         self.registry = MetricsRegistry()
         self.tracer = Tracer(self.registry, peer_id, clock, enabled=enabled)
         self.recorder = FlightRecorder(peer_id, clock, enabled=enabled)
+        # Training-health layer (swarm/health.py): sketches, mass
+        # accounting, contribution quality, codec distortion. Gated
+        # independently (--no-health-probe disables the sketch/tally work
+        # while the rest of the plane stays on); --no-telemetry disables
+        # both. The object always exists so call sites stay branch-free.
+        from distributedvolunteercomputing_tpu.swarm import health as health_mod
+
+        if health_enabled is None:
+            health_enabled = enabled
+        self.health = health_mod.HealthMonitor(
+            self.registry, self.recorder, peer_id,
+            enabled=bool(enabled and health_enabled), clock=clock,
+        )
 
     def set_clock(self, clock: Callable[[], float]) -> None:
         """Adopt the ClockSync-corrected clock once the volunteer builds
@@ -566,6 +588,7 @@ class Telemetry:
         self.clock = clock
         self.tracer._clock = clock
         self.recorder._clock = clock
+        self.health.clock = clock
 
     # -- hot-path shorthands (None/no-op when disabled) ---------------------
 
@@ -612,6 +635,10 @@ class Telemetry:
         out = self.registry.scrape()
         out["peer"] = self.peer_id
         out["enabled"] = self.enabled
+        # Training-health view (None when the probe is disabled): summary
+        # plus the bounded sketch history — what trace_report matches
+        # across peers by trace id for the per-round mixing-error column.
+        out["health"] = self.health.scrape()
         return out
 
     # -- report summary (rides the cp.exchange beat) -------------------------
@@ -619,7 +646,10 @@ class Telemetry:
     # Span-histogram names summarized into every report: the per-phase
     # latency evidence coord.status rolls up without shipping whole scrapes
     # every beat.
-    SUMMARY_SPANS = ("round", "join", "encode", "wire", "fold", "commit", "fetch", "recover")
+    SUMMARY_SPANS = (
+        "round", "join", "encode", "wire", "fold", "commit", "health",
+        "fetch", "recover",
+    )
 
     def summary(self) -> dict:
         """Compact per-beat telemetry summary for the volunteer report:
@@ -656,6 +686,10 @@ STATUS_TELEMETRY_SCHEMA: Dict[str, type] = {
     "events_recorded_total": int,
     "spans": dict,             # span name -> {count, sum_s, mean_s}
     "per_peer": dict,          # peer id -> its report summary (verbatim)
+    # v2: how many fresh reports also carried a training-health summary
+    # (the full health rollup lives at coord.status["health"], pinned by
+    # health.STATUS_HEALTH_SCHEMA).
+    "health_reporting": int,
 }
 STATUS_SPAN_SCHEMA: Dict[str, type] = {
     "count": int,
@@ -692,4 +726,7 @@ def rollup_status(fresh_reports: List[dict]) -> Optional[dict]:
         ),
         "spans": spans,
         "per_peer": per_peer,
+        "health_reporting": sum(
+            1 for m in fresh_reports if isinstance(m.get("health"), dict)
+        ),
     }

@@ -209,6 +209,15 @@ class VolunteerConfig:
     # in tests/test_telemetry.py bounds the cost at <5% of commit latency);
     # --no-telemetry turns every record path into a no-op.
     telemetry: bool = True
+    # Training-health layer (swarm/health.py): post-round parameter
+    # sketches (live mixing error), gradient-mass accounting, per-peer
+    # contribution quality, codec distortion. On by default (the health
+    # overhead smoke in tests/test_health.py bounds the cost at <5% of
+    # commit latency); --no-health-probe disables the sketch computation
+    # and every health tally end-to-end — no sketch bytes ride the
+    # heartbeat report — while the rest of the telemetry plane stays on.
+    # --no-telemetry disables both.
+    health_probe: bool = True
 
     def __post_init__(self):
         if not self.peer_id:
@@ -431,7 +440,8 @@ class Volunteer:
         from distributedvolunteercomputing_tpu.swarm.telemetry import Telemetry
 
         self.telemetry = Telemetry(
-            peer_id=cfg.peer_id, enabled=cfg.telemetry
+            peer_id=cfg.peer_id, enabled=cfg.telemetry,
+            health_enabled=cfg.telemetry and cfg.health_probe,
         )
         # Structured-log identity: with DVC_LOG_JSON=1 every line this
         # process emits carries who/where, join-able against traces.
@@ -908,6 +918,14 @@ class Volunteer:
             # cp.exchange beat via report_source and is rolled up by the
             # control-plane replicas into coord.status["telemetry"].
             report["telemetry"] = self.telemetry.summary()
+        health = self.telemetry.health.summary()
+        if health is not None:
+            # Training-health summary (post-round parameter sketch, mass
+            # accounting, per-peer quality, codec distortion): rides the
+            # same batched beat; replicas roll it into
+            # coord.status["health"]. None — and therefore absent, no
+            # sketch bytes on the heartbeat — under --no-health-probe.
+            report["health"] = health
         if (
             self.averager is not None
             and getattr(self.averager, "group_schedule", None) is not None

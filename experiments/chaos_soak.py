@@ -50,6 +50,11 @@ Usage:
     python experiments/chaos_soak.py --no-train       # campaign only
     python experiments/chaos_soak.py --failover       # leader-failover campaign
     python experiments/chaos_soak.py --failover --quick
+    python experiments/chaos_soak.py --health         # training-health campaign
+                                                      # (ISSUE 12: byzantine
+                                                      # attribution, mass
+                                                      # accounting, live mixing
+                                                      # error vs direct)
 """
 
 from __future__ import annotations
@@ -1224,6 +1229,421 @@ async def mesh_degrade_campaign(args):
     }
 
 
+# -- training-health campaign (ISSUE 12 acceptance) --------------------------
+
+
+async def _teardown_vols(vols):
+    for v in vols:
+        try:
+            await v["mem"].leave()
+        except Exception:
+            pass
+        try:
+            await v["dht"].stop()
+        except Exception:
+            pass
+        try:
+            await v["t"].close()
+        except Exception:
+            pass
+    ChaosTransport._partitions.clear()
+    ChaosTransport._links.clear()
+
+
+async def _build_health_swarm(n, *, method="trimmed_mean", min_group=3,
+                              gather_timeout=10.0, round_deadline_s=None,
+                              chaos_last=False, seed=0):
+    """n volunteers with the (default-on) health probe; v0 sorts first and
+    leads every round. ``chaos_last`` puts the LAST peer on a
+    ChaosTransport so the campaign can delay it mid-run."""
+    vols, boot = [], None
+    schedule = FaultSchedule([], seed=seed)
+    for i in range(n):
+        pid = f"v{i}"
+        t = ChaosTransport(schedule=schedule) if (chaos_last and i == n - 1) else Transport()
+        dht = DHTNode(t)
+        await dht.start(bootstrap=[boot] if boot else None)
+        if boot is None:
+            boot = t.addr
+        mem = SwarmMembership(dht, pid, ttl=10.0)
+        await mem.join()
+        avg = SyncAverager(
+            t, dht, mem, min_group=min_group, max_group=n,
+            join_timeout=8.0, gather_timeout=gather_timeout,
+            round_deadline_s=round_deadline_s, method=method,
+        )
+        vols.append({"pid": pid, "t": t, "dht": dht, "mem": mem, "avg": avg})
+    return vols, schedule
+
+
+async def _byz_attribution_phase(args):
+    """One peer runs with DVC_CHAOS_CONTRIB_SCALE (the volunteer tier's
+    byzantine knob: well-formed frames, values scaled by x — the case
+    CRCs can't catch). The leader's quality score must flag it within
+    <= 10 committed rounds with ZERO honest flags across the campaign."""
+    # The same knob the subprocess volunteer tier reads
+    # (Volunteer._averager_callback): this in-process campaign applies the
+    # scale to the byz peer's tree by hand with identical semantics, so
+    # the env var only OVERRIDES the default factor here — it is never
+    # set, which would leak the fault into unrelated Volunteers.
+    scale = float(os.environ.get("DVC_CHAOS_CONTRIB_SCALE") or "0") or 8.0
+    n = 5
+    byz = f"v{n - 1}"  # sorts last: never leads, always a member
+    vols, _ = await _build_health_swarm(n, method="trimmed_mean", min_group=4)
+    rounds = []
+    flagged_round = None
+    false_positives = set()
+    try:
+        committed = 0
+        for r in range(args.health_rounds):
+            trees = []
+            for i in range(n):
+                tree = tree_for(i)
+                if vols[i]["pid"] == byz:
+                    # Exactly Volunteer._averager_callback's env semantics:
+                    # the real tree scaled by DVC_CHAOS_CONTRIB_SCALE.
+                    tree = {k: v * scale for k, v in tree.items()}
+                trees.append(tree)
+            res = await asyncio.gather(
+                *(
+                    asyncio.wait_for(
+                        vols[i]["avg"].average(trees[i], round_no=r), timeout=60.0
+                    )
+                    for i in range(n)
+                ),
+                return_exceptions=True,
+            )
+            ok = res[0] is not None and not isinstance(res[0], BaseException)
+            committed += int(ok)
+            lead_health = vols[0]["avg"].telemetry.health
+            flagged_now = lead_health.flagged_peers()
+            if flagged_round is None and byz in flagged_now:
+                flagged_round = committed  # "within N committed rounds"
+            for v in vols:
+                for p in v["avg"].telemetry.health.flagged_peers():
+                    if p != byz:
+                        false_positives.add(p)
+            rounds.append({
+                "round": r,
+                "committed": ok,
+                "flagged": flagged_now,
+                "byz_score": lead_health.quality_score(byz),
+                "honest_scores": {
+                    v["pid"]: lead_health.quality_score(v["pid"])
+                    for v in vols[:-1]
+                },
+            })
+        lead_health = vols[0]["avg"].telemetry.health
+        out = {
+            "contrib_scale": scale,
+            "byz_peer": byz,
+            "rounds": len(rounds),
+            "committed_rounds": committed,
+            "flagged_after_committed_rounds": flagged_round,
+            "honest_false_positives": sorted(false_positives),
+            "byz_score_final": lead_health.quality_score(byz),
+            "leader_summary_quality": (lead_health.summary() or {}).get("quality"),
+            "flag_events": vols[0]["avg"].telemetry.recorder.dump(
+                kinds=["peer_quality_flagged"]
+            ),
+            "membership_flagged_field": vols[0]["mem"].extra_info.get(
+                "health_flagged"
+            ),
+            "per_round": rounds,
+        }
+        out["flight_recorders"] = _flight_dumps(vols)
+    finally:
+        await _teardown_vols(vols)
+    return out
+
+
+async def _mass_accounting_phase(args):
+    """Deadline-dropped straggler: v3's outbound RPCs gain a delay past
+    the static round deadline, so the leader commits without it — the
+    lost mass must show up as mass_lost_at_deadline flight events and a
+    slot_committed_frac < 1, with the report balanced every round."""
+    vols, schedule = await _build_health_swarm(
+        4, method="mean", min_group=3, gather_timeout=8.0,
+        round_deadline_s=2.0, chaos_last=True, seed=args.seed,
+    )
+    straggler = vols[-1]
+    rounds = []
+    try:
+        # Healthy warmup: every slot included, frac 1.0.
+        for r in range(3):
+            await asyncio.gather(
+                *(
+                    asyncio.wait_for(
+                        v["avg"].average(tree_for(i), round_no=r), timeout=60.0
+                    )
+                    for i, v in enumerate(vols)
+                ),
+                return_exceptions=True,
+            )
+        lead_health = vols[0]["avg"].telemetry.health
+        warm_mass = (lead_health.summary() or {}).get("mass", {}).get("last")
+        # Fault onset: the straggler's every outbound RPC (join included)
+        # now takes 4s — inside the join window, past the 2s deadline.
+        schedule.events = [fault_event(0.0, float("inf"), "delay", 4.0)]
+        schedule.start()
+        base = 3
+        for r in range(base, base + args.health_rounds):
+            res = await asyncio.gather(
+                *(
+                    asyncio.wait_for(
+                        v["avg"].average(tree_for(i), round_no=r), timeout=60.0
+                    )
+                    for i, v in enumerate(vols)
+                ),
+                return_exceptions=True,
+            )
+            ok = res[0] is not None and not isinstance(res[0], BaseException)
+            mass = (lead_health.summary() or {}).get("mass", {}).get("last")
+            if mass:
+                balanced = abs(
+                    mass["included_weight"] + mass["excluded_weight"]
+                    + mass["aborted_weight"] - mass["armed_weight"]
+                ) < 1e-6
+            else:
+                balanced = None
+            rounds.append({
+                "round": r,
+                "committed": ok,
+                "mass": mass,
+                "balanced": balanced,
+            })
+        events = vols[0]["avg"].telemetry.recorder.dump(
+            kinds=["mass_lost_at_deadline"]
+        )
+        dropped = [
+            rec for rec in rounds
+            if rec["committed"] and rec["mass"]
+            and rec["mass"]["slot_committed_frac"] < 1.0
+        ]
+        out = {
+            "rounds": len(rounds),
+            "warmup_mass": warm_mass,
+            "dropped_rounds": len(dropped),
+            "all_balanced": all(r["balanced"] for r in rounds if r["mass"]),
+            "straggler_named_in_events": any(
+                straggler["pid"] in (e.get("excluded") or []) for e in events
+            ),
+            "mass_lost_events": events[-20:],
+            "per_round": rounds,
+        }
+        out["flight_recorders"] = _flight_dumps(vols)
+    finally:
+        await _teardown_vols(vols)
+    return out
+
+
+async def _mixing_error_phase(args, arm: str):
+    """Two-zone swarm under the hierarchical schedule, rotations pinned:
+    ``intra_only`` never crosses a zone boundary (cross_zone_every_k far
+    beyond the campaign), ``hier`` crosses every 3rd rotation. Per
+    rotation the campaign records the DIRECT relative dispersion (from
+    the true per-node values — the hierarchy bench's offline criterion)
+    and the SKETCH-based dispersion from each node's health monitor (what
+    coord.status["health"] serves live), globally and across zone means."""
+    assert arm in ("intra_only", "hier")
+    from distributedvolunteercomputing_tpu.swarm import health as health_mod
+
+    n, tree_elems, group_target = 8, 16_384, 3
+    k = 10**6 if arm == "intra_only" else 3
+    rot_cell = {"rot": 0}
+    vols, boot = [], None
+    zones = {}
+    try:
+        for i in range(n):
+            zone = "dc" if i < n // 2 else "home"
+            pid = f"b{i:03d}"
+            zones[pid] = zone
+            sched = GroupSchedule(
+                target_size=group_target, rotation_s=1000.0, min_size=2,
+                cross_zone_every_k=k,
+                clock=lambda: rot_cell["rot"] * 1000.0 + 0.5,
+            )
+            t = ChaosTransport()
+            dht = DHTNode(t, maintenance_interval=120.0)
+            await dht.start(bootstrap=[boot] if boot else None)
+            if boot is None:
+                boot = t.addr
+            mem = SwarmMembership(
+                dht, pid, ttl=30.0, extra_info={"zone": zone}
+            )
+            await mem.join()
+            avg = SyncAverager(
+                t, dht, mem, min_group=2, max_group=3 * group_target,
+                join_timeout=6.0, gather_timeout=10.0, group_schedule=sched,
+            )
+            vols.append({"pid": pid, "t": t, "dht": dht, "mem": mem,
+                         "avg": avg, "zone": zone})
+        for v in vols:
+            await v["mem"].alive_peers()  # prime snapshots + zone maps
+        vals = {i: float(i) for i in range(n)}
+
+        def direct_disp(values):
+            stack = np.stack(
+                [np.full(64, val, np.float64) for val in values]
+            )
+            dev = stack - stack.mean(axis=0)[None, :]
+            rms = float(np.sqrt((dev * dev).sum(axis=1).mean()))
+            norm = float(np.sqrt((stack * stack).sum(axis=1).mean()))
+            return rms / norm if norm > 0 else 0.0
+
+        def sketch_disp(sks):
+            d = health_mod.sketch_dispersion(sks)
+            return d["rel"] if d else None
+
+        # Seed every monitor with its INITIAL params so rotation-0 skips
+        # still have a sketch consistent with the node's current values.
+        for i, v in enumerate(vols):
+            v["avg"].telemetry.health.note_sketch(
+                np.full(tree_elems, vals[i], np.float32), trace="init"
+            )
+        history = []
+        # k=3 crosses at rotations 3, 6, 9, ...: the campaign needs >= 3
+        # cross rotations for the hier arm's convergence bar to be fair.
+        rot_rounds = 9 if args.quick else max(12, args.health_rounds)
+        for r in range(1, rot_rounds + 1):
+            rot_cell["rot"] = r
+            results = await asyncio.gather(
+                *(
+                    asyncio.wait_for(
+                        v["avg"].average(
+                            {"w": np.full((tree_elems,), vals[i], np.float32)},
+                            round_no=r,
+                        ),
+                        timeout=40.0,
+                    )
+                    for i, v in enumerate(vols)
+                ),
+                return_exceptions=True,
+            )
+            for i, res in enumerate(results):
+                if res is not None and not isinstance(res, BaseException):
+                    vals[i] = float(res["w"][0])
+            sketches = [
+                np.asarray(
+                    (v["avg"].telemetry.health.last_sketch() or {}).get("v"),
+                    np.float64,
+                )
+                for v in vols
+                if v["avg"].telemetry.health.last_sketch() is not None
+            ]
+            zone_vals = {
+                z: [vals[i] for i in range(n) if vols[i]["zone"] == z]
+                for z in ("dc", "home")
+            }
+            zone_sks = {}
+            for v, i in zip(vols, range(n)):
+                sk = v["avg"].telemetry.health.last_sketch()
+                if sk is not None:
+                    zone_sks.setdefault(v["zone"], []).append(
+                        np.asarray(sk["v"], np.float64)
+                    )
+            history.append({
+                "rot": r,
+                "direct_rel": round(direct_disp(list(vals.values())), 6),
+                "sketch_rel": round(sketch_disp(sketches) or 0.0, 6),
+                "direct_cross_rel": round(direct_disp(
+                    [float(np.mean(zone_vals["dc"])),
+                     float(np.mean(zone_vals["home"]))]
+                ), 6),
+                "sketch_cross_rel": round(sketch_disp(
+                    [np.stack(v).mean(axis=0) for v in zone_sks.values()]
+                    if len(zone_sks) == 2 else []
+                ) or 0.0, 6),
+            })
+    finally:
+        await _teardown_vols(vols)
+    return {
+        "arm": arm,
+        "cross_zone_every_k": k,
+        "n": n,
+        "rotations": len(history),
+        "history": history,
+        "cross_rel_first": history[0]["direct_cross_rel"],
+        "cross_rel_final_direct": history[-1]["direct_cross_rel"],
+        "cross_rel_final_sketch": history[-1]["sketch_cross_rel"],
+    }
+
+
+# Documented tolerance for sketch-vs-direct agreement: the JL projection
+# at dim=64 distorts pairwise norms ~1/sqrt(2*64) per pair; averaged over
+# 8 peers the dispersion estimate lands well inside 25% relative (+ a
+# small absolute grace for near-converged rounds where both are ~0).
+HEALTH_SKETCH_TOL_REL = 0.25
+HEALTH_SKETCH_TOL_ABS = 0.02
+
+
+async def health_campaign(args):
+    out = {"seed": args.seed}
+    print("[health/byz] 5 volunteers, one at DVC_CHAOS_CONTRIB_SCALE ...")
+    out["byz_attribution"] = await _byz_attribution_phase(args)
+    b = out["byz_attribution"]
+    print(f"[health/byz] flagged after {b['flagged_after_committed_rounds']} "
+          f"committed rounds, false positives {b['honest_false_positives']}")
+    print("[health/mass] deadline-dropped straggler ...")
+    out["mass_accounting"] = await _mass_accounting_phase(args)
+    m = out["mass_accounting"]
+    print(f"[health/mass] {m['dropped_rounds']} dropped rounds, "
+          f"balanced={m['all_balanced']}, "
+          f"straggler named={m['straggler_named_in_events']}")
+    print("[health/mixing] two-zone sketch-vs-direct, intra_only vs k=3 ...")
+    out["mixing"] = {
+        "intra_only": await _mixing_error_phase(args, "intra_only"),
+        "hier": await _mixing_error_phase(args, "hier"),
+    }
+    for arm, rec in out["mixing"].items():
+        print(f"[health/mixing] {arm}: cross-zone rel "
+              f"{rec['cross_rel_first']} -> {rec['cross_rel_final_direct']} "
+              f"(sketch {rec['cross_rel_final_sketch']})")
+    return out
+
+
+def health_verdict(result: dict) -> dict:
+    b = result["byz_attribution"]
+    m = result["mass_accounting"]
+    hier = result["mixing"]["hier"]
+    intra = result["mixing"]["intra_only"]
+    # Sketch trustworthiness: on every recorded rotation, the live sketch
+    # dispersion tracks the direct computation within the documented
+    # tolerance — in BOTH arms (converging and stalling trends).
+    sketch_ok = all(
+        abs(h["sketch_rel"] - h["direct_rel"])
+        <= HEALTH_SKETCH_TOL_REL * h["direct_rel"] + HEALTH_SKETCH_TOL_ABS
+        for rec in (hier, intra)
+        for h in rec["history"]
+    )
+    return {
+        "pass_byz_flagged_within_10": (
+            b["flagged_after_committed_rounds"] is not None
+            and b["flagged_after_committed_rounds"] <= 10
+        ),
+        "pass_zero_false_positives": not b["honest_false_positives"],
+        "pass_mass_balanced": bool(m["all_balanced"]),
+        "pass_mass_loss_visible": (
+            m["dropped_rounds"] > 0 and m["straggler_named_in_events"]
+        ),
+        "pass_sketch_matches_direct": sketch_ok,
+        # k=3 must converge the cross-zone dispersion; intra-only must
+        # visibly fail to (the gap the cross rotations exist to close).
+        "pass_hier_converges_cross_zone": (
+            hier["cross_rel_final_direct"] <= 0.25 * hier["cross_rel_first"]
+        ),
+        "pass_intra_only_stalls_cross_zone": (
+            intra["cross_rel_final_direct"] >= 0.5 * intra["cross_rel_first"]
+            and intra["cross_rel_final_sketch"]
+            >= 2.0 * max(hier["cross_rel_final_sketch"], 1e-6)
+        ),
+        "byz_flagged_after_committed_rounds": b["flagged_after_committed_rounds"],
+        "sketch_tol": {
+            "rel": HEALTH_SKETCH_TOL_REL, "abs": HEALTH_SKETCH_TOL_ABS,
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=7)
@@ -1265,6 +1685,20 @@ def main():
                          "a survivor within one heartbeat interval)")
     ap.add_argument("--controlplane-rounds", type=int, default=4,
                     help="replica-kill rounds in the control-plane arm")
+    ap.add_argument("--health", action="store_true",
+                    help="run the training-health arm instead (ISSUE 12): "
+                         "a DVC_CHAOS_CONTRIB_SCALE byzantine peer must be "
+                         "flagged by the contribution-quality score within "
+                         "10 committed rounds with zero honest false "
+                         "positives; a deadline-dropped straggler's lost "
+                         "gradient mass must balance and surface as "
+                         "mass_lost_at_deadline events; and the live "
+                         "sketch-based mixing error must track the direct "
+                         "computation on a two-zone swarm where intra-only "
+                         "rotations stall cross-zone dispersion and k=3 "
+                         "converges it")
+    ap.add_argument("--health-rounds", type=int, default=12,
+                    help="rounds per phase in the health arm")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     if args.out is None:
@@ -1274,6 +1708,7 @@ def main():
             else "chaos_mesh_degrade.json" if args.mesh_degrade
             else "chaos_multigroup.json" if args.multigroup
             else "chaos_controlplane.json" if args.controlplane
+            else "chaos_health.json" if args.health
             else "chaos_soak.json",
         )
     if args.quick:
@@ -1284,7 +1719,19 @@ def main():
         args.mesh_degrade_rounds = 4
         args.multigroup_rounds = 3
         args.controlplane_rounds = 2
+        args.health_rounds = 8
         args.no_train = True
+
+    if args.health:
+        result = {"health_campaign": asyncio.run(health_campaign(args))}
+        result["verdict"] = health_verdict(result["health_campaign"])
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[done] artifact -> {args.out}")
+        print(json.dumps(result["verdict"], indent=2))
+        ok = all(v for k, v in result["verdict"].items() if k.startswith("pass_"))
+        sys.exit(0 if ok else 1)
 
     if args.controlplane:
         result = {

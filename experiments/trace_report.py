@@ -59,8 +59,11 @@ TREE_ELEMS = 300_000  # ~1.2 MB f32 per contribution: chunked wire, fast rounds
 RESULTS = os.path.join(REPO, "experiments", "results")
 
 # Leader-vantage phases, protocol order: sequential by construction in
-# SyncAverager.average, so their sum bounds the round wall from below.
-LEADER_PHASES = ("join", "arm", "encode", "fold", "commit")
+# SyncAverager.average, so their sum bounds the round wall from below
+# ("health" = the post-commit training-health bookkeeping: quality/mass/
+# sketch, swarm/health.py — members are already fetching by then, but it
+# is inside the leader's round wall and must count toward coverage).
+LEADER_PHASES = ("join", "arm", "encode", "fold", "commit", "health")
 # Member vantage (the recovered scenario reports from a survivor).
 MEMBER_PHASES = ("join", "encode", "wire", "fetch", "recover")
 
@@ -178,10 +181,14 @@ def _read_until(proc, tag, timeout=120.0):
 
 
 async def _collect_spans(addrs, timeout=8.0):
-    """Dial every (live) volunteer's telemetry.trace RPC; dead volunteers
-    (the killed leader) simply contribute nothing."""
+    """Dial every (live) volunteer's telemetry.trace / flight / scrape
+    RPCs; dead volunteers (the killed leader) simply contribute nothing.
+    The scrape's health view carries each peer's bounded post-round
+    sketch history — matched across peers by trace id, that is the
+    per-round live mixing-error column."""
     t = Transport()
     spans, flights = [], {}
+    sketches_by_trace = {}
     try:
         for pid, addr in addrs.items():
             addr = (addr[0], int(addr[1]))
@@ -196,11 +203,21 @@ async def _collect_spans(addrs, timeout=8.0):
                     timeout=timeout, connect_timeout=2.0,
                 )
                 flights[pid] = ret.get("events") or []
+                ret, _ = await t.call(
+                    addr, telemetry_mod.SCRAPE_METHOD, {}, b"",
+                    timeout=timeout, connect_timeout=2.0,
+                )
+                health = ret.get("health") or {}
+                for rec in health.get("sketch_history") or []:
+                    if rec.get("trace") and rec.get("v"):
+                        sketches_by_trace.setdefault(rec["trace"], []).append(
+                            rec["v"]
+                        )
             except Exception as e:  # noqa: BLE001 — a dead volunteer is expected here
                 print(f"  (no telemetry from {pid}: {type(e).__name__})")
     finally:
         await t.close()
-    return spans, flights
+    return spans, flights, sketches_by_trace
 
 
 def _phase_durs(spans, phases):
@@ -213,11 +230,17 @@ def _phase_durs(spans, phases):
     return out
 
 
-def _breakdown(all_spans):
+def _breakdown(all_spans, sketches_by_trace=None):
     """Stitch spans by trace id and emit one record per round that has a
     root 'round' span; coverage = sum(vantage phases)/wall from the
     vantage (leader when one committed, else the first member) whose
-    phases are sequential by construction."""
+    phases are sequential by construction. Health columns ride each row:
+    ``mass_committed_frac`` from the leader's fold span and
+    ``mix_err_sketch`` — the relative dispersion of the peers' post-round
+    sketches for THIS trace (swarm/health.py) — so critical-path and
+    training-health read from one artifact."""
+    from distributedvolunteercomputing_tpu.swarm import health as health_mod
+
     by_trace = {}
     for s in all_spans:
         by_trace.setdefault(s["trace"], []).append(s)
@@ -240,6 +263,22 @@ def _breakdown(all_spans):
         wall = root["dur_s"] or 0.0
         covered = sum(phases.values())
         recovered = any(s["name"] == "recover" for s in spans)
+        mass_frac = next(
+            (
+                (s.get("attrs") or {}).get("mass_frac")
+                for s in spans
+                if s["name"] == "fold"
+                and (s.get("attrs") or {}).get("mass_frac") is not None
+            ),
+            None,
+        )
+        mix_err = None
+        sketches = (sketches_by_trace or {}).get(trace)
+        if sketches and len(sketches) >= 2:
+            d = health_mod.sketch_dispersion(
+                [np.asarray(v, np.float64) for v in sketches]
+            )
+            mix_err = d["rel"] if d else None
         rounds.append({
             "trace": trace,
             "key": attrs.get("key"),
@@ -252,6 +291,8 @@ def _breakdown(all_spans):
             "wall_s": round(wall, 6),
             "phases_s": phases,
             "coverage": round(covered / wall, 4) if wall > 0 else None,
+            "mass_committed_frac": mass_frac,
+            "mix_err_sketch": mix_err,
             "members": {
                 "wire_mean_s": _mean(
                     [s["dur_s"] for s in spans if s["name"] == "wire"]
@@ -333,7 +374,7 @@ async def _run_scenario(name, workers, rounds, expect_addrs, scrape_grace=2.0):
             if done is None:
                 raise RuntimeError(f"{name}: worker {spec['pids']} died mid-campaign")
         await asyncio.sleep(scrape_grace)  # let trailing spans land
-        spans, flights = await _collect_spans(addrs)
+        spans, flights, sketches = await _collect_spans(addrs)
     finally:
         for proc, _ in procs:
             try:
@@ -347,16 +388,18 @@ async def _run_scenario(name, workers, rounds, expect_addrs, scrape_grace=2.0):
                 proc.kill()
         await boot_dht.stop()
         await boot_t.close()
-    return spans, flights
+    return spans, flights, sketches
 
 
 async def campaign(args):
     rounds = 2 if args.quick else 4
-    out = {"schema_version": 1, "tree_elems": TREE_ELEMS, "scenarios": {}}
+    # schema v2: per-round health columns (mass_committed_frac from the
+    # leader's fold span, mix_err_sketch from cross-peer sketch matching).
+    out = {"schema_version": 2, "tree_elems": TREE_ELEMS, "scenarios": {}}
 
     # -- committed: plain sync rounds, leader-vantage critical path --------
     print("[committed] 4 volunteers / 2 workers ...")
-    spans, _ = await _run_scenario(
+    spans, _, sketches = await _run_scenario(
         "committed",
         [
             {"pids": ["v0", "v1"]},
@@ -365,7 +408,7 @@ async def campaign(args):
         rounds,
         expect_addrs={"v0", "v1", "v2", "v3"},
     )
-    recs = [r for r in _breakdown(spans) if r["ok"]]
+    recs = [r for r in _breakdown(spans, sketches) if r["ok"]]
     lead = [r for r in recs if r["vantage"] == "leader"]
     out["scenarios"]["committed"] = {
         "rounds": recs,
@@ -374,13 +417,21 @@ async def campaign(args):
         "phase_means_s": {
             p: _mean([r["phases_s"].get(p) for r in lead]) for p in LEADER_PHASES
         },
+        "mass_committed_frac_min": min(
+            (r["mass_committed_frac"] for r in lead
+             if r["mass_committed_frac"] is not None),
+            default=None,
+        ),
+        "mix_err_sketch_mean": _mean([r["mix_err_sketch"] for r in recs]),
     }
     print(f"[committed] {len(lead)} leader-vantage rounds, coverage_min="
-          f"{out['scenarios']['committed']['coverage_min']}")
+          f"{out['scenarios']['committed']['coverage_min']}, "
+          f"mix_err_sketch_mean="
+          f"{out['scenarios']['committed']['mix_err_sketch_mean']}")
 
     # -- recovered: leader SIGKILL mid-stream, survivors' vantage ----------
     print("[recovered] leader a0 dies mid_stream ...")
-    spans, flights = await _run_scenario(
+    spans, flights, sketches = await _run_scenario(
         "recovered",
         [
             {
@@ -392,7 +443,7 @@ async def campaign(args):
         1,
         expect_addrs={"v1", "v2", "v3"},
     )
-    recs = _breakdown(spans)
+    recs = _breakdown(spans, sketches)
     recovered = [r for r in recs if r["recovered"] and r["ok"]]
     out["scenarios"]["recovered"] = {
         "rounds": recs,
@@ -422,7 +473,7 @@ async def campaign(args):
         "group_size": 3, "rotation_s": 3.0, "cross_zone_every_k": 2,
         "max_group": 9, "round_gap_s": 1.0,
     }
-    spans, _ = await _run_scenario(
+    spans, _, sketches = await _run_scenario(
         "cross_zone",
         [
             dict(zone_spec, pids=["z0a", "z0b", "z0c"], zone="dc-a"),
@@ -432,13 +483,21 @@ async def campaign(args):
         expect_addrs={"z0a", "z0b", "z0c", "z1a", "z1b", "z1c"},
         scrape_grace=3.0,
     )
-    recs = [r for r in _breakdown(spans) if r["ok"]]
+    recs = [r for r in _breakdown(spans, sketches) if r["ok"]]
     levels = sorted({r["level"] for r in recs})
     out["scenarios"]["cross_zone"] = {
         "rounds": recs,
         "levels_seen": levels,
         "per_level_wall_mean_s": {
             lv: _mean([r["wall_s"] for r in recs if r["level"] == lv])
+            for lv in levels
+        },
+        # The live-mixing column, per hierarchy level: intra rounds only
+        # converge within a group; the cross rounds are where the
+        # cross-zone dispersion moves (the health rollup's across_zones
+        # signal — chaos_soak --health runs the full convergence A/B).
+        "per_level_mix_err_sketch_mean": {
+            lv: _mean([r["mix_err_sketch"] for r in recs if r["level"] == lv])
             for lv in levels
         },
     }

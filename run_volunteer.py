@@ -174,7 +174,15 @@ def main() -> None:
                     help="disable the telemetry plane (round tracing, "
                          "unified metrics registry, flight recorder): every "
                          "record path becomes a no-op; the telemetry.* RPCs "
-                         "still answer with empty views")
+                         "still answer with empty views (implies "
+                         "--no-health-probe)")
+    ap.add_argument("--no-health-probe", action="store_true",
+                    help="disable the training-health layer only "
+                         "(post-round parameter sketches / live mixing "
+                         "error, gradient-mass accounting, per-peer "
+                         "contribution quality, codec distortion gauges): "
+                         "no sketch bytes ride the heartbeat report; the "
+                         "rest of the telemetry plane stays on")
     ap.add_argument("--host-replica", action="store_true",
                     help="host a control-plane replica on this volunteer: "
                          "serve coord.status and batched heartbeat/report "
@@ -334,6 +342,7 @@ def main() -> None:
         outer_lr=args.outer_lr,
         outer_momentum=args.outer_momentum,
         telemetry=not args.no_telemetry,
+        health_probe=not (args.no_telemetry or args.no_health_probe),
     )
     if cfg.averaging != "none":
         # Build/load the native host core BEFORE the event loop exists: the

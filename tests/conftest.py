@@ -91,6 +91,16 @@ def pytest_configure(config):
         "telemetry schema, structured JSONL logging, overhead smoke) — in "
         "the default lane, and selectable on their own with -m telemetry",
     )
+    config.addinivalue_line(
+        "markers",
+        "health: training-health telemetry tests (seeded random-projection "
+        "sketch estimator vs direct parameter dispersion, gradient-mass "
+        "accounting balance across the deadline/abort/fence matrix, "
+        "per-peer contribution-quality attribution + flagging, "
+        "--no-health-probe end-to-end plumbing, coord.status health "
+        "schema, health-probe overhead smoke) — in the default lane, and "
+        "selectable on their own with -m health",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
