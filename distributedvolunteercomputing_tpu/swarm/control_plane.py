@@ -65,6 +65,7 @@ from distributedvolunteercomputing_tpu.swarm.dht import (
 from distributedvolunteercomputing_tpu.swarm.membership import PEERS_KEY
 from distributedvolunteercomputing_tpu.swarm import health as health_mod
 from distributedvolunteercomputing_tpu.swarm import telemetry as telemetry_mod
+from distributedvolunteercomputing_tpu.swarm import watchdog as watchdog_mod
 from distributedvolunteercomputing_tpu.swarm.transport import Addr, Transport
 from distributedvolunteercomputing_tpu.utils.logging import errstr, get_logger
 
@@ -227,6 +228,15 @@ class ControlPlaneReplica:
             self.telemetry = telemetry_mod.Telemetry(peer_id=self.rid)
             self.telemetry.register_rpcs(transport)
         self.telemetry.registry.source("control_plane.replica", self.stats)
+        # Swarm watchdog (swarm/watchdog.py): SLO burn rates over the
+        # merged rollup plus the swarm-level detectors no volunteer can
+        # see (cross-zone mixing stall), evaluated once per tick and
+        # served as coord.status["slo"] / ["alerts"]. Replica-side only —
+        # pure rollup math, no per-round cost — so it stays on even when
+        # a hosting volunteer disabled its own telemetry.
+        self.watchdog = watchdog_mod.SwarmWatchdog(
+            recorder=self.telemetry.recorder, peer_id=self.rid,
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -306,6 +316,7 @@ class ControlPlaneReplica:
                 await self._flush_mem_records()
                 await self._write_rollups()
                 self._sweep()
+                self._eval_watchdog()
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — the tick must not die
@@ -544,6 +555,35 @@ class ControlPlaneReplica:
                 self._shard_gens.pop(s, None)
                 self.counters["rollups_fenced"] += 1
                 self.counters["shards_released"] += 1
+
+    def _eval_watchdog(self) -> None:
+        """One SLO/detector evaluation over the merged view (tick-paced;
+        the status path re-evaluates lazily under the same spacing guard).
+        Advisory: a watchdog bug must never take the tick down."""
+        try:
+            fresh_map, commit_w, xz_w = self._merged_metrics()
+            fresh = list(fresh_map.values())
+            self.watchdog.evaluate(
+                fresh,
+                multigroup=self._multigroup_rollup(fresh, commit_w, xz_w),
+                health=health_mod.rollup_status(fresh),
+            )
+        except Exception as e:  # noqa: BLE001
+            log.debug("watchdog evaluation failed: %s", errstr(e))
+
+    @staticmethod
+    def _stamp_age(rollup: Optional[dict], fresh: list, now: float) -> Optional[dict]:
+        """Staleness stamp for a status rollup section: seconds since the
+        freshest contributing report landed — a frozen replica serves a
+        growing age_s, a healthy quiet swarm a small one."""
+        if rollup is None:
+            return None
+        recvs = [
+            m.get("recv_t") for m in fresh
+            if isinstance(m.get("recv_t"), (int, float))
+        ]
+        rollup["age_s"] = round(max(0.0, now - max(recvs)) if recvs else -1.0, 3)
+        return rollup
 
     def _sweep(self) -> None:
         now = time.time()
@@ -854,6 +894,13 @@ class ControlPlaneReplica:
         fresh = list(fresh_map.values())
         agg_sps = sum(float(m.get("samples_per_sec", 0.0)) for m in fresh)
         multigroup = self._multigroup_rollup(fresh, commit_w, xz_w)
+        now = time.time()
+        health_roll = health_mod.rollup_status(fresh)
+        # A status serve is also an evaluation opportunity (spacing-
+        # guarded inside, so a status storm cannot inflate burn windows):
+        # an operator probing a freshly-failed-over replica sees live
+        # objectives, not a blank watchdog.
+        self.watchdog.evaluate(fresh, multigroup=multigroup, health=health_roll)
         return {
             # Rotating group-schedule rollup (None until some volunteer
             # reports multi-group gauges).
@@ -861,15 +908,26 @@ class ControlPlaneReplica:
             # Telemetry-plane rollup (versioned; None until some volunteer
             # reports a telemetry summary): per-span count/sum merged
             # swarm-wide plus every reporter's verbatim summary — the
-            # schema tests/test_telemetry.py pins per version.
-            "telemetry": telemetry_mod.rollup_status(fresh),
+            # schema tests/test_telemetry.py pins per version. Every
+            # rollup section carries an age_s staleness stamp (satellite:
+            # a frozen replica is distinguishable from a quiet swarm).
+            "telemetry": self._stamp_age(
+                telemetry_mod.rollup_status(fresh), fresh, now
+            ),
             # Training-health rollup (versioned; None until some volunteer
             # reports a health summary): cross-peer sketch dispersion —
             # the LIVE mixing error, global / per zone / across zone
             # means — plus mass-accounting stats, merged per-peer quality
             # scores, the flagged-peer union, and per-wire codec
             # distortion. Pinned by health.STATUS_HEALTH_SCHEMA.
-            "health": health_mod.rollup_status(fresh),
+            "health": self._stamp_age(health_roll, fresh, now),
+            # Watchdog plane (versioned, ALWAYS dicts — the plane exists
+            # the moment a replica does): declarative objectives with
+            # fast/slow burn rates, and the swarm-wide firing-alert rollup
+            # (volunteer-reported firing sets + replica-local swarm-level
+            # alerts). Pinned by watchdog.STATUS_WATCHDOG_SCHEMA.
+            "slo": self.watchdog.slo_status(now),
+            "alerts": self.watchdog.alerts_status(fresh, now),
             "alive": alive,
             "n_alive": len(alive),
             "swarm_samples_per_sec": agg_sps,

@@ -318,6 +318,9 @@ class HealthMonitor:
         self._last_mass: Optional[dict] = None
         self._codec: Dict[str, dict] = {}
         self.rounds_observed = 0
+        # Committed-round mass reports folded (the watchdog's "is there a
+        # NEW mass observation this tick" cursor).
+        self.mass_rounds = 0
         self.sketches_computed = 0
         if enabled and registry is not None:
             self._mass_gauge = registry.gauge(
@@ -446,6 +449,7 @@ class HealthMonitor:
                 report.get("aborted_slots", 0)
             )
             with self._lock:
+                self.mass_rounds += 1
                 self._last_mass = {
                     k: report[k] for k in report if k != "per_peer"
                 }
@@ -607,6 +611,7 @@ class HealthMonitor:
 # like STATUS_TELEMETRY_SCHEMA, so drift breaks CI instead of dashboards.
 STATUS_HEALTH_SCHEMA: Dict[str, type] = {
     "schema_version": int,
+    "age_s": float,          # staleness stamp (serve-time, freshest report)
     "reporting": int,        # volunteers whose fresh report carried health
     "mixing": dict,          # global + per-zone sketch dispersion (below)
     "mass": dict,            # committed-frac stats + cumulative lost weight

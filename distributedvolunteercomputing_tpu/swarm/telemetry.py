@@ -65,12 +65,39 @@ log = get_logger(__name__)
 # the report beat, scrapes carry the health view, and the status rollup
 # counts health reporters (the full health rollup is coord.status["health"],
 # pinned by its own STATUS_HEALTH_SCHEMA).
-TELEMETRY_SCHEMA_VERSION = 2
+# v3: the watchdog layer (swarm/watchdog.py) — flight events carry a
+# severity (``sev``) and the flight RPC an incremental ``since_seq``
+# cursor; scrapes carry the watchdog view; the status rollups gain an
+# ``age_s`` staleness stamp (the slo/alerts sections are pinned by
+# watchdog.STATUS_WATCHDOG_SCHEMA).
+TELEMETRY_SCHEMA_VERSION = 3
 
 # RPC method names (registered by Telemetry.register_rpcs).
 SCRAPE_METHOD = "telemetry.scrape"
 TRACE_METHOD = "telemetry.trace"
 FLIGHT_METHOD = "telemetry.flight"
+PROM_METHOD = "telemetry.prom"
+
+# Default severity per flight-recorder event kind (the alerting tier's
+# triage order: ``page`` wakes someone, ``warn`` waits for business
+# hours, ``info`` is context). Callers can override per event via
+# ``sev=``; unknown kinds default to "info".
+KIND_SEVERITY: Dict[str, str] = {
+    "leader_deposed": "warn",
+    "fence_rejected": "warn",
+    "round_degraded": "warn",
+    "round_failed": "warn",
+    "round_recovered": "info",
+    "recovery_failed": "page",
+    "backoff": "warn",
+    "method_escalated": "warn",
+    "method_deescalated": "info",
+    "codec_degraded": "warn",
+    "peer_quality_flagged": "page",
+    "mass_lost_at_deadline": "warn",
+    "alert_raised": "page",
+    "alert_cleared": "info",
+}
 
 # The ambient trace id: set by Tracer.trace_scope around a round on the
 # client side, and restored by the transport server around each handler
@@ -394,6 +421,9 @@ class Tracer:
         self._hist = registry.histogram(
             "swarm.span_seconds", "round phase durations by span name"
         ) if registry is not None else None
+        # Finished-span hook (the watchdog's per-level round-wall feed):
+        # called with each ended span's dict, exceptions swallowed.
+        self.on_record: Optional[Callable[[dict], None]] = None
 
     def start(self, name: str, trace: Optional[str] = None, **attrs: Any) -> Optional[Span]:
         if not self.enabled:
@@ -405,10 +435,13 @@ class Tracer:
 
     def _finish(self, span: Span) -> None:
         try:
+            sp = span.as_dict()
             with self._lock:
-                self._done.append(span.as_dict())
+                self._done.append(sp)
             if self._hist is not None and span.dur_s is not None:
                 self._hist.observe(span.dur_s, span=span.name)
+            if self.on_record is not None:
+                self.on_record(sp)
         except Exception as e:  # noqa: BLE001 — tracing must never fail the round
             log.debug("span finish failed: %s", errstr(e))
 
@@ -432,6 +465,11 @@ class Tracer:
             self._done.append(sp)
         if self._hist is not None:
             self._hist.observe(dur_s, span=name)
+        if self.on_record is not None:
+            try:
+                self.on_record(sp)
+            except Exception as e:  # noqa: BLE001 — the hook must not fail the caller
+                log.debug("span hook failed: %s", errstr(e))
 
     @contextlib.contextmanager
     def span(self, name: str, trace: Optional[str] = None, **attrs: Any) -> Iterator[Optional[Span]]:
@@ -510,10 +548,15 @@ class FlightRecorder:
             return
         try:
             trace = fields.pop("trace", None) or current_trace()
+            # Severity rides every event (triage tier for the alerting
+            # plane): explicit sev= wins, else the documented per-kind
+            # default, else "info".
+            sev = fields.pop("sev", None) or KIND_SEVERITY.get(str(kind), "info")
             ev = {
                 "seq": self._seq,
                 "t": round(self._clock(), 6),
                 "kind": str(kind),
+                "sev": str(sev),
                 "peer": self.peer_id,
             }
             if trace:
@@ -526,15 +569,33 @@ class FlightRecorder:
         except Exception as e:  # noqa: BLE001 — recording must never fail the caller
             log.debug("flight record failed: %s", errstr(e))
 
-    def dump(self, since: float = 0.0, kinds: Optional[List[str]] = None) -> List[dict]:
+    def dump(
+        self,
+        since: float = 0.0,
+        kinds: Optional[List[str]] = None,
+        since_seq: Optional[int] = None,
+    ) -> List[dict]:
+        """Ring contents, filterable by time (``since``), kind, and the
+        monotonic ``since_seq`` CURSOR (events with seq >= since_seq) —
+        the incremental-poll half of the flight RPC: a watchdog poller or
+        chaos collector passes the previous reply's ``next_seq`` back and
+        re-ships only what's new instead of the whole ring."""
         with self._lock:
             out = list(self._events)
         if since:
             out = [e for e in out if e["t"] >= since]
+        if since_seq is not None:
+            out = [e for e in out if e["seq"] >= since_seq]
         if kinds:
             want = set(kinds)
             out = [e for e in out if e["kind"] in want]
         return out
+
+    @property
+    def next_seq(self) -> int:
+        """The cursor a caller passes as ``since_seq`` next poll to see
+        only events recorded after everything currently in the ring."""
+        return self._seq
 
     def clear(self) -> None:
         with self._lock:
@@ -561,6 +622,7 @@ class Telemetry:
         clock: Callable[[], float] = time.time,
         enabled: bool = True,
         health_enabled: Optional[bool] = None,
+        watchdog_enabled: Optional[bool] = None,
     ):
         self.peer_id = peer_id
         self.enabled = enabled
@@ -581,6 +643,22 @@ class Telemetry:
             self.registry, self.recorder, peer_id,
             enabled=bool(enabled and health_enabled), clock=clock,
         )
+        # Watchdog layer (swarm/watchdog.py): streaming anomaly detectors
+        # over the plane's own series. Gated independently the same way
+        # (--no-watchdog keeps tracing/health on but ships no alert
+        # bytes); --no-telemetry disables everything. Always constructed
+        # so call sites stay branch-free.
+        from distributedvolunteercomputing_tpu.swarm import watchdog as watchdog_mod
+
+        if watchdog_enabled is None:
+            watchdog_enabled = enabled
+        self.watchdog = watchdog_mod.Watchdog(
+            self.registry, self.recorder, peer_id,
+            enabled=bool(enabled and watchdog_enabled), clock=clock,
+        )
+        if self.watchdog.enabled:
+            # Ended round spans feed the per-level wall detectors.
+            self.tracer.on_record = self.watchdog.observe_span
 
     def set_clock(self, clock: Callable[[], float]) -> None:
         """Adopt the ClockSync-corrected clock once the volunteer builds
@@ -589,6 +667,7 @@ class Telemetry:
         self.tracer._clock = clock
         self.recorder._clock = clock
         self.health.clock = clock
+        self.watchdog.clock = clock
 
     # -- hot-path shorthands (None/no-op when disabled) ---------------------
 
@@ -618,18 +697,39 @@ class Telemetry:
             }, b""
 
         async def _flight(args: dict, payload: bytes):
+            since_seq = args.get("since_seq")
+            # Cursor read BEFORE the dump: an event recorded (from a
+            # trainer/averager thread) between the two reads must show up
+            # in the NEXT poll, not vanish — at-least-once duplication is
+            # fine for a poller, a silently dropped event is not.
+            next_seq = self.recorder.next_seq
             return {
                 "schema_version": TELEMETRY_SCHEMA_VERSION,
                 "peer": self.peer_id,
                 "events": self.recorder.dump(
                     since=float(args.get("since") or 0.0),
                     kinds=args.get("kinds") or None,
+                    since_seq=int(since_seq) if since_seq is not None else None,
                 ),
+                # Incremental cursor: pass back as since_seq next poll and
+                # repeated dumps ship only new events, not the whole ring.
+                "next_seq": next_seq,
             }, b""
+
+        async def _prom(args: dict, payload: bytes):
+            # Prometheus text exposition of the whole registry: any stock
+            # scraper (or the --metrics-port HTTP shim) can watch this
+            # volunteer without the coordinator.
+            text = render_prom(self.registry.scrape())
+            return {
+                "peer": self.peer_id,
+                "content_type": PROM_CONTENT_TYPE,
+            }, text.encode()
 
         transport.register(SCRAPE_METHOD, _scrape)
         transport.register(TRACE_METHOD, _trace)
         transport.register(FLIGHT_METHOD, _flight)
+        transport.register(PROM_METHOD, _prom)
 
     def scrape(self) -> dict:
         out = self.registry.scrape()
@@ -639,6 +739,9 @@ class Telemetry:
         # plus the bounded sketch history — what trace_report matches
         # across peers by trace id for the per-round mixing-error column.
         out["health"] = self.health.scrape()
+        # Watchdog view (None when disabled): the firing alert set plus
+        # lifetime raise/clear totals and per-level wall histograms.
+        out["watchdog"] = self.watchdog.summary()
         return out
 
     # -- report summary (rides the cp.exchange beat) -------------------------
@@ -690,6 +793,12 @@ STATUS_TELEMETRY_SCHEMA: Dict[str, type] = {
     # (the full health rollup lives at coord.status["health"], pinned by
     # health.STATUS_HEALTH_SCHEMA).
     "health_reporting": int,
+    # v3: staleness stamp — seconds since the FRESHEST contributing report
+    # landed, stamped by the serving replica on the telemetry clock. A
+    # frozen replica serves a growing age_s; a healthy quiet swarm serves
+    # a small one. (Stamped at serve time, so rollup_status() output only
+    # carries it after the replica's status path adds it.)
+    "age_s": float,
 }
 STATUS_SPAN_SCHEMA: Dict[str, type] = {
     "count": int,
@@ -730,3 +839,148 @@ def rollup_status(fresh_reports: List[dict]) -> Optional[dict]:
             1 for m in fresh_reports if isinstance(m.get("health"), dict)
         ),
     }
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_PROM_NAME_RE = None
+
+
+def _prom_name(name: str) -> str:
+    global _PROM_NAME_RE
+    if _PROM_NAME_RE is None:
+        import re
+
+        _PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+    out = _PROM_NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+        parts.append(f'{_prom_name(str(k))}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def render_prom(scrape: dict) -> str:
+    """Render a registry scrape (:meth:`MetricsRegistry.scrape`) in the
+    Prometheus text exposition format, so any stock scraper can watch a
+    volunteer directly — no coordinator, no custom client. Dotted names
+    sanitize to underscores; histograms emit the standard cumulative
+    ``_bucket``/``_sum``/``_count`` triple over the shared log2 bounds."""
+    lines: List[str] = []
+    for name, m in sorted((scrape.get("metrics") or {}).items()):
+        pname = _prom_name(name)
+        mtype = m.get("type")
+        if mtype == "counter":
+            lines.append(f"# TYPE {pname} counter")
+            for v in m.get("values") or []:
+                lines.append(
+                    f"{pname}{_prom_label_str(v.get('labels') or {})} "
+                    f"{float(v['value']):g}"
+                )
+        elif mtype == "gauge":
+            lines.append(f"# TYPE {pname} gauge")
+            for v in m.get("values") or []:
+                lines.append(
+                    f"{pname}{_prom_label_str(v.get('labels') or {})} "
+                    f"{float(v['value']):g}"
+                )
+        elif mtype == "histogram":
+            lines.append(f"# TYPE {pname} histogram")
+            bounds = m.get("bucket_bounds") or list(HIST_BUCKETS)
+            for v in m.get("values") or []:
+                labels = dict(v.get("labels") or {})
+                acc = 0
+                for ub, c in zip(bounds, v.get("buckets") or []):
+                    acc += int(c)
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_prom_label_str({**labels, 'le': f'{ub:g}'})} {acc}"
+                    )
+                acc += int((v.get("buckets") or [0])[-1])
+                lines.append(
+                    f"{pname}_bucket"
+                    f"{_prom_label_str({**labels, 'le': '+Inf'})} "
+                    f"{int(v.get('count') or acc)}"
+                )
+                lines.append(
+                    f"{pname}_sum{_prom_label_str(labels)} "
+                    f"{float(v.get('sum') or 0.0):g}"
+                )
+                lines.append(
+                    f"{pname}_count{_prom_label_str(labels)} "
+                    f"{int(v.get('count') or 0)}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+class MetricsHTTPServer:
+    """Minimal local HTTP shim serving ``GET /metrics`` in Prometheus text
+    format (the ``--metrics-port`` toggle): hand-rolled over asyncio
+    streams — no HTTP dependency — because the only consumers are stock
+    scrapers doing one GET per interval. Binds the volunteer's host; port
+    0 picks an ephemeral port (returned from :meth:`start`)."""
+
+    def __init__(self, telemetry: "Telemetry", host: str = "127.0.0.1", port: int = 0):
+        self.telemetry = telemetry
+        self.host = host
+        self.port = int(port)
+        self._server = None
+
+    async def start(self) -> Tuple[str, int]:
+        import asyncio
+
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("metrics endpoint on http://%s:%d/metrics", self.host, self.port)
+        return self.host, self.port
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            request = await reader.readline()
+            # Drain headers (bounded) so keep-alive clients see a clean close.
+            for _ in range(64):
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.decode("latin-1", "replace").split()
+            path = parts[1] if len(parts) >= 2 else ""
+            if parts[:1] == ["GET"] and path.split("?")[0] in ("/metrics", "/"):
+                body = render_prom(self.telemetry.registry.scrape()).encode()
+                head = (
+                    "HTTP/1.0 200 OK\r\n"
+                    f"Content-Type: {PROM_CONTENT_TYPE}\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n"
+                ).encode()
+            else:
+                body = b"watchdog: only /metrics lives here\n"
+                head = (
+                    "HTTP/1.0 404 Not Found\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n"
+                ).encode()
+            writer.write(head + body)
+            await writer.drain()
+        except Exception as e:  # noqa: BLE001 — a broken scraper must not log-spam
+            log.debug("metrics request failed: %s", errstr(e))
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

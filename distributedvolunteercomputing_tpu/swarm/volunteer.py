@@ -218,6 +218,18 @@ class VolunteerConfig:
     # heartbeat report — while the rest of the telemetry plane stays on.
     # --no-telemetry disables both.
     health_probe: bool = True
+    # Swarm watchdog (swarm/watchdog.py): streaming anomaly detectors
+    # (commit-rate collapse, per-level round-wall inflation, mass-fraction
+    # drops, bandwidth collapse, control-plane beat failure streaks,
+    # quality-flag alerts) with hysteresis + cooldown, riding the report
+    # beat as a compact firing set. On by default; --no-watchdog disables
+    # every detector end-to-end — no alert bytes ride the heartbeat —
+    # while tracing/health stay on. --no-telemetry disables everything.
+    watchdog: bool = True
+    # Local Prometheus text endpoint (GET /metrics) for stock scrapers:
+    # 0 = off (the telemetry.prom debug RPC always answers on the swarm
+    # transport regardless).
+    metrics_port: int = 0
 
     def __post_init__(self):
         if not self.peer_id:
@@ -244,6 +256,11 @@ class VolunteerConfig:
         if self.phi_threshold <= 0:
             raise ValueError(
                 f"phi_threshold must be > 0, got {self.phi_threshold}"
+            )
+        if not (0 <= self.metrics_port <= 65535):
+            raise ValueError(
+                f"metrics_port must be in [0, 65535] (0 = off), got "
+                f"{self.metrics_port}"
             )
         if self.group_rotation_s < 0:
             raise ValueError(
@@ -442,7 +459,9 @@ class Volunteer:
         self.telemetry = Telemetry(
             peer_id=cfg.peer_id, enabled=cfg.telemetry,
             health_enabled=cfg.telemetry and cfg.health_probe,
+            watchdog_enabled=cfg.telemetry and cfg.watchdog,
         )
+        self._metrics_server = None
         # Structured-log identity: with DVC_LOG_JSON=1 every line this
         # process emits carries who/where, join-able against traces.
         # First volunteer wins — the fields are process-global, and in a
@@ -525,9 +544,26 @@ class Volunteer:
         self._loop_monitor = maybe_enable_from_env()
         await self.transport.start()
         # Debug/collection surface: telemetry.scrape / telemetry.trace /
-        # telemetry.flight answer on this volunteer's transport (operators
-        # and experiments/trace_report.py dial them directly).
+        # telemetry.flight / telemetry.prom answer on this volunteer's
+        # transport (operators and experiments/trace_report.py dial them
+        # directly).
         self.telemetry.register_rpcs(self.transport)
+        if self.cfg.metrics_port:
+            # Local Prometheus endpoint: any stock scraper can watch this
+            # volunteer without the coordinator (or the swarm transport).
+            from distributedvolunteercomputing_tpu.swarm.telemetry import (
+                MetricsHTTPServer,
+            )
+
+            # Loopback ONLY: the swarm transport binds cfg.host (often
+            # 0.0.0.0) with MAC-covered frames, but this endpoint is
+            # plain unauthenticated HTTP serving the full registry — the
+            # documented contract is a LOCAL scrape shim, so it must not
+            # ride the volunteer's public bind address.
+            self._metrics_server = MetricsHTTPServer(
+                self.telemetry, "127.0.0.1", self.cfg.metrics_port
+            )
+            await self._metrics_server.start()
         bootstrap = _parse_addrs(self.cfg.coordinator) or None
         await self.dht.start(bootstrap=bootstrap)
         from distributedvolunteercomputing_tpu.swarm.control_plane import (
@@ -881,6 +917,27 @@ class Volunteer:
                 # each other's unpublished window (GossipAverager.publish).
                 _, snap = self.trainer.host_snapshot()
                 self.averager.publish(bundle.avg_select(snap))
+        if self.telemetry.watchdog.enabled:
+            # Watchdog probes over the surfaces built above: commit-rate,
+            # mass-fraction, per-peer bandwidth EWMAs, control-plane beat
+            # outcomes, quality flags (per-level round walls feed via the
+            # tracer hook). Ticked once per report beat (_build_report).
+            transport = self.transport
+
+            def _peer_bandwidths(max_age_s: float = 120.0) -> Dict[str, float]:
+                cutoff = time.monotonic() - max_age_s
+                return {
+                    f"{host}:{port}": float(st.bw_down_ewma)
+                    for (host, port), st in transport._peer_stats.items()
+                    if st.bw_down_ewma is not None and st.bw_down_t >= cutoff
+                }
+
+            self.telemetry.watchdog.wire_volunteer(
+                averager=self.averager,
+                control_plane=self.control_plane,
+                health=self.telemetry.health,
+                bandwidths=_peer_bandwidths,
+            )
         log.info(
             "volunteer %s up on %s:%d (model=%s averaging=%s)",
             self.cfg.peer_id, *self.transport.addr, self.cfg.model, self.cfg.averaging,
@@ -918,6 +975,18 @@ class Volunteer:
             # cp.exchange beat via report_source and is rolled up by the
             # control-plane replicas into coord.status["telemetry"].
             report["telemetry"] = self.telemetry.summary()
+        wd = self.telemetry.watchdog
+        if wd.enabled:
+            # One watchdog evaluation pass per report beat (the probes
+            # sample commit counters, mass fractions, bandwidth EWMAs,
+            # beat outcomes), then the compact firing set rides the same
+            # batched cp.exchange the rest of the report does. Absent
+            # entirely — no alert bytes on the heartbeat — under
+            # --no-watchdog / --no-telemetry.
+            wd.tick()
+            summary = wd.summary()
+            if summary is not None:
+                report["watchdog"] = summary
         health = self.telemetry.health.summary()
         if health is not None:
             # Training-health summary (post-round parameter sketch, mass
@@ -1065,6 +1134,11 @@ class Volunteer:
                 except Exception:
                     pass
             await self.dht.stop()
+            if self._metrics_server is not None:
+                try:
+                    await self._metrics_server.close()
+                except Exception:
+                    pass
             if getattr(self, "_loop_monitor", None) is not None:
                 await self._loop_monitor.stop()
             await self.transport.close()

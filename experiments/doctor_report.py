@@ -1,0 +1,386 @@
+#!/usr/bin/env python
+"""Root-cause doctor: join firing alerts with flight-recorder events,
+trace evidence, and health/quality telemetry into RANKED root-cause
+hypotheses — the third layer of the swarm watchdog (ISSUE 13).
+
+The alerting tier (swarm/watchdog.py) answers *that* something broke;
+this module answers *what probably broke it*, by joining the three
+evidence planes the telemetry substrate already shares a round-key/time
+axis across:
+
+- **alerts** — ``alert_raised`` transitions (volunteer detectors + the
+  replica-side SLO/mixing plane), each naming a detector kind and a key
+  (hierarchy level, peer, link).
+- **flight events** — every volunteer's flight-recorder ring
+  (depositions, fence rejections, mass-loss, quality flags, backoff),
+  each carrying peer, severity, and the round trace it happened under.
+- **health/quality** — per-peer quality scores, lost-mass attribution,
+  bandwidth evidence.
+
+Each RULE below scores one failure-class hypothesis from that joined
+evidence and emits a causal chain (e.g. ``cross-zone bw collapse on
+dc<->home -> level=cross deadline inflation -> mixing stall``). The
+output is the ranked list — highest score first — with the evidence each
+hypothesis rode on, so an operator (or the chaos verdict) can audit the
+diagnosis instead of trusting it.
+
+Usage:
+    python experiments/doctor_report.py <chaos_artifact.json> [--scenario k]
+        # diagnose a chaos_soak artifact (reads its alerts + flight dumps)
+    python experiments/doctor_report.py --bundle <bundle.json>
+        # diagnose a raw evidence bundle (the diagnose() input, verbatim)
+
+Library use (what ``chaos_soak.py --watchdog`` asserts against):
+    from doctor_report import diagnose
+    ranked = diagnose(bundle)   # bundle: {"alerts": [...], "flight": {...}, ...}
+    ranked[0]["cause"]          # the top hypothesis
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+# Evidence weights per rule: corroboration across planes beats volume
+# within one plane, so each distinct evidence CLASS contributes once and
+# the score saturates — 10 depositions are not 10x the evidence of 3.
+_CAP = 1.0
+
+
+def _alerts_of(bundle: dict, kind: str, key_prefix: str = "") -> List[dict]:
+    out = []
+    for a in bundle.get("alerts") or []:
+        if a.get("kind") != kind:
+            continue
+        if key_prefix and not str(a.get("key", "")).startswith(key_prefix):
+            continue
+        out.append(a)
+    return out
+
+
+def _events_of(bundle: dict, kind: str) -> List[dict]:
+    out = []
+    for events in (bundle.get("flight") or {}).values():
+        for e in events or []:
+            if e.get("kind") == kind:
+                out.append(e)
+    return out
+
+
+def _sat(n: int, k: int) -> float:
+    """Saturating evidence weight: 0 at n=0, 1 at n>=k."""
+    return min(float(n) / float(max(k, 1)), _CAP)
+
+
+def _rule_leader_crash_storm(bundle: dict) -> Optional[dict]:
+    """Repeated depositions of the same leader + wall/commit anomalies ->
+    a crash-looping (or serially killed) leader."""
+    deps = _events_of(bundle, "leader_deposed")
+    if not deps:
+        return None
+    by_leader = Counter(str(e.get("leader", "?")) for e in deps)
+    leader, n = by_leader.most_common(1)[0]
+    wall = _alerts_of(bundle, "round_wall_inflation")
+    rate = _alerts_of(bundle, "commit_rate_collapse")
+    recov = _events_of(bundle, "round_recovered")
+    score = (
+        0.5 * _sat(n, 3)
+        + 0.3 * _sat(len(wall) + len(rate), 1)
+        + 0.2 * _sat(len(recov), 2)
+    )
+    chain = (
+        f"leader {leader} deposed {n}x -> epoch-fenced recovery rounds "
+        f"({len(recov)} recovered) -> round wall inflation"
+    )
+    return {
+        "cause": "leader_crash_storm",
+        "score": round(score, 4),
+        "peers": [leader],
+        "chain": chain,
+        "evidence": {
+            "leader_deposed_events": n,
+            "depositions_by_leader": dict(by_leader),
+            "round_wall_alerts": len(wall),
+            "commit_rate_alerts": len(rate),
+            "rounds_recovered_events": len(recov),
+        },
+    }
+
+
+def _rule_straggler(bundle: dict) -> Optional[dict]:
+    """Deadline-dropped gradient mass repeatedly attributed to one peer +
+    a mass-fraction alert -> a straggler losing its mass at the deadline."""
+    losses = _events_of(bundle, "mass_lost_at_deadline")
+    if not losses:
+        return None
+    dropped = Counter()
+    for e in losses:
+        for p in (e.get("excluded") or []) + (e.get("aborted") or []):
+            dropped[str(p)] += 1
+    if not dropped:
+        return None
+    peer, n = dropped.most_common(1)[0]
+    mass = _alerts_of(bundle, "mass_frac_drop")
+    slo = _alerts_of(bundle, "slo_burn", key_prefix="mass_committed_frac")
+    # A straggler inflates nothing per se — its mass is CUT at the
+    # deadline — so wall evidence is not required; quality flags argue
+    # AGAINST this rule (that is the byzantine rule's evidence).
+    flags = [
+        e for e in _events_of(bundle, "peer_quality_flagged")
+        if str(e.get("peer_flagged", e.get("peer"))) == peer
+    ]
+    score = (
+        0.5 * _sat(n, 3)
+        + 0.4 * _sat(len(mass) + len(slo), 1)
+        + (-0.3 if flags else 0.1)
+    )
+    chain = (
+        f"peer {peer} dropped at the round deadline {n}x -> "
+        f"mass_committed_frac drop ({len(mass)} alert(s))"
+    )
+    return {
+        "cause": "straggler_deadline_drop",
+        "score": round(max(score, 0.0), 4),
+        "peers": [peer],
+        "chain": chain,
+        "evidence": {
+            "mass_lost_events": len(losses),
+            "dropped_by_peer": dict(dropped),
+            "mass_frac_alerts": len(mass),
+            "slo_burn_alerts": len(slo),
+        },
+    }
+
+
+def _rule_thin_cross_zone_link(bundle: dict) -> Optional[dict]:
+    """Cross-LEVEL wall inflation + mixing stall (+ bandwidth collapse on
+    a zone pair) -> the cross-zone links are the bottleneck, not any one
+    peer."""
+    wall_cross = _alerts_of(bundle, "round_wall_inflation", key_prefix="cross")
+    stall = _alerts_of(bundle, "mixing_stall")
+    bw = _alerts_of(bundle, "peer_bw_collapse")
+    if not wall_cross and not stall:
+        return None
+    links = sorted({str(a.get("key", "")) for a in bw if a.get("key")})
+    score = (
+        0.4 * _sat(len(wall_cross), 1)
+        + 0.4 * _sat(len(stall), 1)
+        + 0.2 * _sat(len(bw), 1)
+    )
+    chain = (
+        (f"bw collapse on {', '.join(links)} -> " if links else "")
+        + "level=cross deadline inflation -> cross-zone mixing stall"
+    )
+    return {
+        "cause": "thin_cross_zone_link",
+        "score": round(score, 4),
+        "peers": links,
+        "chain": chain,
+        "evidence": {
+            "cross_wall_alerts": len(wall_cross),
+            "mixing_stall_alerts": len(stall),
+            "bw_collapse_alerts": len(bw),
+            "links": links,
+        },
+    }
+
+
+def _rule_byzantine_contributor(bundle: dict) -> Optional[dict]:
+    """Persistent quality flags on one peer (the robust estimators keep
+    trimming it) -> a byzantine/garbage contributor."""
+    flags = _events_of(bundle, "peer_quality_flagged")
+    byz_alerts = _alerts_of(bundle, "byzantine_contributor")
+    flagged = Counter(
+        str(e.get("peer_flagged") or e.get("peer") or "?") for e in flags
+    )
+    for a in byz_alerts:
+        if a.get("key"):
+            flagged[str(a["key"])] += 1
+    if not flagged:
+        return None
+    peer, n = flagged.most_common(1)[0]
+    quality = bundle.get("quality") or {}
+    qrec = quality.get(peer) or {}
+    score = (
+        0.5 * _sat(n, 2)
+        + 0.3 * _sat(len(byz_alerts), 1)
+        + (0.2 if qrec.get("flagged") or qrec.get("score", 1.0) < 0.5 else 0.0)
+    )
+    chain = (
+        f"peer {peer} persistently trimmed by the robust fold -> "
+        f"quality flag ({n} flag event(s)/alert(s))"
+    )
+    return {
+        "cause": "byzantine_contributor",
+        "score": round(score, 4),
+        "peers": [peer],
+        "chain": chain,
+        "evidence": {
+            "flag_events": len(flags),
+            "byzantine_alerts": len(byz_alerts),
+            "flagged_by_peer": dict(flagged),
+            "quality_record": qrec or None,
+        },
+    }
+
+
+def _rule_control_plane_outage(bundle: dict) -> Optional[dict]:
+    """Beat failure streaks + status staleness -> the control plane, not
+    the data plane, is what broke."""
+    beats = _alerts_of(bundle, "cp_beat_failures")
+    fresh = _alerts_of(bundle, "slo_burn", key_prefix="status_freshness")
+    if not beats and not fresh:
+        return None
+    score = 0.6 * _sat(len(beats), 1) + 0.4 * _sat(len(fresh), 1)
+    return {
+        "cause": "control_plane_outage",
+        "score": round(score, 4),
+        "peers": [],
+        "chain": "control-plane beat failures -> report staleness",
+        "evidence": {
+            "beat_failure_alerts": len(beats),
+            "freshness_burn_alerts": len(fresh),
+        },
+    }
+
+
+RULES = (
+    _rule_leader_crash_storm,
+    _rule_straggler,
+    _rule_thin_cross_zone_link,
+    _rule_byzantine_contributor,
+    _rule_control_plane_outage,
+)
+
+
+def diagnose(bundle: Dict[str, Any]) -> List[dict]:
+    """Rank root-cause hypotheses over an evidence bundle.
+
+    ``bundle`` keys (all optional — rules skip absent planes):
+      - ``alerts``: list of alert dicts / alert_raised events (``kind``,
+        ``key``, ``severity``; flight-event form with ``alert`` instead of
+        ``kind`` is normalized here).
+      - ``flight``: peer -> list of flight-recorder events.
+      - ``quality``: peer -> {score, rounds, flagged} (health rollup form).
+
+    Returns hypotheses sorted by score (desc); empty when no rule found
+    any evidence (a healthy swarm diagnoses to nothing, by design)."""
+    # Normalize alert_raised flight events into plain alert dicts.
+    alerts = []
+    for a in bundle.get("alerts") or []:
+        if not isinstance(a, dict):
+            continue
+        if a.get("kind") == "alert_raised" and a.get("alert"):
+            alerts.append({**a, "kind": a["alert"]})
+        else:
+            alerts.append(a)
+    norm = dict(bundle)
+    norm["alerts"] = alerts
+    out = []
+    for rule in RULES:
+        try:
+            hyp = rule(norm)
+        except Exception as e:  # noqa: BLE001 — one rule must not kill the report
+            hyp = {
+                "cause": rule.__name__, "score": 0.0, "peers": [],
+                "chain": f"rule failed: {e}", "evidence": {},
+            }
+        if hyp is not None and hyp["score"] > 0:
+            out.append(hyp)
+    out.sort(key=lambda h: (-h["score"], h["cause"]))
+    return out
+
+
+def bundle_from_artifact(artifact: dict, scenario: Optional[str] = None) -> dict:
+    """Build a diagnose() bundle from a chaos_soak artifact: alert events
+    are harvested from every flight recorder in the (sub)tree, firing
+    sets from any embedded watchdog/alerts sections."""
+    root = artifact
+    if scenario:
+        for part in scenario.split("."):
+            root = root.get(part) or {}
+    alerts: List[dict] = []
+    flight: Dict[str, list] = {}
+    quality: Dict[str, dict] = {}
+
+    def walk(node: Any) -> None:
+        if isinstance(node, dict):
+            fr = node.get("flight_recorders")
+            if isinstance(fr, dict):
+                for pid, events in fr.items():
+                    if isinstance(events, list):
+                        flight.setdefault(str(pid), []).extend(events)
+                        alerts.extend(
+                            e for e in events
+                            if isinstance(e, dict) and e.get("kind") == "alert_raised"
+                        )
+            al = node.get("alerts")
+            if isinstance(al, dict) and isinstance(al.get("firing"), list):
+                alerts.extend(a for a in al["firing"] if isinstance(a, dict))
+            q = node.get("quality")
+            if isinstance(q, dict):
+                for pid, rec in q.items():
+                    if isinstance(rec, dict) and "score" in rec:
+                        quality[str(pid)] = rec
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(root)
+    return {"alerts": alerts, "flight": flight, "quality": quality}
+
+
+def render(ranked: List[dict]) -> str:
+    if not ranked:
+        return "doctor: no anomaly evidence found — swarm looks healthy\n"
+    lines = ["doctor: ranked root-cause hypotheses", ""]
+    for i, h in enumerate(ranked, 1):
+        lines.append(
+            f"{i}. {h['cause']}  (score {h['score']:.2f})"
+            + (f"  peers: {', '.join(h['peers'])}" if h["peers"] else "")
+        )
+        lines.append(f"   chain: {h['chain']}")
+        ev = ", ".join(f"{k}={v}" for k, v in h["evidence"].items()
+                       if not isinstance(v, dict))
+        if ev:
+            lines.append(f"   evidence: {ev}")
+    return "\n".join(lines) + "\n"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("artifact", nargs="?", default=None,
+                    help="chaos_soak artifact JSON to diagnose")
+    ap.add_argument("--scenario", default=None,
+                    help="dotted path into the artifact (e.g. "
+                         "watchdog_campaign.scenarios.straggler)")
+    ap.add_argument("--bundle", default=None,
+                    help="raw evidence-bundle JSON (diagnose() input)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the ranked hypotheses as JSON")
+    args = ap.parse_args()
+    if args.bundle:
+        with open(args.bundle) as f:
+            bundle = json.load(f)
+    elif args.artifact:
+        with open(args.artifact) as f:
+            artifact = json.load(f)
+        bundle = bundle_from_artifact(artifact, args.scenario)
+    else:
+        ap.error("pass a chaos artifact or --bundle")
+        return
+    ranked = diagnose(bundle)
+    if args.json:
+        print(json.dumps(ranked, indent=2))
+    else:
+        sys.stdout.write(render(ranked))
+
+
+if __name__ == "__main__":
+    main()

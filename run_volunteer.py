@@ -183,6 +183,18 @@ def main() -> None:
                          "contribution quality, codec distortion gauges): "
                          "no sketch bytes ride the heartbeat report; the "
                          "rest of the telemetry plane stays on")
+    ap.add_argument("--no-watchdog", action="store_true",
+                    help="disable the swarm watchdog only (streaming "
+                         "anomaly detectors: commit-rate collapse, round-"
+                         "wall inflation per level, mass-fraction drops, "
+                         "bandwidth collapse, beat-failure streaks, "
+                         "quality-flag alerts): no alert bytes ride the "
+                         "heartbeat report; tracing and the health probe "
+                         "stay on")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve GET /metrics in Prometheus text format on "
+                         "this local port (0 = off): any stock scraper can "
+                         "watch this volunteer without the coordinator")
     ap.add_argument("--host-replica", action="store_true",
                     help="host a control-plane replica on this volunteer: "
                          "serve coord.status and batched heartbeat/report "
@@ -343,6 +355,8 @@ def main() -> None:
         outer_momentum=args.outer_momentum,
         telemetry=not args.no_telemetry,
         health_probe=not (args.no_telemetry or args.no_health_probe),
+        watchdog=not (args.no_telemetry or args.no_watchdog),
+        metrics_port=args.metrics_port,
     )
     if cfg.averaging != "none":
         # Build/load the native host core BEFORE the event loop exists: the

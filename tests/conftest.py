@@ -101,6 +101,16 @@ def pytest_configure(config):
         "schema, health-probe overhead smoke) — in the default lane, and "
         "selectable on their own with -m health",
     )
+    config.addinivalue_line(
+        "markers",
+        "watchdog: swarm-watchdog tests (online baselines + anomaly "
+        "detectors with hysteresis/cooldown, SLO burn-rate windows, "
+        "alert lifecycle + flight severity, incremental flight cursor, "
+        "Prometheus exposition + /metrics endpoint, coord.status "
+        "slo/alerts schema walk, --no-watchdog end-to-end plumbing, "
+        "watchdog overhead smoke) — in the default lane, and selectable "
+        "on their own with -m watchdog",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
