@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributedvolunteercomputing_tpu.parallel.mesh import shard_map_manual
+
 
 def pipeline_trunk(
     block_fn: Callable[[Any, jax.Array], jax.Array],
@@ -112,13 +114,8 @@ def pipeline_trunk(
         # over pp replicates them to every stage.
         return jax.lax.psum(outputs, axis)
 
-    out = jax.shard_map(
-        run,
-        mesh=mesh,
-        in_specs=(blocks_spec, P()),
-        out_specs=P(),
-        axis_names={axis},
-        check_vma=False,
+    out = shard_map_manual(
+        run, mesh, (blocks_spec, P()), P(), axis
     )(blocks, mbs)
     return out.reshape(b, *x.shape[1:])
 

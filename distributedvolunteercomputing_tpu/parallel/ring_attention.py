@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributedvolunteercomputing_tpu.parallel.mesh import shard_map_manual
+
 NEG_INF = -1e30
 
 
@@ -68,7 +70,9 @@ def ring_attention(
     causal: bool = False,
 ) -> jax.Array:
     """Exact attention over the ring; call INSIDE shard_map over ``axis_name``."""
-    size = jax.lax.axis_size(axis_name)
+    # psum(1, axis) is the axis size on BOTH sides of the jax API split
+    # (jax.lax.axis_size does not exist on the tier-1 jax).
+    size = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, tl, d = q.shape
     scale = 1.0 / (d ** 0.5)
@@ -103,14 +107,7 @@ def sp_shard_map(inner, mesh: Mesh, axis: str):
     mesh axis automatic (GSPMD). Shared by ring and ulysses so the two
     impls can't diverge on the wrapping."""
     spec = P(None, None, axis, None)
-    return jax.shard_map(
-        inner,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        axis_names={axis},
-        check_vma=False,
-    )
+    return shard_map_manual(inner, mesh, (spec, spec, spec), spec, axis)
 
 
 def ring_attention_bhtd(

@@ -47,7 +47,9 @@ def ulysses_attention(
     over ``axis_name``."""
     from distributedvolunteercomputing_tpu.ops.attention import attention_core_local
 
-    sp = jax.lax.axis_size(axis_name)
+    # psum(1, axis) is the axis size on BOTH sides of the jax API split
+    # (jax.lax.axis_size does not exist on the tier-1 jax).
+    sp = jax.lax.psum(1, axis_name)
     h = q.shape[1]
     if h % sp != 0:
         raise ValueError(

@@ -54,6 +54,23 @@ aborts before ANY tile committed is reset cleanly and may retry; one that
 aborts after committing tiles is tainted for the round and later pushes
 under its key are refused.
 
+Tail-optimal hedged recovery (OptiReduce, PAPERS.md): beside the in-order
+original stream, the aggregator accepts **hedged tile-range replies**
+(``add_hedged``) — the leader re-requested a straggler's missing tiles over
+a second stream (``sync.refetch``) or decoded them from a ring neighbor's
+XOR redundancy sidecar. Hedged arrivals are idempotent by (slot, tile): a
+per-(slot, tile) arrival bitmap is the single source of truth, so a hedge
+and the original can never double-fold one tile, in either order. A slot
+whose every tile landed — through any mix of sources — auto-seals; one
+sealed with at least one hedged tile is classified ``recovered`` (not
+``included``) in ``mass_report``, so the win is auditable per round.
+Hedges never resurrect an aborted or tainted slot: replies for those are
+counted (``hedge_dropped``) and discarded, and a fenced aggregator counts
+hedged chunks with the same ``chunks_after_fence`` bookkeeping the
+original stream gets. The per-slot arrival **scoreboard** (tiles present,
+missing ranges, last-arrival age) is what the leader's hedge loop ranks
+targets from.
+
 Thread model: ``add_chunk``/``add_dense`` run on the event-loop thread (the
 transport's frame reader) or an averager worker thread, serialized by one
 lock; tile aggregation jobs run via ``asyncio.to_thread`` when a loop is
@@ -82,6 +99,30 @@ log = get_logger(__name__)
 # Sentinel job queued alongside window-closure tuples: "flush the mesh
 # mean folder's staged chunks on a worker" (see _spawn_jobs).
 _FLUSH = object()
+
+
+def encode_wire_elems(wire: str, x: np.ndarray) -> bytes:
+    """f32 elements -> wire bytes for the elementwise wires (f32/bf16).
+
+    The ONE home of the re-encode rule: the hedge/redundancy paths'
+    bit-identical-reencode invariant (refetch serving, tail retention,
+    XOR sidecars must all produce the exact bytes the original push
+    carried) rests on every encoder agreeing, so there is exactly one."""
+    x = np.ascontiguousarray(x, np.float32)
+    if wire == "bf16":
+        return native.f32_to_bf16(x).tobytes()
+    return x.tobytes()
+
+
+def wire_geometry(wire: str, chunk_bytes: int, n_elems: int) -> Tuple[int, int, int, int]:
+    """(element size, chunk bytes, tile elems, n tiles) for an elementwise
+    wire — THE tiling rule. The aggregator's bitmap, the refetch range
+    RPC, and the redundancy sidecars all address tiles by it, so like
+    ``encode_wire_elems`` it has exactly one home: a divergent copy would
+    silently shift hedged folds across tile boundaries."""
+    esz = 4 if wire == "f32" else 2
+    tile_elems = max(int(chunk_bytes) // esz, 1)
+    return esz, int(chunk_bytes), tile_elems, max(-(-int(n_elems) // tile_elems), 1)
 
 
 class TilePool:
@@ -199,18 +240,19 @@ class StreamingAggregator:
         pool: Optional[TilePool] = None,
         codec: Optional[mesh_codec_mod.MeshCodec] = None,
         telemetry=None,
+        tail_keep_tiles: int = 0,
     ):
         if wire not in ("f32", "bf16"):
             raise ValueError(f"streaming aggregation needs an elementwise wire, got {wire!r}")
-        esz = 4 if wire == "f32" else 2
+        esz, _, tile_elems, n_tiles = wire_geometry(wire, chunk_bytes, n_elems)
         if chunk_bytes % esz:
             raise ValueError(f"chunk_bytes {chunk_bytes} not {wire} element-aligned")
         self.n_elems = int(n_elems)
         self.wire = wire
         self.esz = esz
         self.chunk_bytes = int(chunk_bytes)
-        self.tile_elems = self.chunk_bytes // esz
-        self.n_tiles = max(-(-self.n_elems // self.tile_elems), 1)
+        self.tile_elems = tile_elems
+        self.n_tiles = n_tiles
         self.method = method
         self.mode = robust.tile_mode(method)
         self._kw_fn = kw_fn or (lambda n: {})
@@ -228,6 +270,29 @@ class StreamingAggregator:
         self._filled = np.zeros(n, np.int64)  # elements received per slot
         self._committed_tiles = np.zeros(n, np.int64)  # tiles folded per slot
         self._tasks: List[asyncio.Task] = []
+        # -- tail-optimal hedged recovery state --------------------------
+        # The per-(slot, tile) arrival bitmap is the idempotency ledger:
+        # one True per tile per slot, set by WHICHEVER source folds it
+        # first (original stream, hedged range reply, redundancy decode),
+        # checked by every other. _filled stays the ORIGINAL stream's
+        # in-order cursor; completeness is _tiles_got == n_tiles.
+        self._tile_have = np.zeros((n, self.n_tiles), bool)
+        self._tile_hedged = np.zeros((n, self.n_tiles), bool)
+        self._tiles_got = np.zeros(n, np.int64)
+        self._hedged_tiles = np.zeros(n, np.int64)  # hedge/redund-folded
+        # Per-slot arrival timing for the scoreboard (monotonic seconds
+        # since t0): first and latest tile arrival from ANY source.
+        self._first_at = np.full(n, -1.0)
+        self._last_at = np.full(n, -1.0)
+        # Seal latency per slot (seconds since arming) — the leader feeds
+        # these to the resilience policy's per-peer tail quantiles.
+        self._seal_at: Dict[int, float] = {}
+        # Summand redundancy: raw wire bytes of the last ``tail_keep_tiles``
+        # tiles are retained per (slot, tile) so an XOR sidecar from a ring
+        # neighbor can be decoded against the neighbor's own delivered tail
+        # at commit time. 0 = retain nothing (redundancy off).
+        self.tail_keep_tiles = int(tail_keep_tiles)
+        self._tail_bytes: Dict[Tuple[int, int], bytes] = {}
 
         self._tile_w: Optional[np.ndarray] = None
         self._windows: Dict[int, _Window] = {}
@@ -299,6 +364,13 @@ class StreamingAggregator:
         self.streamed_contribs = 0
         self.dense_contribs = 0
         self.aborted_contribs = 0
+        # Hedged-recovery gauges: tiles folded from a hedge/redundancy
+        # source, hedge replies for tiles that had already landed (wasted
+        # wire bytes — the AIMD budget's decrease signal), and replies
+        # refused outright (aborted/tainted slot, frozen round).
+        self.tiles_recovered = 0
+        self.hedge_duplicates = 0
+        self.hedge_dropped = 0
         # Leader-failover fencing: True once this aggregator was superseded
         # by a newer round generation (fence()). Chunks that still arrive —
         # a stale sink flushing after its round was deposed — are counted,
@@ -335,6 +407,11 @@ class StreamingAggregator:
         if out is not None:
             return native.bf16_to_f32(bits, out=out[: bits.size])
         return native.bf16_to_f32(bits)
+
+    def _encode_elems(self, x: np.ndarray) -> bytes:
+        """f32 elements back to this round's wire form (the inverse of
+        _decode; bit-identical for already-roundtripped values)."""
+        return encode_wire_elems(self.wire, x)
 
     # -- sink construction ----------------------------------------------------
 
@@ -406,30 +483,140 @@ class StreamingAggregator:
                     self._tainted.add(slot)
                 return
             self._filled[slot] = e0 + n
+            if self._tile_have[slot, tile]:
+                # A hedged reply folded this tile first: the bitmap wins.
+                # The in-order cursor still advances (the stream stays in
+                # sync); the redundant copy is the hedge's wasted bytes.
+                self.hedge_duplicates += 1
+                self._note_arrival_locked(slot)
+                return
             t0 = time.perf_counter()
-            if self.mode == "mean":
-                if self._folder is not None:
-                    # On-mesh: stage the RAW wire bytes (no decode on the
-                    # frame-reader thread); a worker flushes staged batches
-                    # through one fused device decode+scatter-add.
-                    if self._folder.add(tile, weight, data):
-                        fire.append(_FLUSH)
-                else:
-                    x = self._decode(data)
-                    native.weighted_sum_inplace(self._out[e0 : e0 + n], x, weight)
-                self._tile_w[tile] += weight
-                self._committed_tiles[slot] += 1
-                self.tiles_early += 1  # folded while the push was in flight
-            elif self.mode == "window":
-                self._window_row(slot, tile, self._decode(data), n, fire)
-            else:  # d2_dense / dense
-                row = self._row_buffer(slot)
-                self._decode(data, out=row[e0:])
-                self._committed_tiles[slot] += 1
-                if self.mode == "d2_dense":
-                    self._accumulate_d2(slot, tile, e0, e0 + n)
+            self._fold_tile_locked(slot, weight, tile, e0, n, data, fire)
+            self._mark_tile_locked(slot, tile, hedged=False)
             self.busy_s += time.perf_counter() - t0
         self._spawn_jobs(fire)
+
+    def _fold_tile_locked(
+        self, slot: int, weight: float, tile: int, e0: int, n: int,
+        data: bytes, fire: List, *, hedged: bool = False,
+    ) -> None:
+        """Fold one verified tile's wire bytes for ``slot`` — the shared
+        body behind the original stream (add_chunk) and hedged replies
+        (add_hedged). Caller holds the lock and has already established
+        the (slot, tile) is unfolded."""
+        if self.mode == "mean":
+            if self._folder is not None:
+                # On-mesh: stage the RAW wire bytes (no decode on the
+                # frame-reader thread); a worker flushes staged batches
+                # through one fused device decode+scatter-add.
+                if self._folder.add(tile, weight, data):
+                    fire.append(_FLUSH)
+            else:
+                x = self._decode(data)
+                native.weighted_sum_inplace(self._out[e0 : e0 + n], x, weight)
+            self._tile_w[tile] += weight
+            self._committed_tiles[slot] += 1
+            if not hedged:
+                # "Folded while the push was in flight" — a hedged tile
+                # is counted under tiles_recovered instead, never both.
+                self.tiles_early += 1
+        elif self.mode == "window":
+            self._window_row(slot, tile, self._decode(data), n, fire)
+        else:  # d2_dense / dense
+            row = self._row_buffer(slot)
+            self._decode(data, out=row[e0:])
+            self._committed_tiles[slot] += 1
+            if self.mode == "d2_dense":
+                self._accumulate_d2(slot, tile, e0, e0 + n)
+        if self.tail_keep_tiles and tile >= self.n_tiles - self.tail_keep_tiles:
+            # Summand redundancy: tail tiles double as XOR-decode keys for
+            # a ring neighbor's sidecar, so their wire bytes are retained
+            # (bounded: tail_keep_tiles x chunk_bytes per slot).
+            self._tail_bytes[(slot, tile)] = bytes(data)
+
+    def _note_arrival_locked(self, slot: int) -> None:
+        now = time.monotonic() - self.t0
+        if self._first_at[slot] < 0:
+            self._first_at[slot] = now
+        self._last_at[slot] = now
+
+    def _mark_tile_locked(self, slot: int, tile: int, *, hedged: bool) -> None:
+        """Record one folded (slot, tile) in the idempotency bitmap and
+        auto-seal the slot the moment its last tile lands — completeness
+        is tile-count, not the in-order cursor, so a contribution finished
+        by hedged replies seals exactly like a purely-streamed one.
+        Caller holds the lock."""
+        self._tile_have[slot, tile] = True
+        self._tiles_got[slot] += 1
+        self._note_arrival_locked(slot)
+        if hedged:
+            self._tile_hedged[slot, tile] = True
+            self._hedged_tiles[slot] += 1
+            self.tiles_recovered += 1
+        if (
+            self._tiles_got[slot] == self.n_tiles
+            and slot not in self._sealed
+            and slot not in self._aborted
+            and slot not in self._tainted
+        ):
+            self._sealed.add(slot)
+            self._seal_at[slot] = self._last_at[slot]
+            self.streamed_contribs += 1
+
+    def add_hedged(
+        self, peer: str, weight: float, off: int, data: bytes,
+        *, source: str = "refetch",
+    ) -> int:
+        """Fold one hedged tile reply (a ``sync.refetch`` range chunk or a
+        redundancy-sidecar decode) for ``peer``. Idempotent by (slot,
+        tile): a tile the original stream (or an earlier hedge) already
+        folded is counted as a duplicate and discarded — a hedge and the
+        original can never double-fold. Unlike the original stream, a
+        malformed reply only drops itself (the healthy original must not
+        be poisoned by a bad hedge), and an aborted/tainted slot is never
+        resurrected. Returns 1 when the tile folded, 0 otherwise."""
+        slot = self.slot_index.get(peer)
+        total = self.n_elems * self.esz
+        if (
+            slot is None
+            or not data
+            or off % self.chunk_bytes
+            or off >= total
+            or len(data) != min(self.chunk_bytes, total - off)
+        ):
+            with self._lock:
+                self.hedge_dropped += 1
+            return 0
+        tile = off // self.chunk_bytes
+        e0 = tile * self.tile_elems
+        n = len(data) // self.esz
+        fire: List[tuple] = []
+        with self._lock:
+            if self.fenced:
+                self.chunks_after_fence += 1
+                return 0
+            if self.frozen or slot in self._aborted or slot in self._tainted:
+                self.hedge_dropped += 1
+                return 0
+            if slot in self._sealed or self._tile_have[slot, tile]:
+                self.hedge_duplicates += 1
+                return 0
+            w = self._weights.get(slot)
+            if w is None:
+                # A silent straggler never declared a weight; the refetch
+                # reply carries the one its push would have (first write
+                # wins — a started stream's declared weight is kept).
+                w = float(weight)
+                if not np.isfinite(w) or w <= 0:
+                    self.hedge_dropped += 1
+                    return 0
+                self._weights[slot] = w
+            t0 = time.perf_counter()
+            self._fold_tile_locked(slot, w, tile, e0, n, data, fire, hedged=True)
+            self._mark_tile_locked(slot, tile, hedged=True)
+            self.busy_s += time.perf_counter() - t0
+        self._spawn_jobs(fire)
+        return 1
 
     def _spawn_jobs(self, fire: List) -> None:
         """Spawn queued aggregation work OUTSIDE the lock: window-closure
@@ -456,16 +643,40 @@ class StreamingAggregator:
         with self._lock:
             if self.frozen or slot in self._aborted or slot in self._tainted or slot in self._sealed:
                 return False
+            # Tiles a hedged reply (or an aborted-then-retried stream's
+            # surviving bitmap) already folded must not fold again: the
+            # dense feed covers exactly the MISSING tiles. The common case
+            # (no prior tile state) reduces to the whole-vector fast path.
+            partial = bool(self._tiles_got[slot])
+            w = float(self._weights.get(slot, w))
             t0 = time.perf_counter()
             if self.mode == "mean":
                 if self._folder is not None:
+                    if partial:
+                        # The device folder stages whole vectors only; a
+                        # per-tile dense backfill under it would need wire
+                        # re-encoding on the loop thread. Rare (auth +
+                        # hedge overlap) — refuse, the hedges own the slot.
+                        return False
                     self._folder.add_dense(buf, w)
+                    self._tile_w += w
+                    self._committed_tiles[slot] += self.n_tiles
+                elif partial:
+                    b32 = np.ascontiguousarray(buf, np.float32)
+                    for tile in range(self.n_tiles):
+                        if self._tile_have[slot, tile]:
+                            continue
+                        e0 = tile * self.tile_elems
+                        e1 = min(e0 + self.tile_elems, self.n_elems)
+                        native.weighted_sum_inplace(self._out[e0:e1], b32[e0:e1], w)
+                        self._tile_w[tile] += w
+                        self._committed_tiles[slot] += 1
                 else:
                     native.weighted_sum_inplace(
                         self._out, np.ascontiguousarray(buf, np.float32), w
                     )
-                self._tile_w += w
-                self._committed_tiles[slot] += self.n_tiles
+                    self._tile_w += w
+                    self._committed_tiles[slot] += self.n_tiles
             elif self.mode == "window":
                 # Borrowed reference, not a copy: rows flow into windows
                 # lazily (open ones now, future ones at creation, the rest
@@ -488,16 +699,33 @@ class StreamingAggregator:
             else:
                 row = self._row_buffer(slot)
                 row[:] = buf
-                self._committed_tiles[slot] += self.n_tiles
-                if self.mode == "d2_dense":
-                    for tile in range(self.n_tiles):
+                for tile in range(self.n_tiles):
+                    if self._tile_have[slot, tile]:
+                        continue  # hedge-folded: d2/commit already counted
+                    self._committed_tiles[slot] += 1
+                    if self.mode == "d2_dense":
                         e0 = tile * self.tile_elems
                         self._accumulate_d2(
                             slot, tile, e0, min(e0 + self.tile_elems, self.n_elems)
                         )
+            if self.tail_keep_tiles:
+                # Retain the tail tiles' WIRE form (re-encoded from the
+                # dense feed — bit-identical for f32/bf16 roundtrips) so
+                # this slot can serve as a ring neighbor's XOR-decode key.
+                b32 = np.ascontiguousarray(buf, np.float32)
+                for tile in range(self.n_tiles - self.tail_keep_tiles, self.n_tiles):
+                    if tile < 0 or (slot, tile) in self._tail_bytes:
+                        continue
+                    e0 = tile * self.tile_elems
+                    e1 = min(e0 + self.tile_elems, self.n_elems)
+                    self._tail_bytes[(slot, tile)] = self._encode_elems(b32[e0:e1])
             self.busy_s += time.perf_counter() - t0
             self._filled[slot] = self.n_elems
+            self._tile_have[slot, :] = True
+            self._tiles_got[slot] = self.n_tiles
+            self._note_arrival_locked(slot)
             self._sealed.add(slot)
+            self._seal_at.setdefault(slot, self._last_at[slot])
             self._weights[slot] = w
             self.dense_contribs += 1
         self._spawn_jobs(fire)
@@ -505,13 +733,21 @@ class StreamingAggregator:
 
     def seal_slot(self, slot: int) -> bool:
         """Mark a streamed contribution complete; False when it didn't
-        actually deliver every element (short stream)."""
+        actually deliver every element (short stream). Completeness is
+        TILE count, not the in-order cursor: a contribution whose tail a
+        hedge delivered seals (auto-sealed by the last fold already; this
+        just confirms it to the sink lifecycle)."""
         with self._lock:
             if slot in self._aborted or slot in self._tainted:
                 return False
-            if self._filled[slot] != self.n_elems:
+            if slot in self._sealed:
+                return True
+            if self._tiles_got[slot] != self.n_tiles:
                 return False
+            # Unreachable in practice (_mark_tile_locked auto-seals at the
+            # last fold) — kept as the sink lifecycle's backstop.
             self._sealed.add(slot)
+            self._seal_at.setdefault(slot, time.monotonic() - self.t0)
             self.streamed_contribs += 1
             return True
 
@@ -532,13 +768,27 @@ class StreamingAggregator:
                 self._tainted.add(slot)
             if self.mode in ("d2_dense", "dense"):
                 # Nothing irreversible happened (rows are retained until
-                # finalize): a retry starts clean.
+                # finalize): a retry starts clean — including the tile
+                # bitmap, or the retry's chunks would read as duplicates.
                 self._committed_tiles[slot] = 0
+                self._tile_have[slot, :] = False
+                self._tile_hedged[slot, :] = False
+                self._tiles_got[slot] = 0
+                self._hedged_tiles[slot] = 0
             if self.mode == "window":
                 for tile, win in self._windows.items():
                     if win.mask[slot]:
                         win.mask[slot] = False
                         win.count -= 1
+                        # Withdrawn rows leave the idempotency bitmap too:
+                        # only CLOSED tiles stand, and a clean retry's
+                        # chunks must not read as duplicates.
+                        if self._tile_have[slot, tile]:
+                            self._tile_have[slot, tile] = False
+                            self._tiles_got[slot] -= 1
+                            if self._tile_hedged[slot, tile]:
+                                self._tile_hedged[slot, tile] = False
+                                self._hedged_tiles[slot] -= 1
                 # Its absence may be exactly what held the remaining
                 # windows open — re-check the early-fire condition.
                 active = self._active_slots()
@@ -719,9 +969,10 @@ class StreamingAggregator:
                     slot not in self._sealed
                     and slot not in self._aborted
                     and slot not in self._tainted
-                    and self._filled[slot] == self.n_elems
+                    and self._tiles_got[slot] == self.n_tiles
                 ):
                     self._sealed.add(slot)
+                    self._seal_at.setdefault(slot, time.monotonic() - self.t0)
                     self.streamed_contribs += 1
 
     def fence(self) -> None:
@@ -759,26 +1010,111 @@ class StreamingAggregator:
     def mass_report(self) -> dict:
         """Balanced gradient-mass classification for this round (training-
         health layer, swarm/health.py): every armed slot lands in exactly
-        one of included (sealed) / aborted (died mid-payload or tainted) /
-        excluded (never sealed by the freeze — late, partial, or silent),
-        with the weight it DECLARED (0 for a slot that never spoke — its
-        undelivered mass is unknowable to the leader, so it balances as
-        one excluded slot at weight 0). included + excluded + aborted
-        weight sums to the total armed weight by construction; the
-        property test exercises the classification across the deadline /
-        abort / fence matrix."""
+        one of included (sealed purely by its own stream) / recovered
+        (sealed with at least one hedge/redundancy-folded tile — the
+        tail-optimal pipeline's auditable win) / aborted (died mid-payload
+        or tainted) / excluded (never sealed by the freeze — late,
+        partial, or silent), with the weight it DECLARED (0 for a slot
+        that never spoke — its undelivered mass is unknowable to the
+        leader, so it balances as one excluded slot at weight 0).
+        included + recovered + excluded + aborted weight sums to the total
+        armed weight by construction; the property test exercises the
+        classification across the deadline / abort / hedge / fence
+        matrix."""
         with self._lock:
             per_peer: Dict[str, dict] = {}
             for slot, pid in enumerate(self.slots):
                 w = float(self._weights.get(slot, 0.0))
                 if slot in self._sealed:
-                    oc = "included"
+                    oc = "recovered" if self._hedged_tiles[slot] else "included"
                 elif slot in self._aborted or slot in self._tainted:
                     oc = "aborted"
                 else:
                     oc = "excluded"
                 per_peer[pid] = {"outcome": oc, "weight": w}
         return health_mod.mass_report_from_per_peer(per_peer)
+
+    # -- tail-optimal hedged recovery surface --------------------------------
+
+    def scoreboard(self) -> Dict[str, dict]:
+        """Per-peer tile-arrival scoreboard — what the leader's hedge loop
+        ranks re-request targets from. ``missing`` is the contiguous
+        [t0, t1) tile ranges not yet folded from any source;
+        ``last_arrival_age_s`` is None until the slot's first tile."""
+        now = time.monotonic() - self.t0
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for slot, pid in enumerate(self.slots):
+                last = self._last_at[slot]
+                out[pid] = {
+                    "tiles_got": int(self._tiles_got[slot]),
+                    "n_tiles": self.n_tiles,
+                    "hedged_tiles": int(self._hedged_tiles[slot]),
+                    "sealed": slot in self._sealed,
+                    "aborted": slot in self._aborted or slot in self._tainted,
+                    "started": bool(self._first_at[slot] >= 0.0),
+                    "last_arrival_age_s": (
+                        round(now - last, 6) if last >= 0.0 else None
+                    ),
+                    "missing": self._missing_ranges_locked(slot),
+                }
+            return out
+
+    def _missing_ranges_locked(self, slot: int) -> List[Tuple[int, int]]:
+        # Fast paths first: the hedge loop polls this under the ingest
+        # lock every ~200 ms, and most slots are either COMPLETE (sealed/
+        # dense) or UNTOUCHED (silent) — neither needs the bitmap scan
+        # (at 1e6 tiles the flatnonzero temp alone is MBs per slot).
+        got = int(self._tiles_got[slot])
+        if got == self.n_tiles:
+            return []
+        if got == 0:
+            return [(0, self.n_tiles)]
+        missing = np.flatnonzero(~self._tile_have[slot])
+        if missing.size == 0:
+            return []
+        ranges: List[Tuple[int, int]] = []
+        start = prev = int(missing[0])
+        for t in missing[1:]:
+            t = int(t)
+            if t == prev + 1:
+                prev = t
+                continue
+            ranges.append((start, prev + 1))
+            start = prev = t
+        ranges.append((start, prev + 1))
+        return ranges
+
+    def tail_bytes(self, peer: str, tile: int) -> Optional[bytes]:
+        """The retained wire bytes of one of ``peer``'s tail tiles (None
+        unless redundancy retention covered it and the tile arrived) —
+        the XOR-decode key for a ring neighbor's sidecar."""
+        slot = self.slot_index.get(peer)
+        if slot is None:
+            return None
+        with self._lock:
+            return self._tail_bytes.get((slot, tile))
+
+    def seal_latencies(self) -> Dict[str, float]:
+        """Seconds from arming to each sealed contribution's completion —
+        the leader feeds these into the resilience policy's per-peer tail
+        quantiles (the hedge-target ranking evidence)."""
+        with self._lock:
+            return {
+                self.slots[s]: round(dt, 6) for s, dt in self._seal_at.items()
+            }
+
+    def hedge_stats(self) -> Dict[str, int]:
+        """Hedge-outcome counters for this round (AIMD feedback + gauges)."""
+        with self._lock:
+            return {
+                "tiles_recovered": int(self.tiles_recovered),
+                "hedge_duplicates": int(self.hedge_duplicates),
+                "hedge_dropped": int(self.hedge_dropped),
+                "slots_recovered": sum(
+                    1 for s in self._sealed if self._hedged_tiles[s]
+                ),
+            }
 
     def quality_d2(self) -> Dict[str, float]:
         """Per-peer summed squared distance to the committed aggregate
@@ -859,12 +1195,17 @@ class StreamingAggregator:
                         self.tiles_deadline += 1
                 return self._out
             # d2_dense / dense: stack the complete rows and run the dense
-            # estimator (selection from the PRE-ACCUMULATED d² for krum/bulyan).
+            # estimator (selection from the PRE-ACCUMULATED d² for krum/
+            # bulyan). Completeness is TILE count, not the in-order cursor:
+            # a hedge-completed row (out-of-order tiles, cursor never
+            # advanced) is complete and must aggregate — it was REPORTED
+            # recovered, so dropping it here would commit the accounting
+            # without the mass.
             slots = sorted(
                 self.slot_index[p]
                 for p in (included if included is not None else self.included_peers())
                 if self.slot_index.get(p) in self._rows
-                and self._filled[self.slot_index[p]] == self.n_elems
+                and self._tiles_got[self.slot_index[p]] == self.n_tiles
             )
             if not slots:
                 return self._out
@@ -896,6 +1237,7 @@ class StreamingAggregator:
                 self.pool.put(row)
             self._rows.clear()
             self._resident.clear()  # borrowed references: just drop them
+            self._tail_bytes.clear()  # redundancy retention dies with the round
             if self._folder is not None:
                 # Device accumulator freed with the round (committed rounds
                 # already pulled result(); failed/fenced ones abandon it).
@@ -925,6 +1267,11 @@ class StreamingAggregator:
             "streamed_contribs": int(self.streamed_contribs),
             "dense_contribs": int(self.dense_contribs),
             "aborted_contribs": int(self.aborted_contribs),
+            # Tail-optimal hedged recovery (per-round view; the averager
+            # rolls these into cumulative stats).
+            "tiles_recovered": int(self.tiles_recovered),
+            "hedge_duplicates": int(self.hedge_duplicates),
+            "hedge_dropped": int(self.hedge_dropped),
             "fenced": bool(self.fenced),
             "chunks_after_fence": int(self.chunks_after_fence),
             # On-mesh data path: which backend folded this round (may read

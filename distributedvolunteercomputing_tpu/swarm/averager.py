@@ -65,7 +65,13 @@ import numpy as np
 from distributedvolunteercomputing_tpu import native
 from distributedvolunteercomputing_tpu.ops import mesh_codec as mesh_codec_mod
 from distributedvolunteercomputing_tpu.ops import robust
-from distributedvolunteercomputing_tpu.swarm.agg_stream import StreamingAggregator
+from distributedvolunteercomputing_tpu.swarm.agg_stream import (
+    StreamingAggregator,
+    encode_wire_elems,
+)
+from distributedvolunteercomputing_tpu.swarm.agg_stream import (
+    wire_geometry as agg_wire_geometry,
+)
 from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
 from distributedvolunteercomputing_tpu.swarm.matchmaking import (
     Group,
@@ -161,6 +167,11 @@ class _Round:
         # original leader, bumped per failover recovery. Armed handlers
         # reject contribute/fetch traffic carrying any other generation.
         self.gen = 0
+        # Tail-optimal recovery: XOR redundancy sidecars received for this
+        # round (pred peer -> (succ peer, pred weight, xor bytes, t0 tile))
+        # and the number of hedged re-requests this round issued.
+        self.redund: Dict[str, tuple] = {}
+        self.hedges_issued = 0
         self.t0 = time.monotonic()
 
 
@@ -195,6 +206,8 @@ class AveragerBase:
         group_schedule: Optional[GroupSchedule] = None,
         control_plane=None,
         telemetry=None,
+        hedge: bool = True,
+        tail_redundancy_frac: float = 0.0,
     ):
         if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -340,6 +353,26 @@ class AveragerBase:
         # default, selected once at volunteer startup and surfaced in
         # stats()["mesh_codec"].
         self._mesh_codec = mesh_codec
+        # Tail-optimal hedged recovery (OptiReduce, ROADMAP item 2): when
+        # this node LEADS a streaming round, predicted-late peers' missing
+        # tile ranges are re-requested over a second stream ahead of the
+        # deadline (sync.refetch), with duplicates idempotent by (peer,
+        # tile, fence). Advisory and leader-local — nothing is negotiated
+        # on the wire; hedge=False restores pure deadline-drop.
+        self.hedge = bool(hedge)
+        # Optional summand redundancy: each member's last-k% tiles ride
+        # XOR-coded on its ring successor's sidecar, decodable by the
+        # leader iff the original misses commit. 0.0 = off.
+        if not 0.0 <= tail_redundancy_frac <= 0.5:
+            raise ValueError(
+                f"tail_redundancy_frac must be in [0, 0.5], got {tail_redundancy_frac}"
+            )
+        self.tail_redundancy_frac = float(tail_redundancy_frac)
+        # Cumulative hedge counters (stats()["hedge"] / volunteer summary).
+        self.hedges_issued = 0
+        self.hedges_failed = 0
+        self.slots_recovered = 0
+        self.redund_decodes = 0
         self._specs = None
         self._treedef = None
         self._schema: Optional[str] = None
@@ -1493,6 +1526,16 @@ class AveragerBase:
             cp_stats.get("beats") or self.control_plane is not None
         ):
             out["control_plane"] = cp_stats
+        if self.hedges_issued or self.slots_recovered or self.redund_decodes:
+            # Tail-optimal recovery scorecard (cumulative, leader vantage):
+            # per-round detail lives in aggregation gauges + mass reports.
+            out["hedge"] = {
+                "enabled": self.hedge,
+                "issued": self.hedges_issued,
+                "failed": self.hedges_failed,
+                "slots_recovered": self.slots_recovered,
+                "redund_decodes": self.redund_decodes,
+            }
         out["telemetry"] = self.telemetry.summary()
         # SNAPSHOT semantics: several sub-dicts above are filled in place by
         # background work (round paths, the aggregation worker, heartbeat
@@ -1514,6 +1557,7 @@ class AveragerBase:
         for k in (
             "tiles_early", "tiles_deadline", "streamed_contribs",
             "dense_contribs", "aborted_contribs", "folder_flushes",
+            "tiles_recovered", "hedge_duplicates", "hedge_dropped",
         ):
             agg[k] = agg.get(k, 0) + g[k]
         agg["codec_backend"] = g["codec_backend"]
@@ -1585,6 +1629,26 @@ class SyncAverager(AveragerBase):
         self.transport.register_request_sink(
             "sync.contribute", self._contribute_stream_factory
         )
+        # Tail-optimal hedged recovery plumbing. sync.refetch serves tile
+        # RANGES of a member's retained (PR-4) contribution back to the
+        # round leader over a second stream — re-encoded from the retained
+        # dense form, bit-identical for the elementwise wires, so EF can
+        # never double-stage. sync.redund_share / sync.redund carry the
+        # optional summand-redundancy sidecars (ring neighbor's last-k%
+        # tiles, XOR-coded).
+        self.transport.register("sync.refetch", self._rpc_refetch)
+        self.transport.register("sync.redund_share", self._rpc_redund_share)
+        self.transport.register("sync.redund", self._rpc_redund)
+        # epoch -> {"gen", "token", "buf" (dense f32), "weight", "group"}:
+        # the member-side registry behind sync.refetch, set around the
+        # push/fetch leg and dropped when the round resolves.
+        self._push_retained: Dict[str, dict] = {}
+        # (epoch, pred peer) -> (mono, weight, t0 tile, tail bytes,
+        # fence): ring neighbors' redundancy shares, stashed until our
+        # own round state for that epoch exists (then XOR-coded to the
+        # leader), and retained as the replica-holder refetch source
+        # (served by fence+share alone when our own retention is gone).
+        self._redund_shares: Dict[Tuple[str, str], tuple] = {}
 
     # The four instrumented leader-round phases, in protocol order (the
     # kill-at-phase chaos matrix iterates these).
@@ -1861,6 +1925,574 @@ class SyncAverager(AveragerBase):
             st.result_wire,
         )
 
+    # -- tail-optimal hedged recovery ---------------------------------------
+    #
+    # The leader's soft-deadline pipeline (ROADMAP item 2 / OptiReduce):
+    # ahead of the round deadline, peers whose remaining tiles are
+    # predicted late (phi-accrual suspicion, transport latency/bandwidth
+    # EWMAs, stalled-stream age) get their missing tile ranges re-requested
+    # over a second stream — first from the straggler's own retained bytes
+    # (sync.refetch), then, when summand redundancy is on, from the ring
+    # successor holding the straggler's XOR-coded tail. Duplicate arrivals
+    # are idempotent by (peer, tile, fence) in the aggregator, so a hedge
+    # and the original can never double-fold.
+
+    REDUND_SHARE_TTL_S = 60.0
+    MAX_REDUND_SHARES = 128
+    # Hedged re-requests per straggler per round. Each attempt runs under
+    # a SHORT per-attempt timeout (a fraction of the round budget, not
+    # the whole remainder): tail latency is per-request, so a hedge that
+    # itself straggles is cancelled and re-drawn instead of squatting on
+    # the in-flight budget until the deadline.
+    HEDGE_MAX_PER_PEER = 3
+    HEDGE_ATTEMPT_FRAC = 0.35
+    HEDGE_POLL_S = 0.2
+
+    def _wire_geometry(self, n_elems: int) -> Tuple[int, int, int, int]:
+        """(element size, chunk bytes, tile elems, n tiles) for this wire
+        — delegated to agg_stream.wire_geometry, the tiling rule's one
+        home, so refetch/sidecar tile addressing can never drift from the
+        aggregator's bitmap."""
+        return agg_wire_geometry(self.wire, self.transport.chunk_bytes, n_elems)
+
+    def _redund_tiles(self, n_tiles: int) -> int:
+        """Tail tiles covered by summand redundancy (0 = off)."""
+        if not self.tail_redundancy_frac or self.wire not in ("f32", "bf16"):
+            return 0
+        return min(n_tiles, max(1, int(round(self.tail_redundancy_frac * n_tiles))))
+
+    def _encode_range(self, buf: np.ndarray, e0: int, e1: int) -> bytes:
+        """Element range -> wire bytes, bit-identical to the original
+        push's encoding (f32/bf16 are elementwise, so a slice of the
+        encoding IS the encoding of the slice; bf16 re-encode of the
+        retained f32 form is exact — no second EF staging). One shared
+        encoder (agg_stream.encode_wire_elems) guards that invariant."""
+        return encode_wire_elems(self.wire, buf[e0:e1])
+
+    async def _rpc_refetch(self, args: dict, payload: bytes):
+        """Serve a tile range of a retained contribution back to a round
+        leader: our OWN contribution (args peer == us), or — replica-holder
+        mode — a ring neighbor's stashed redundancy tail. Authenticated by
+        the round token the leader issued to THIS node; fenced by the
+        generation the bytes were retained under."""
+        epoch = args.get("epoch")
+        target = args.get("peer")
+        try:
+            t0, t1 = int(args.get("t0", -1)), int(args.get("t1", -1))
+        except (TypeError, ValueError):
+            raise RPCError("malformed refetch range")
+        rec = self._push_retained.get(epoch) if isinstance(epoch, str) else None
+        if target == self.peer_id:
+            if rec is None:
+                raise RPCError("no retained contribution for this round epoch")
+            if self._fence_of(args) != rec["gen"]:
+                raise RPCError(
+                    f"fencing mismatch: retained bytes are generation "
+                    f"{rec['gen']}, refetch asks for {self._fence_of(args)}"
+                )
+            if rec["token"] and args.get("token") != rec["token"]:
+                raise RPCError("invalid refetch token for this round")
+            buf: np.ndarray = rec["buf"]
+            esz, cb, tile_elems, n_tiles = self._wire_geometry(buf.size)
+            if not 0 <= t0 < t1 <= n_tiles:
+                raise RPCError(
+                    f"refetch range [{t0}, {t1}) outside 0..{n_tiles}"
+                )
+            data = await asyncio.to_thread(
+                self._encode_range, buf, t0 * tile_elems,
+                min(t1 * tile_elems, buf.size),
+            )
+            return {"ok": True, "weight": rec["weight"]}, data
+        # Replica-holder mode: serve the neighbor's stashed tail share.
+        # Keyed on the SHARE, not this node's own round state — the whole
+        # point of the replica hop is the degraded case, where this
+        # node's own round may already have resolved (and dropped its
+        # retention) while the leader's is still open. The share carries
+        # its own fence; the token check applies when our retention is
+        # still around to validate against (residual trust otherwise:
+        # the predecessor explicitly shared these bytes for recovery,
+        # and they are TTL'd).
+        share = self._redund_shares.get((epoch, target)) if target else None
+        if share is None:
+            raise RPCError(f"no retained bytes for peer {target!r}")
+        _, share_w, share_t0, share_bytes, share_fence = share
+        if self._fence_of(args) != share_fence:
+            raise RPCError(
+                f"fencing mismatch: share is generation {share_fence}, "
+                f"refetch asks for {self._fence_of(args)}"
+            )
+        if rec is not None and rec["token"] and args.get("token") != rec["token"]:
+            raise RPCError("invalid refetch token for this round")
+        cb = self.transport.chunk_bytes
+        if t0 < share_t0 or t1 <= t0:
+            raise RPCError(
+                f"refetch range [{t0}, {t1}) outside share (covers {share_t0}..)"
+            )
+        off0 = (t0 - share_t0) * cb
+        # Clamp the end to the share: the final tile is short, and the
+        # leader's add_hedged enforces exact per-tile lengths anyway.
+        off1 = min(len(share_bytes), (t1 - share_t0) * cb)
+        if off0 >= len(share_bytes):
+            raise RPCError("refetch range outside the retained share")
+        return {"ok": True, "weight": share_w}, share_bytes[off0:off1]
+
+    def _retain_push(self, group: Group, buf: np.ndarray, weight: float) -> None:
+        """Register this member round's dense contribution for sync.refetch
+        (and drain any parked ring-neighbor shares now that the round's
+        leader/token are known)."""
+        self._push_retained[group.epoch] = {
+            "gen": group.gen,
+            "token": group.token,
+            "buf": buf,
+            "weight": float(weight),
+            "group": group,
+        }
+        if self.tail_redundancy_frac:
+            for (epoch, pred) in list(self._redund_shares):
+                if epoch == group.epoch:
+                    self._spawn_task(self._send_sidecar(group.epoch, pred))
+
+    def _drop_retained(self, epoch: str) -> None:
+        self._push_retained.pop(epoch, None)
+
+    def _sweep_redund_shares(self) -> None:
+        now = time.monotonic()
+        stale = [
+            k for k, (t, *_rest) in self._redund_shares.items()
+            if now - t > self.REDUND_SHARE_TTL_S
+        ]
+        for k in stale:
+            self._redund_shares.pop(k, None)
+        while len(self._redund_shares) >= self.MAX_REDUND_SHARES:
+            self._redund_shares.pop(next(iter(self._redund_shares)), None)
+
+    def _spawn_task(self, coro) -> Optional[asyncio.Task]:
+        """Fire-and-forget helper task (redundancy sends): errors are
+        logged, never raised — redundancy is strictly best-effort."""
+        async def run():
+            try:
+                await coro
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — advisory path
+                log.debug("tail-redundancy task failed: %s", errstr(e))
+        try:
+            return asyncio.get_running_loop().create_task(run())
+        except RuntimeError:
+            coro.close()
+            return None
+
+    async def _send_redund_share(
+        self, group: Group, buf: np.ndarray, weight: float
+    ) -> None:
+        """Member side: ship our last-k% tiles' wire bytes to the ring
+        successor, which XOR-codes them with its own tail into the
+        leader-bound sidecar. Best-effort — a lost share just means no
+        replica for this round."""
+        esz, cb, tile_elems, n_tiles = self._wire_geometry(buf.size)
+        r = self._redund_tiles(n_tiles)
+        if not r:
+            return
+        succ = self._ring_successor(group, self.peer_id)
+        if succ is None:
+            return
+        t0 = n_tiles - r
+        tail = await asyncio.to_thread(
+            self._encode_range, buf, t0 * tile_elems, buf.size
+        )
+        _, succ_addr = succ
+        await self.transport.call(
+            succ_addr, "sync.redund_share",
+            {
+                "epoch": group.epoch, "peer": self.peer_id,
+                "weight": float(weight), "t0": t0, "fence": group.gen,
+            },
+            tail, timeout=5.0, record_latency=False,
+        )
+
+    async def _rpc_redund_share(self, args: dict, payload: bytes):
+        """A ring predecessor's tail tiles (summand redundancy, member to
+        member). Stashed — it becomes our XOR sidecar to the leader the
+        moment our own round state for the epoch exists, and the
+        replica-holder source for the leader's second hedge."""
+        epoch, pred = args.get("epoch"), args.get("peer")
+        if not isinstance(epoch, str) or not isinstance(pred, str) or not payload:
+            raise RPCError("malformed redundancy share")
+        try:
+            w = float(args.get("weight"))
+            t0 = int(args.get("t0"))
+        except (TypeError, ValueError):
+            raise RPCError("malformed redundancy share meta")
+        self._sweep_redund_shares()
+        self._redund_shares[(epoch, pred)] = (
+            time.monotonic(), w, t0, bytes(payload), self._fence_of(args),
+        )
+        if epoch in self._push_retained and self.tail_redundancy_frac:
+            self._spawn_task(self._send_sidecar(epoch, pred))
+        return {"ok": True}, b""
+
+    async def _send_sidecar(self, epoch: str, pred: str) -> None:
+        """XOR our own tail tiles with the stashed predecessor share and
+        ship the sidecar to the round leader (decoded there only if the
+        original misses commit)."""
+        rec = self._push_retained.get(epoch)
+        share = self._redund_shares.get((epoch, pred))
+        if rec is None or share is None:
+            return
+        _, pred_w, t0, pred_tail, _fence = share
+        group: Group = rec["group"]
+        buf: np.ndarray = rec["buf"]
+        esz, cb, tile_elems, n_tiles = self._wire_geometry(buf.size)
+        if t0 != n_tiles - self._redund_tiles(n_tiles):
+            return  # config skew: the share's layout is not ours
+        own_tail = await asyncio.to_thread(
+            self._encode_range, buf, t0 * tile_elems, buf.size
+        )
+        if len(own_tail) != len(pred_tail):
+            return  # schema mismatch — not our swarm's layout
+        xored = (
+            np.bitwise_xor(
+                np.frombuffer(own_tail, np.uint8),
+                np.frombuffer(pred_tail, np.uint8),
+            ).tobytes()
+        )
+        leader_id, leader_addr = group.members[0]
+        await self.transport.call(
+            leader_addr, "sync.redund",
+            {
+                "epoch": epoch, "peer": self.peer_id, "pred": pred,
+                "fence": group.gen, "token": group.token,
+                "pred_weight": pred_w, "t0": t0,
+            },
+            xored, timeout=5.0, record_latency=False,
+        )
+
+    async def _rpc_redund(self, args: dict, payload: bytes):
+        """Leader side: accept one XOR redundancy sidecar for an armed
+        round (authenticated by the SUCCESSOR's issued token)."""
+        epoch = args.get("epoch")
+        st = self._rounds.get(epoch) if isinstance(epoch, str) else None
+        if st is None or st.tokens is None or st.stream is None:
+            raise RPCError("no armed round for this epoch")
+        if self._fence_of(args) != st.gen:
+            self._note_fence_rejected("sync.redund", args, have_gen=st.gen)
+            raise RPCError("fencing mismatch on redundancy sidecar")
+        succ, pred = args.get("peer"), args.get("pred")
+        if not succ or st.tokens.get(succ) != args.get("token"):
+            raise RPCError("invalid redundancy token")
+        if not isinstance(pred, str) or pred not in st.stream.slot_index:
+            raise RPCError("redundancy sidecar names an unknown peer")
+        try:
+            pred_w = float(args.get("pred_weight"))
+            t0 = int(args.get("t0"))
+        except (TypeError, ValueError):
+            raise RPCError("malformed redundancy sidecar meta")
+        if t0 != st.stream.n_tiles - st.stream.tail_keep_tiles:
+            raise RPCError("redundancy sidecar layout mismatch")
+        if len(st.redund) < 64:  # bounded per round
+            st.redund[pred] = (succ, pred_w, bytes(payload), t0)
+        return {"ok": True}, b""
+
+    def _decode_redundancy(self, st: _Round) -> int:
+        """Decode XOR sidecars for peers still missing tail tiles — called
+        right before the freeze, so recovered tiles fold into the commit.
+        pred_tile = sidecar XOR succ's own delivered tile (retained by the
+        aggregator's tail-byte window). Idempotent through add_hedged."""
+        stream = st.stream
+        if stream is None or not st.redund:
+            return 0
+        folded = 0
+        board = stream.scoreboard()
+        # Snapshot: this runs on a worker thread while late sync.redund
+        # handlers may still insert on the loop thread — iterating the
+        # live dict would crash the round with RuntimeError.
+        for pred, (succ, pred_w, xbytes, t0) in list(st.redund.items()):
+            rec = board.get(pred)
+            if rec is None or rec["sealed"] or rec["aborted"]:
+                continue
+            cb = stream.chunk_bytes
+            total = stream.n_elems * stream.esz
+            for tile in range(t0, stream.n_tiles):
+                seg0 = (tile - t0) * cb
+                seg_len = min(cb, total - tile * cb)
+                if seg0 + seg_len > len(xbytes):
+                    break  # malformed sidecar: stop, never mis-slice
+                succ_bytes = stream.tail_bytes(succ, tile)
+                if succ_bytes is None or len(succ_bytes) != seg_len:
+                    continue  # successor's own copy of this tile missing
+                data = np.bitwise_xor(
+                    np.frombuffer(xbytes, np.uint8, count=seg_len, offset=seg0),
+                    np.frombuffer(succ_bytes, np.uint8),
+                ).tobytes()
+                n = stream.add_hedged(
+                    pred, pred_w, tile * cb, data, source="redund"
+                )
+                folded += n
+        if folded:
+            self.redund_decodes += folded
+            if self.telemetry.enabled:
+                self.telemetry.registry.counter(
+                    "swarm.hedge.redund_tiles_total",
+                    "tail tiles decoded from XOR redundancy sidecars",
+                ).inc(folded)
+        return folded
+
+    async def _hedge_loop(self, st: _Round, group: Group) -> None:
+        """The leader's soft-deadline watcher: sleep to the learned soft
+        deadline, then rank stragglers off the aggregator's scoreboard and
+        keep at most the learned budget of hedged range re-requests in
+        flight until the round fills or the deadline lands. Cancelled with
+        the gather; in-flight folds after the freeze are no-ops by the
+        aggregator's frozen check."""
+        stream = st.stream
+        if stream is None:
+            return
+        asg = self._last_group
+        level = asg.level if asg is not None else "flat"
+        budget = self._deadline_wait(group)
+        t_end = time.monotonic() + budget
+        if self.resilience is not None:
+            soft_frac, max_inflight = self.resilience.hedge_params(level)
+        else:
+            soft_frac, max_inflight = 0.6, 2
+        await asyncio.sleep(budget * soft_frac)
+        addr_by = {pid: addr for pid, addr in group.members}
+        attempts: Dict[str, int] = {}
+        # Keyed BY PEER: one hedge in flight per straggler — a poll must
+        # not re-issue for a peer whose previous attempt is still
+        # running, or the per-peer attempt budget burns in three polls
+        # (and the duplicate replies would read to the AIMD as hedging a
+        # healthy tail). A peer re-enters targeting only after its
+        # attempt resolves (reply, error, or per-attempt timeout).
+        inflight: Dict[str, asyncio.Task] = {}
+        try:
+            while not st.full.is_set():
+                left = t_end - time.monotonic()
+                if left <= 0.1:
+                    break
+                for p in [p for p, t in inflight.items() if t.done()]:
+                    inflight.pop(p)
+                if len(inflight) < max_inflight:
+                    for peer, rng in self._hedge_targets(
+                        stream.scoreboard(), left, addr_by, attempts
+                    ):
+                        if len(inflight) >= max_inflight:
+                            break
+                        if peer in inflight:
+                            continue
+                        attempts[peer] = attempts.get(peer, 0) + 1
+                        att_timeout = min(
+                            max(left, 0.2),
+                            max(0.5, self.HEDGE_ATTEMPT_FRAC * budget),
+                        )
+                        inflight[peer] = asyncio.create_task(
+                            self._hedge_fetch(
+                                st, group, peer, addr_by[peer],
+                                rng[0], rng[1], att_timeout,
+                            )
+                        )
+                await asyncio.sleep(min(self.HEDGE_POLL_S, max(left, 0.05)))
+        finally:
+            for t in inflight.values():
+                if not t.done():
+                    t.cancel()
+
+    def _hedge_targets(
+        self,
+        board: Dict[str, dict],
+        left: float,
+        addr_by: Dict[str, Any],
+        attempts: Dict[str, int],
+    ) -> List[Tuple[str, Tuple[int, int]]]:
+        """Rank hedge candidates: unsealed peers with missing tiles whose
+        ORIGINAL stream is predicted to miss the deadline — phi-accrual
+        suspicion, a stalled stream (no arrival for several RTTs), or a
+        transfer estimate (missing bytes / measured bandwidth + latency)
+        exceeding the time left. Past the soft deadline a silent peer is
+        hedged outright (its p95 completion history already failed it).
+        Worst missing-volume first."""
+        out: List[Tuple[int, str, Tuple[int, int]]] = []
+        for peer, rec in board.items():
+            if (
+                peer == self.peer_id
+                or rec["sealed"]
+                or rec["aborted"]
+                or not rec["missing"]
+                or attempts.get(peer, 0) >= self.HEDGE_MAX_PER_PEER
+                or peer not in addr_by
+            ):
+                continue
+            addr = addr_by[peer]
+            missing_tiles = sum(t1 - t0 for t0, t1 in rec["missing"])
+            lat = self.transport.peer_latency(addr) or 0.05
+            bw = self.transport.peer_bw_down(addr)
+            suspect = (
+                self.failure_detector is not None
+                and self.failure_detector.suspect(peer)
+            )
+            age = rec["last_arrival_age_s"]
+            stalled = (
+                rec["started"] and age is not None and age > max(0.5, 4.0 * lat)
+            )
+            eta = (
+                lat + missing_tiles * self.transport.chunk_bytes / bw
+                if bw else None
+            )
+            if suspect or stalled or not rec["started"] or (
+                eta is not None and eta > left
+            ):
+                # One contiguous range per request: the original stream is
+                # in-order, so the missing set is (almost always) a suffix;
+                # residual holes get the next pass.
+                rng = rec["missing"][0]
+                out.append((missing_tiles, peer, (int(rng[0]), int(rng[1]))))
+        out.sort(key=lambda x: -x[0])
+        return [(p, r) for _, p, r in out]
+
+    async def _hedge_fetch(
+        self,
+        st: _Round,
+        group: Group,
+        peer: str,
+        addr,
+        t0: int,
+        t1: int,
+        timeout: float,
+    ) -> None:
+        """One hedged range re-request: pull tiles [t0, t1) of ``peer``'s
+        retained contribution over a second stream and fold them into the
+        round's aggregator as they verify. Falls back to the peer's ring
+        successor (replica holder of its XOR-shared tail) when the
+        straggler itself is unreachable and redundancy is on."""
+        stream = st.stream
+        if stream is None:
+            return
+        tele = self.telemetry
+        st.hedges_issued += 1
+        self.hedges_issued += 1
+        if tele.enabled:
+            tele.registry.counter(
+                "swarm.hedge.issued_total", "hedged tile re-requests issued"
+            ).inc()
+        tele.event(
+            "hedge_issued", epoch=group.epoch, peer=peer,
+            t0=int(t0), t1=int(t1),
+        )
+        span = tele.tracer.start(
+            "hedge", trace=group.epoch, role="leader", peer=peer,
+            tiles=int(t1 - t0), gen=st.gen,
+        )
+        token = (st.tokens or {}).get(peer, "")
+        args = {
+            "epoch": group.epoch, "fence": st.gen, "peer": peer,
+            "t0": int(t0), "t1": int(t1), "token": token,
+        }
+        base = int(t0) * stream.chunk_bytes
+        folded = 0
+        source = "refetch"
+        try:
+            try:
+                folded = await self._refetch_into(
+                    stream, peer, addr, args, base, timeout
+                )
+            except (RPCError, OSError, asyncio.TimeoutError, TimeoutError) as e:
+                # Replica-holder fallback: the straggler itself is gone or
+                # saturated; its ring successor retains the XOR-shared
+                # tail. Only the tail sub-range is recoverable there.
+                succ = self._ring_successor(group, peer)
+                r_tiles = stream.tail_keep_tiles
+                tail_t0 = stream.n_tiles - r_tiles
+                if succ is None or not r_tiles or t1 <= tail_t0:
+                    raise
+                source = "replica"
+                succ_id, succ_addr = succ
+                rargs = dict(
+                    args,
+                    t0=int(max(t0, tail_t0)),
+                    token=(st.tokens or {}).get(succ_id, ""),
+                )
+                log.debug(
+                    "hedge: refetch from %s failed (%s); trying replica "
+                    "holder %s", peer, errstr(e), succ_id,
+                )
+                folded = await self._refetch_into(
+                    stream, peer, succ_addr, rargs,
+                    rargs["t0"] * stream.chunk_bytes,
+                    max(timeout / 2, 0.2),
+                )
+            if span is not None:
+                span.end(ok=True, folded=folded, source=source)
+        except asyncio.CancelledError:
+            # Deadline landed (or the round filled) with this hedge still
+            # in flight: end the span so the trace shows the attempt.
+            if span is not None:
+                span.end(ok=False, cancelled=True, folded=folded)
+            raise
+        except (RPCError, OSError, asyncio.TimeoutError, TimeoutError) as e:
+            self.hedges_failed += 1
+            if tele.enabled:
+                tele.registry.counter(
+                    "swarm.hedge.failed_total", "hedged re-requests that failed"
+                ).inc()
+            if span is not None:
+                span.end(ok=False, error=errstr(e), source=source)
+
+    async def _refetch_into(
+        self,
+        stream: StreamingAggregator,
+        peer: str,
+        addr,
+        args: dict,
+        base: int,
+        timeout: float,
+    ) -> int:
+        """Issue one sync.refetch and fold the reply into ``stream`` under
+        ``peer``'s slot. Streams chunk-by-chunk when the peer's weight is
+        already known and the transport is unauthenticated (the request-
+        sink integrity rule applied client-side: hedged folds are
+        irreversible, so under auth the reply buffers whole and folds only
+        after the payload MAC verified)."""
+        folded = 0
+        w_known = stream.weight_of(peer)
+        if w_known > 0 and getattr(self.transport, "_secret", None) is None:
+            def hsink(off: int, total: int, data: bytes) -> None:
+                nonlocal folded
+                folded += stream.add_hedged(peer, w_known, base + off, data)
+
+            await self.transport.call(
+                addr, "sync.refetch", args, timeout=timeout,
+                chunk_sink=hsink, record_latency=False,
+            )
+            return folded
+        ret, payload = await self.transport.call(
+            addr, "sync.refetch", args, timeout=timeout, record_latency=False,
+        )
+        try:
+            w = float(ret.get("weight") or 1.0)
+        except (TypeError, ValueError):
+            w = 1.0
+
+        def fold() -> int:
+            n = 0
+            cb = stream.chunk_bytes
+            for off in range(0, len(payload), cb):
+                n += stream.add_hedged(
+                    peer, w, base + off, bytes(payload[off : off + cb])
+                )
+            return n
+
+        return await asyncio.to_thread(fold)
+
+    def _ring_successor(self, group: Group, peer: str) -> Optional[Tuple[str, Any]]:
+        """The ring successor of ``peer`` among the round's NON-LEADER
+        members (the redundancy ring excludes the leader — it already
+        holds its own contribution), or None below 3 members."""
+        ring = [m for m in group.members if m[0] != group.leader_id]
+        ids = [pid for pid, _ in ring]
+        if peer not in ids or len(ring) < 2:
+            return None
+        return ring[(ids.index(peer) + 1) % len(ring)]
+
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
         await self._maybe_backoff()
@@ -1948,7 +2580,25 @@ class SyncAverager(AveragerBase):
                             group, await asyncio.to_thread(sent), weight, wire_bytes
                         )
                     else:
-                        result = await self._member_round(group, weight, wire_bytes, sent)
+                        # Tail-optimal recovery, member side: register the
+                        # dense form behind sync.refetch for the round's
+                        # lifetime (the leader's hedges re-pull ranges of
+                        # it, bit-identical to the push), and — redundancy
+                        # on — ship the tail tiles to the ring successor.
+                        retained = self.wire in ("f32", "bf16") and buf is not None
+                        if retained:
+                            self._retain_push(group, buf, weight)
+                            if self.tail_redundancy_frac and len(group.members) >= 3:
+                                self._spawn_task(
+                                    self._send_redund_share(group, buf, weight)
+                                )
+                        try:
+                            result = await self._member_round(
+                                group, weight, wire_bytes, sent
+                            )
+                        finally:
+                            if retained:
+                                self._drop_retained(group.epoch)
                 except (RPCError, OSError, ValueError, asyncio.TimeoutError) as e:
                     log.info(
                         "sync round %d failed (%s); continuing local",
@@ -2054,11 +2704,15 @@ class SyncAverager(AveragerBase):
                 # fold tile-by-tile as they arrive (transport request
                 # sink), inline pushes fold at decode, and the deadline
                 # commit reduces to closing whatever is still open.
+                _, _, _, n_tiles = self._wire_geometry(n_elems)
                 st.stream = StreamingAggregator(
                     n_elems, member_ids, method, self.wire,
                     self.transport.chunk_bytes, kw_fn=kw_fn,
                     codec=self.mesh_codec,
                     telemetry=self.telemetry,
+                    # Summand redundancy: retain members' tail-tile wire
+                    # bytes as XOR-decode keys for ring sidecars.
+                    tail_keep_tiles=self._redund_tiles(n_tiles),
                 )
                 # Fold every pre-arming parked buffer; fed entries drop
                 # their dense copy — the aggregator owns that mass now.
@@ -2118,6 +2772,18 @@ class SyncAverager(AveragerBase):
         )
         commit_sp = None
         try:
+            # Tail-optimal recovery: the soft-deadline hedger watches the
+            # aggregator's tile scoreboard beside the gather wait and
+            # re-requests predicted-late ranges. The ROUND deadline is
+            # untouched — hedging spends idle wait, not wall time.
+            hedger: Optional[asyncio.Task] = None
+            if (
+                self.hedge
+                and st.stream is not None
+                and self.wire in ("f32", "bf16")
+                and len(group.members) > 1
+            ):
+                hedger = asyncio.create_task(self._hedge_loop(st, group))
             try:
                 # The group DEADLINE bounds the gather: begin fan-out time
                 # already spent the budget, so a slow formation shrinks the
@@ -2127,6 +2793,18 @@ class SyncAverager(AveragerBase):
                 )
             except asyncio.TimeoutError:
                 self._round_degraded = True  # deadline commit: not an observation
+            finally:
+                if hedger is not None:
+                    hedger.cancel()
+                    try:
+                        await hedger
+                    except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                        pass
+            if st.stream is not None and st.redund:
+                # Summand redundancy decodes BEFORE the freeze: a tail the
+                # original missed folds into the commit iff its XOR
+                # sidecar + the successor's own delivered tail are both in.
+                await asyncio.to_thread(self._decode_redundancy, st)
             await self._phase("post_partial_commit")
             # Resolve pre-schema-parked powersgd payloads now that our own
             # pack fixed the specs (exact-size-capped decode).
@@ -2277,6 +2955,46 @@ class SyncAverager(AveragerBase):
                     st.stream.quality_d2() if st.stream is not None
                     else dense_q or None
                 )
+            if st.stream is not None:
+                # Tail-optimal bookkeeping: cumulative recovered-slot
+                # counter, per-peer contribution-latency samples (the
+                # policy's tail quantiles), and the AIMD hedge-budget
+                # feedback for this round's hierarchy level.
+                hs = st.stream.hedge_stats()
+                self.slots_recovered += hs["slots_recovered"]
+                if hs["slots_recovered"] and self.telemetry.enabled:
+                    self.telemetry.registry.counter(
+                        "swarm.hedge.slots_recovered_total",
+                        "straggler contributions completed by hedged recovery",
+                    ).inc(hs["slots_recovered"])
+                if self.resilience is not None:
+                    for p, dt in st.stream.seal_latencies().items():
+                        if p != self.peer_id:
+                            self.resilience.record_contribution_latency(p, dt)
+                    if self.hedge:
+                        if mass is not None:
+                            lost_w = float(mass["excluded_weight"]) + float(
+                                mass["aborted_weight"]
+                            )
+                            if lost_w == 0.0 and (
+                                mass["excluded_slots"] or mass["aborted_slots"]
+                            ):
+                                # Silent peers declare no weight; the lost
+                                # SLOTS are still the AIMD's open-up signal.
+                                lost_w = float(
+                                    mass["excluded_slots"] + mass["aborted_slots"]
+                                )
+                        else:
+                            lost_w = float(len(st.excluded))
+                        asg_now = self._last_group
+                        self.resilience.record_hedge_outcome(
+                            asg_now.level if asg_now is not None else "flat",
+                            issued=st.hedges_issued,
+                            tiles_recovered=hs["tiles_recovered"],
+                            duplicate_tiles=hs["hedge_duplicates"],
+                            slots_recovered=hs["slots_recovered"],
+                            lost_weight=lost_w,
+                        )
             if fold_sp is not None:
                 fold_sp.end(
                     ok=True, arrived=len(peers),

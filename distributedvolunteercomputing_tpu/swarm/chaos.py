@@ -176,7 +176,8 @@ class ChaosTransport(Transport):
     # check runs first (a cut link delivers nothing to delay or corrupt).
     _partitions: Set[frozenset] = set()
     # Process-wide per-peer-pair LINK MODEL (set_link): propagation latency
-    # plus serialization bandwidth for the edge between two addresses.
+    # plus serialization bandwidth — and, optionally, a heavy-tailed
+    # per-call jitter distribution — for the edge between two addresses.
     # Class-level for the same reason as _partitions — a link is a property
     # of the path between two nodes. Applied on the OUTBOUND half at each
     # endpoint (delay = latency + request_payload/bw before the call), so a
@@ -185,7 +186,10 @@ class ChaosTransport(Transport):
     # in wall time. Composes with everything above — partition first (a cut
     # link delivers nothing), then the link delay, then rates/schedules.
     # Tests/campaigns must ``clear_links()`` in teardown.
-    _links: Dict[frozenset, Tuple[float, Optional[float]]] = {}
+    _links: Dict[frozenset, Tuple[float, Optional[float], Optional[dict]]] = {}
+
+    # Known heavy-tailed jitter shapes for set_link(jitter=...).
+    _JITTER_DISTS = ("pareto", "lognormal")
 
     def __init__(
         self,
@@ -277,6 +281,7 @@ class ChaosTransport(Transport):
         peer_b,
         latency_s: float = 0.0,
         bw_bps: Optional[float] = None,
+        jitter: Optional[dict] = None,
     ) -> None:
         """Model the link between two peer addresses: every call either
         endpoint makes to the other first pays ``latency_s`` plus the
@@ -289,22 +294,66 @@ class ChaosTransport(Transport):
         pair replaces its link; composes with ``partition``/``heal``,
         constant rates, ``corrupt_at_frac``, and fault schedules.
 
-        Fidelity limit: the delay is applied BEFORE the call's bytes are
+        ``jitter`` adds a HEAVY-TAILED per-call delay on top of the base
+        latency — the tail-latency model tail-optimal benches need (most
+        calls near the base, a fat tail of 10-100x outliers), replacing
+        hand-rolled x10 stragglers:
+
+        - ``{"dist": "pareto", "scale": s, "alpha": a}`` — extra delay
+          ``s * (X - 1)`` with X ~ Pareto(alpha); alpha in (1, 2] is the
+          classic heavy WAN tail (smaller alpha = fatter). Median extra
+          ~``s * (2^(1/a) - 1)``, unbounded tail.
+        - ``{"dist": "lognormal", "scale": s, "sigma": g}`` — extra delay
+          ``s * LogNormal(0, g)``; median exactly ``s``.
+        - optional ``"cap"``: ceiling (seconds) on the extra delay — real
+          stacks retransmit/abort rather than stall a flow for minutes,
+          and an uncapped alpha~1 draw otherwise turns one unlucky
+          control RPC into a process-scale stall.
+        - optional ``"min_bytes"``: draw the jitter only for calls whose
+          request payload is at least this size — the bulk-flow tail
+          model (a straggler's *data* transfers stall; its meta-sized
+          control RPCs ride the base latency), which is the tail the
+          hedged-recovery pipeline targets.
+
+        Draws come from this transport's own SEEDED rng, so a campaign
+        replay with the same traffic order reproduces the same tail.
+
+        Fidelity limit (same as the PR-8 note on the base model): the
+        delay — jitter included — is applied BEFORE the call's bytes are
         written, so it shapes WALL TIME but not the receiver's measured
         arrival rate — the production bandwidth-measurement path (the
         read-timed bw_down EWMA and the rx_bps uplink echo) still
-        observes localhost speed over a modeled thin link. Scenarios that
-        need bandwidth ADVERTISEMENTS under a modeled WAN inject them
-        directly via membership ``extra_info`` (hierarchy_bench does);
-        pacing the actual socket writes is a transport change, not a
-        wrapper's."""
+        observes localhost speed over a modeled thin link, and a jittered
+        call stalls WHOLE (one draw per call, not per packet — a fresh
+        hedged request re-draws, which is exactly the tail-dodging effect
+        hedging exploits, but intra-payload pacing is not modeled).
+        Scenarios that need bandwidth ADVERTISEMENTS under a modeled WAN
+        inject them directly via membership ``extra_info``
+        (hierarchy_bench does); pacing the actual socket writes is a
+        transport change, not a wrapper's."""
         if latency_s < 0:
             raise ValueError(f"latency_s must be >= 0, got {latency_s}")
         if bw_bps is not None and bw_bps <= 0:
             raise ValueError(f"bw_bps must be > 0 (or None), got {bw_bps}")
+        if jitter is not None:
+            dist = jitter.get("dist")
+            if dist not in self._JITTER_DISTS:
+                raise ValueError(
+                    f"unknown jitter dist {dist!r}; known: {self._JITTER_DISTS}"
+                )
+            if float(jitter.get("scale", 0.0)) <= 0:
+                raise ValueError("jitter needs scale > 0")
+            if dist == "pareto" and float(jitter.get("alpha", 0.0)) <= 0:
+                raise ValueError("pareto jitter needs alpha > 0")
+            if dist == "lognormal" and float(jitter.get("sigma", 0.0)) <= 0:
+                raise ValueError("lognormal jitter needs sigma > 0")
+            if jitter.get("cap") is not None and float(jitter["cap"]) < 0:
+                raise ValueError("jitter cap must be >= 0")
+            jitter = dict(jitter)
         ChaosTransport._links[self._pair(peer_a, peer_b)] = (
             float(latency_s),
             float(bw_bps) if bw_bps is not None else None,
+            jitter,
         )
 
     def clear_links(self, peer_a=None, peer_b=None) -> None:
@@ -324,8 +373,28 @@ class ChaosTransport(Transport):
         link = ChaosTransport._links.get(self._pair(self.addr, addr))
         if link is None:
             return 0.0
-        latency, bw = link
-        return latency + (n_bytes / bw if bw else 0.0)
+        latency, bw, jitter = link
+        delay = latency + (n_bytes / bw if bw else 0.0)
+        if jitter is not None and n_bytes < int(jitter.get("min_bytes") or 0):
+            jitter = None
+        if jitter is not None:
+            # One seeded draw per CALL: most calls ride near the base
+            # latency, a heavy tail stalls whole — and a hedged re-request
+            # is a fresh call with a fresh draw.
+            scale = float(jitter["scale"])
+            if jitter["dist"] == "pareto":
+                extra = scale * (
+                    self._chaos.paretovariate(float(jitter["alpha"])) - 1.0
+                )
+            else:  # lognormal
+                extra = scale * self._chaos.lognormvariate(
+                    0.0, float(jitter["sigma"])
+                )
+            cap = jitter.get("cap")
+            if cap is not None:
+                extra = min(extra, float(cap))
+            delay += extra
+        return delay
 
     async def call(
         self,

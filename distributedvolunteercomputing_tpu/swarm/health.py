@@ -218,23 +218,29 @@ def mass_from_outcomes(
 def mass_report_from_per_peer(per_peer: Dict[str, dict]) -> dict:
     """Fold a per-peer outcome/weight classification into the balanced
     mass report (each peer in exactly one bucket, so the weights sum by
-    construction — the property test's invariant)."""
-    sums = {"included": 0.0, "excluded": 0.0, "aborted": 0.0}
-    counts = {"included": 0, "excluded": 0, "aborted": 0}
+    construction — the property test's invariant). ``recovered`` is the
+    tail-optimal pipeline's bucket: mass that COMMITTED, but only because
+    hedged re-requests / summand redundancy finished a straggling
+    contribution — split from ``included`` so the hedger's win is
+    auditable per round while both count toward the committed fraction."""
+    sums = {"included": 0.0, "recovered": 0.0, "excluded": 0.0, "aborted": 0.0}
+    counts = {"included": 0, "recovered": 0, "excluded": 0, "aborted": 0}
     for rec in per_peer.values():
         oc = rec["outcome"]
         sums[oc] += float(rec["weight"])
         counts[oc] += 1
-    armed_w = sums["included"] + sums["excluded"] + sums["aborted"]
+    armed_w = sum(sums.values())
+    committed_w = sums["included"] + sums["recovered"]
+    committed_n = counts["included"] + counts["recovered"]
     n = len(per_peer)
     if armed_w > 0:
-        frac = sums["included"] / armed_w
+        frac = committed_w / armed_w
     elif n:
-        frac = counts["included"] / n
+        frac = committed_n / n
     else:
         frac = 1.0
     # Round the buckets first and report their EXACT sum as armed_weight:
-    # three independently-rounded buckets against an independently-rounded
+    # independently-rounded buckets against an independently-rounded
     # total could miss the balance invariant by ~2e-6, which is exactly
     # what the property tests and the chaos verdict assert against.
     rounded = {oc: round(sums[oc], 6) for oc in sums}
@@ -243,6 +249,8 @@ def mass_report_from_per_peer(per_peer: Dict[str, dict]) -> dict:
         "armed_weight": round(sum(rounded.values()), 6),
         "included_slots": counts["included"],
         "included_weight": rounded["included"],
+        "recovered_slots": counts["recovered"],
+        "recovered_weight": rounded["recovered"],
         "excluded_slots": counts["excluded"],
         "excluded_weight": rounded["excluded"],
         "aborted_slots": counts["aborted"],
@@ -252,7 +260,7 @@ def mass_report_from_per_peer(per_peer: Dict[str, dict]) -> dict:
         # undelivered weight is unknowable (counts 0 above), so the slot
         # fraction is what shows a deadline-dropped straggler's cost when
         # its push never declared a weight at all.
-        "slot_committed_frac": round(counts["included"] / n, 6) if n else 1.0,
+        "slot_committed_frac": round(committed_n / n, 6) if n else 1.0,
         "per_peer": per_peer,
     }
 
@@ -464,10 +472,26 @@ class HealthMonitor:
                         )
             if self._mass_gauge is not None:
                 self._mass_gauge.set(float(report.get("mass_committed_frac", 1.0)))
-                for oc in ("included", "excluded", "aborted"):
+                for oc in ("included", "recovered", "excluded", "aborted"):
                     w = float(report.get(f"{oc}_weight", 0.0))
                     if w:
                         self._mass_ctr.inc(w, outcome=oc)
+            rec_slots = int(report.get("recovered_slots", 0))
+            if rec_slots:
+                # The hedger's auditable win: mass that would have been
+                # lost at the deadline, committed anyway. The doctor's
+                # straggler rule demotes itself on this evidence.
+                self._event(
+                    "mass_recovered_by_hedge",
+                    trace=trace,
+                    recovered_weight=report.get("recovered_weight"),
+                    recovered_slots=rec_slots,
+                    recovered=sorted(
+                        p for p, r in (report.get("per_peer") or {}).items()
+                        if r.get("outcome") == "recovered"
+                    ),
+                    mass_committed_frac=report.get("mass_committed_frac"),
+                )
             if lost_slots:
                 self._event(
                     "mass_lost_at_deadline",
@@ -476,6 +500,8 @@ class HealthMonitor:
                     lost_slots=lost_slots,
                     mass_committed_frac=report.get("mass_committed_frac"),
                     slot_committed_frac=report.get("slot_committed_frac"),
+                    recovered_weight=report.get("recovered_weight", 0.0),
+                    recovered_slots=report.get("recovered_slots", 0),
                     excluded=sorted(
                         p for p, r in (report.get("per_peer") or {}).items()
                         if r.get("outcome") == "excluded"
@@ -668,12 +694,14 @@ def rollup_status(fresh_reports: List[dict]) -> Optional[dict]:
     # -- mass --------------------------------------------------------------
     fracs = []
     lost_total = 0.0
+    recovered_total = 0.0
     for h in per_peer.values():
         last = (h.get("mass") or {}).get("last")
         if isinstance(last, dict):
             f = last.get("mass_committed_frac")
             if isinstance(f, (int, float)):
                 fracs.append(float(f))
+            recovered_total += float(last.get("recovered_weight") or 0.0)
         for w in ((h.get("mass") or {}).get("lost_by_peer") or {}).values():
             lost_total += float(w or 0.0)
     mass = {
@@ -681,6 +709,10 @@ def rollup_status(fresh_reports: List[dict]) -> Optional[dict]:
         "committed_frac_mean": round(sum(fracs) / len(fracs), 6) if fracs else None,
         "committed_frac_min": round(min(fracs), 6) if fracs else None,
         "lost_weight_total": round(lost_total, 6),
+        # Mass the hedged-recovery pipeline saved in the reporters' latest
+        # rounds: lost vs recovered side by side is the tail-optimal
+        # pipeline's live scorecard.
+        "recovered_weight_last": round(recovered_total, 6),
     }
     # -- quality -----------------------------------------------------------
     quality: Dict[str, dict] = {}

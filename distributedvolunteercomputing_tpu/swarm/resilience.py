@@ -23,6 +23,21 @@ the knobs the averaging tier runs on, instead of static configuration:
 - **pre-exclusion** (``should_preexclude()``): per-peer outcome history
   (absent/late streaks) combined with the phi-accrual detector's suspicion
   marks peers the matchmaker should leave out of group formation.
+- **hedge budget** (``hedge_params()``): the tail-optimal recovery loop's
+  two knobs — what fraction of the round budget to wait before the first
+  hedged re-request (the *soft deadline*) and how many hedges may be in
+  flight at once — learned per hierarchy level with AIMD, the same shape
+  the round deadline uses: mass still lost at the deadline despite
+  hedging opens the budget (additive increase in-flight, earlier soft
+  deadline); rounds where hedges only duplicated tiles the original
+  delivered anyway close it (multiplicative decrease, later soft
+  deadline). Cross-zone rounds hedge on slow links by design, so each
+  level learns its own operating point.
+- **per-peer tail quantiles** (``stats()["peers"][p]["lat_p50_s"/"lat_p95_s"]``):
+  observed contribution-completion latencies (arming -> seal, recorded by
+  the leader per committed round) kept as a bounded per-peer sample
+  window — the hedge-target ranking evidence, visible in coord.status and
+  citable by the doctor.
 
 The policy is advisory and local: every averager consults its own
 instance; nothing is negotiated over the wire (the leader's deadline
@@ -37,7 +52,8 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, Iterable, Optional
+from collections import deque
+from typing import Dict, Iterable, Optional, Tuple
 
 from distributedvolunteercomputing_tpu.utils.logging import get_logger
 
@@ -59,6 +75,12 @@ class PeerOutcomes:
     rejected: float = 0.0
     # Consecutive not-on-time rounds; resets on any on-time arrival.
     miss_streak: int = 0
+    # Observed contribution-completion latencies (seconds, arming -> seal;
+    # recorded by the round leader). Bounded window: the tail quantiles
+    # exported in stats() are what rank hedge targets.
+    lat: "deque[float]" = dataclasses.field(
+        default_factory=lambda: deque(maxlen=64)
+    )
 
     def total(self) -> float:
         return self.on_time + self.late + self.absent + self.rejected
@@ -132,6 +154,10 @@ class ResiliencePolicy:
         # asymmetry the hierarchy exists to exploit. (Learning stays
         # per-peer and global: a deadline per level is a follow-on.)
         self.level_rounds: Dict[str, dict] = {}
+        # Tail-optimal hedge budget, learned PER HIERARCHY LEVEL (flat /
+        # intra / cross — cross-zone rounds hedge on slow links by design,
+        # so one shared operating point would be wrong for both).
+        self._hedge_levels: Dict[str, dict] = {}
         # One slow round must count ONCE: a peer whose push lands after the
         # commit is seen twice (absent in the commit batch, late on the RPC
         # path), in either order. These two sets reconcile the duplicate —
@@ -326,6 +352,102 @@ class ResiliencePolicy:
         self._peer(peer).rejected += 1.0
         self._maybe_escalate()
 
+    def record_contribution_latency(self, peer: str, dt: float) -> None:
+        """One observed contribution-completion latency (seconds from round
+        arming to the peer's seal, recorded by the leader). Feeds the
+        per-peer tail quantiles in ``stats()`` — the evidence the hedge
+        loop ranks re-request targets by."""
+        if dt < 0 or not dt < float("inf"):
+            return
+        self._peer(peer).lat.append(float(dt))
+
+    def peer_latency_quantiles(self, peer: str) -> Optional[Tuple[float, float]]:
+        """(p50, p95) of the peer's observed contribution latencies, or
+        None before any sample."""
+        st = self.peers.get(peer)
+        if st is None or not st.lat:
+            return None
+        xs = sorted(st.lat)
+        return (
+            xs[int(0.5 * (len(xs) - 1))],
+            xs[int(round(0.95 * (len(xs) - 1)))],
+        )
+
+    # -- hedge budget (tail-optimal recovery) -------------------------------
+
+    HEDGE_SOFT_FRAC_INIT = 0.6
+    HEDGE_SOFT_FRAC_MIN = 0.3
+    HEDGE_SOFT_FRAC_MAX = 0.9
+    HEDGE_SOFT_FRAC_STEP = 0.05
+    HEDGE_INFLIGHT_INIT = 2
+    HEDGE_INFLIGHT_MIN = 1
+    HEDGE_INFLIGHT_MAX = 8
+
+    def _hedge_rec(self, level: Optional[str]) -> dict:
+        lv = level or "flat"
+        rec = self._hedge_levels.get(lv)
+        if rec is None:
+            rec = self._hedge_levels[lv] = {
+                "soft_frac": self.HEDGE_SOFT_FRAC_INIT,
+                "max_inflight": float(self.HEDGE_INFLIGHT_INIT),
+                "rounds": 0,
+                "issued": 0,
+                "tiles_recovered": 0,
+                "duplicate_tiles": 0,
+                "slots_recovered": 0,
+                "lost_weight_after": 0.0,
+            }
+        return rec
+
+    def hedge_params(self, level: Optional[str] = None) -> Tuple[float, int]:
+        """(soft_deadline_frac, max_inflight_hedges) for the NEXT round at
+        ``level``: wait soft_frac x the round budget before the first
+        hedged re-request, and keep at most max_inflight in flight."""
+        rec = self._hedge_rec(level)
+        return float(rec["soft_frac"]), max(1, int(round(rec["max_inflight"])))
+
+    def record_hedge_outcome(
+        self,
+        level: Optional[str] = None,
+        *,
+        issued: int,
+        tiles_recovered: int = 0,
+        duplicate_tiles: int = 0,
+        slots_recovered: int = 0,
+        lost_weight: float = 0.0,
+    ) -> None:
+        """One committed round's hedge scorecard, AIMD'd into the level's
+        budget the way round deadlines learn: mass STILL lost at the
+        deadline means the hedger was too little / too late — additive
+        increase of in-flight budget, earlier soft deadline; a round whose
+        hedges only duplicated tiles the original delivered anyway means
+        the hedger fired on a healthy tail — multiplicative decrease,
+        later soft deadline. Rounds with no hedges and no loss leave the
+        operating point alone (no evidence either way)."""
+        rec = self._hedge_rec(level)
+        rec["rounds"] += 1
+        rec["issued"] += int(issued)
+        rec["tiles_recovered"] += int(tiles_recovered)
+        rec["duplicate_tiles"] += int(duplicate_tiles)
+        rec["slots_recovered"] += int(slots_recovered)
+        rec["lost_weight_after"] += float(lost_weight)
+        if lost_weight > 0:
+            rec["max_inflight"] = min(
+                rec["max_inflight"] + 1.0, float(self.HEDGE_INFLIGHT_MAX)
+            )
+            rec["soft_frac"] = max(
+                rec["soft_frac"] - self.HEDGE_SOFT_FRAC_STEP,
+                self.HEDGE_SOFT_FRAC_MIN,
+            )
+        elif issued and tiles_recovered == 0 and duplicate_tiles > 0:
+            rec["max_inflight"] = max(
+                rec["max_inflight"] * 0.7, float(self.HEDGE_INFLIGHT_MIN)
+            )
+            rec["soft_frac"] = min(
+                rec["soft_frac"] + self.HEDGE_SOFT_FRAC_STEP,
+                self.HEDGE_SOFT_FRAC_MAX,
+            )
+
     # -- decisions ---------------------------------------------------------
 
     def should_preexclude(self, peer: str) -> bool:
@@ -393,18 +515,42 @@ class ResiliencePolicy:
             "consecutive_failures": self._consecutive_failures,
             "method_level": _METHOD_LADDER[self._method_level],
             "peers": {
-                p: {
-                    "on_time": round(st.on_time, 2),
-                    "late": round(st.late, 2),
-                    "absent": round(st.absent, 2),
-                    "rejected": round(st.rejected, 2),
-                    "miss_streak": st.miss_streak,
-                }
-                for p, st in self.peers.items()
+                p: self._peer_stats_dict(p, st) for p, st in self.peers.items()
             },
         }
         if self.group_rounds:
             out["groups"] = {g: dict(r) for g, r in self.group_rounds.items()}
         if self.level_rounds:
             out["levels"] = {lv: dict(r) for lv, r in self.level_rounds.items()}
+        if self._hedge_levels:
+            out["hedge"] = {
+                lv: {
+                    "soft_frac": round(rec["soft_frac"], 3),
+                    "max_inflight": max(1, int(round(rec["max_inflight"]))),
+                    "rounds": rec["rounds"],
+                    "issued": rec["issued"],
+                    "tiles_recovered": rec["tiles_recovered"],
+                    "duplicate_tiles": rec["duplicate_tiles"],
+                    "slots_recovered": rec["slots_recovered"],
+                    "lost_weight_after": round(rec["lost_weight_after"], 6),
+                }
+                for lv, rec in self._hedge_levels.items()
+            }
+        return out
+
+    def _peer_stats_dict(self, peer: str, st: PeerOutcomes) -> dict:
+        out = {
+            "on_time": round(st.on_time, 2),
+            "late": round(st.late, 2),
+            "absent": round(st.absent, 2),
+            "rejected": round(st.rejected, 2),
+            "miss_streak": st.miss_streak,
+        }
+        q = self.peer_latency_quantiles(peer)
+        if q is not None:
+            # Observed contribution-latency tail — the hedge-target
+            # ranking, visible in coord.status and citable by the doctor.
+            out["lat_p50_s"] = round(q[0], 4)
+            out["lat_p95_s"] = round(q[1], 4)
+            out["lat_samples"] = len(st.lat)
         return out

@@ -95,6 +95,11 @@ KIND_SEVERITY: Dict[str, str] = {
     "codec_degraded": "warn",
     "peer_quality_flagged": "page",
     "mass_lost_at_deadline": "warn",
+    # Tail-optimal hedged recovery: a hedge being issued is routine
+    # tail-chasing; recovered mass is the good-news twin of
+    # mass_lost_at_deadline.
+    "hedge_issued": "info",
+    "mass_recovered_by_hedge": "info",
     "alert_raised": "page",
     "alert_cleared": "info",
 }

@@ -523,6 +523,18 @@ class Transport:
             return None
         return st.lat_ewma if st is not None else None
 
+    def peer_bw_down(self, addr) -> Optional[float]:
+        """Measured downlink throughput (bytes/s EWMA) FROM a dialed peer —
+        our own read-timed samples of its bulk transfers, or None before
+        the first >=MIN_BW_SAMPLE_BYTES payload. The hedge loop's transfer
+        estimator reads this to predict whether a straggler's missing
+        tiles can still arrive inside the round deadline."""
+        try:
+            st = self._peer_stats.get((str(addr[0]), int(addr[1])))
+        except (TypeError, ValueError, IndexError):
+            return None
+        return st.bw_down_ewma if st is not None else None
+
     def bandwidth_advertisement(
         self, max_age_s: float = BW_ADVERT_MAX_AGE_S
     ) -> dict:

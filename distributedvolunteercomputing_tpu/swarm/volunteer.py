@@ -226,6 +226,18 @@ class VolunteerConfig:
     # every detector end-to-end — no alert bytes ride the heartbeat —
     # while tracing/health stay on. --no-telemetry disables everything.
     watchdog: bool = True
+    # Tail-optimal hedged recovery (ISSUE 14, docs/PERFORMANCE.md): when
+    # this volunteer LEADS a streaming round, predicted-late peers'
+    # missing tile ranges are re-requested over a second stream ahead of
+    # the deadline (sync.refetch, idempotent per tile). On by default —
+    # it spends idle gather wait, never the deadline; --no-hedge restores
+    # pure deadline-drop.
+    hedge: bool = True
+    # Optional summand redundancy: each contribution's last-k% tiles ride
+    # XOR-coded on the ring successor's sidecar, decodable by the leader
+    # iff the original misses commit. 0.0 = off (costs one extra k%-sized
+    # member-to-member transfer per round when on).
+    tail_redundancy_frac: float = 0.0
     # Local Prometheus text endpoint (GET /metrics) for stock scrapers:
     # 0 = off (the telemetry.prom debug RPC always answers on the swarm
     # transport regardless).
@@ -693,6 +705,12 @@ class Volunteer:
                 # Shared telemetry bundle: round spans, the unified metrics
                 # registry, and the flight recorder all live here.
                 telemetry=self.telemetry,
+                # Tail-optimal hedged recovery (docs/PERFORMANCE.md):
+                # soft-deadline re-requests for predicted-late tile ranges
+                # when this node leads a streaming round, plus the optional
+                # last-k% summand redundancy ring.
+                hedge=self.cfg.hedge,
+                tail_redundancy_frac=self.cfg.tail_redundancy_frac,
             )
             if self.cfg.group_size:
                 from distributedvolunteercomputing_tpu.swarm.matchmaking import (

@@ -573,6 +573,19 @@ class Watchdog:
             events = det.observe(self.clock(), bool(bad), key=key)
         self._emit(events)
 
+    def annotate(self, kind: str, key: str, **fields) -> None:
+        """Merge advisory context onto a FIRING alert (no-op otherwise):
+        e.g. the hedged-recovery scorecard onto ``mass_frac_drop``, so the
+        alert itself says whether an automated mitigation is already
+        recovering the mass. Annotations ride the firing dict into
+        ``alerts()``/``summary()``; they never change alert lifecycle."""
+        if not self.enabled:
+            return
+        with self._lock:
+            alert = self._firing.get((kind, key))
+            if alert is not None:
+                alert.update({k: v for k, v in fields.items() if v is not None})
+
     def retire_key(self, kind: str, key: str) -> None:
         """Drop a detector key whose underlying series went away (peer
         departed): clears any firing alert and frees the key slot."""
@@ -631,6 +644,23 @@ class Watchdog:
                         ]
                         if fracs:
                             self.observe("mass_frac_drop", min(fracs))
+                        # Hedged-recovery annotation: stamp the LATEST
+                        # round's recovered mass onto any firing mass
+                        # alert so an operator (and the doctor) can see
+                        # whether the hedger is on the case. Stamped on
+                        # every fresh report — zeros included — so a
+                        # round where recovery stopped cannot leave a
+                        # stale "mitigation active" claim on a
+                        # still-firing alert.
+                        self.annotate(
+                            "mass_frac_drop", "",
+                            hedge_recovered_weight=float(
+                                last.get("recovered_weight") or 0.0
+                            ),
+                            hedge_recovered_slots=int(
+                                last.get("recovered_slots") or 0
+                            ),
+                        )
                 # Quality flags -> per-peer byzantine alerts. Feed every
                 # currently-flagged peer as bad and every previously-fed
                 # peer that unflagged as good, so clears happen.

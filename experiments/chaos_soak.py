@@ -1260,10 +1260,15 @@ async def _teardown_vols(vols):
 
 async def _build_health_swarm(n, *, method="trimmed_mean", min_group=3,
                               gather_timeout=10.0, round_deadline_s=None,
-                              chaos_last=False, seed=0):
+                              chaos_last=False, seed=0, hedge=False):
     """n volunteers with the (default-on) health probe; v0 sorts first and
     leads every round. ``chaos_last`` puts the LAST peer on a
-    ChaosTransport so the campaign can delay it mid-run."""
+    ChaosTransport so the campaign can delay it mid-run.
+
+    Hedged recovery (ISSUE 14) is PINNED OFF here by default: these
+    campaigns measure the deadline-DROP telemetry (lost-mass events, the
+    mass_frac_drop alert, the doctor's straggler rule), which the hedger
+    exists to make disappear — the --tail campaign is where it is on."""
     vols, boot = [], None
     schedule = FaultSchedule([], seed=seed)
     for i in range(n):
@@ -1279,6 +1284,7 @@ async def _build_health_swarm(n, *, method="trimmed_mean", min_group=3,
             t, dht, mem, min_group=min_group, max_group=n,
             join_timeout=8.0, gather_timeout=gather_timeout,
             round_deadline_s=round_deadline_s, method=method,
+            hedge=hedge,
         )
         vols.append({"pid": pid, "t": t, "dht": dht, "mem": mem, "avg": avg})
     return vols, schedule
@@ -1650,6 +1656,245 @@ def health_verdict(result: dict) -> dict:
             "rel": HEALTH_SKETCH_TOL_REL, "abs": HEALTH_SKETCH_TOL_ABS,
         },
     }
+
+
+# -- tail-optimal campaign (ISSUE 14 acceptance) -----------------------------
+#
+# Hedged per-tile recovery vs the drop-the-straggler baseline at the SAME
+# static round deadline, under the heavy-tailed set_link model: the hedged
+# arm must commit >= TAIL_LOST_MASS_BAR x less lost gradient mass, with
+# round-wall p99 within TAIL_WALL_TOL of baseline, the mass-report buckets
+# (included/recovered/excluded/aborted) summing exactly to armed mass
+# every round, and the hedge decisions visible as spans + flight events in
+# the attached recorder dumps.
+
+TAIL_LOST_MASS_BAR = 1.5
+TAIL_WALL_TOL = 0.10
+TAIL_N_ELEMS = 16_384      # 64 KiB f32 -> 16 tiles at chunk_bytes=4096
+TAIL_DEADLINE_S = 2.5
+
+TAIL_SCENARIOS = {
+    # x10 straggler: the straggler<->leader link draws a Pareto(1.3) tail
+    # on its BULK transfers (min_bytes gates the draw to payload-bearing
+    # calls — control RPCs ride the base latency, the classic slow-
+    # uplink straggler) — the median push lands well inside the deadline,
+    # the fat tail (x10 and beyond, capped where a real stack would
+    # abort the flow) blows it ~1 round in 4; the hedged refetch request
+    # is meta-sized and the reply rides the unshaped return path.
+    "straggler_x10": dict(
+        latency_s=0.15,
+        jitter={
+            "dist": "pareto", "scale": 2.0, "alpha": 1.3,
+            "cap": 6.0, "min_bytes": 32_768,
+        },
+    ),
+    # thin link: serialization alone (64 KiB at 24 KB/s) blows the
+    # deadline deterministically; the refetch REQUEST is meta-sized (no
+    # serialization term) and the straggler's response rides the
+    # unshaped return path — so recovery lands where the push cannot.
+    "thin_link": dict(
+        latency_s=0.2, bw_bps=24_000.0,
+        jitter={"dist": "lognormal", "scale": 0.15, "sigma": 0.8, "cap": 4.0},
+    ),
+}
+
+
+async def _build_tail_swarm(n, *, hedge, seed):
+    """n volunteers on ChaosTransports with 4 KiB wire chunks (16 tiles at
+    the campaign payload) and a STATIC round deadline, so the hedged and
+    drop arms run under identical commit times — the acceptance bar's
+    'same round deadline' clause, by construction."""
+    vols, boot = [], None
+    for i in range(n):
+        pid = f"v{i}"
+        t = ChaosTransport(chunk_bytes=4096, seed=seed * 101 + i)
+        dht = DHTNode(t)
+        await dht.start(bootstrap=[boot] if boot else None)
+        if boot is None:
+            boot = t.addr
+        mem = SwarmMembership(dht, pid, ttl=10.0)
+        await mem.join()
+        avg = SyncAverager(
+            t, dht, mem, min_group=3, max_group=n, join_timeout=8.0,
+            gather_timeout=10.0, round_deadline_s=TAIL_DEADLINE_S,
+            method="mean", hedge=hedge,
+        )
+        vols.append({"pid": pid, "t": t, "dht": dht, "mem": mem, "avg": avg})
+    return vols
+
+
+async def _tail_arm(args, scenario, *, hedge):
+    n = 4
+    vols = await _build_tail_swarm(n, hedge=hedge, seed=args.seed)
+    leader, straggler = vols[0], vols[-1]
+    rounds = []
+    try:
+        # Healthy warmup (links unshaped) — the deadline is static, so
+        # this just settles membership and the transport pools.
+        for r in range(2):
+            await asyncio.gather(
+                *(
+                    asyncio.wait_for(
+                        v["avg"].average(
+                            tree_for(i, size=TAIL_N_ELEMS), round_no=r
+                        ),
+                        timeout=60.0,
+                    )
+                    for i, v in enumerate(vols)
+                ),
+                return_exceptions=True,
+            )
+        straggler["t"].set_link(
+            leader["t"].addr, straggler["t"].addr, **TAIL_SCENARIOS[scenario]
+        )
+        lead_health = vols[0]["avg"].telemetry.health
+
+        async def timed_avg(v, i, r):
+            t0 = time.monotonic()
+            try:
+                res = await asyncio.wait_for(
+                    v["avg"].average(tree_for(i, size=TAIL_N_ELEMS), round_no=r),
+                    timeout=60.0,
+                )
+            except BaseException as e:  # noqa: BLE001 — campaign bookkeeping
+                return e, time.monotonic() - t0
+            return res, time.monotonic() - t0
+
+        for r in range(2, 2 + args.tail_rounds):
+            mass_cursor = lead_health.mass_rounds
+            res = await asyncio.gather(
+                *(timed_avg(v, i, r) for i, v in enumerate(vols))
+            )
+            # Leader-vantage round wall: the deadline-bounded commit path
+            # (the straggler's OWN wall reflects its slow link equally in
+            # both arms, with per-draw variance that isn't the round's).
+            wall = res[0][1]
+            ok = res[0][0] is not None and not isinstance(res[0][0], BaseException)
+            fresh = lead_health.mass_rounds > mass_cursor
+            mass = (
+                (lead_health.summary() or {}).get("mass", {}).get("last")
+                if fresh else None
+            )
+            if mass:
+                balanced = abs(
+                    mass["included_weight"] + mass["recovered_weight"]
+                    + mass["excluded_weight"] + mass["aborted_weight"]
+                    - mass["armed_weight"]
+                ) < 1e-6
+                lost_slots = mass["excluded_slots"] + mass["aborted_slots"]
+                recovered_slots = mass["recovered_slots"]
+            else:
+                # No commit this round: the whole round's mass is lost
+                # (a skipped round produces nothing for anyone). Scoring
+                # it as armed-slots lost keeps the arms comparable when
+                # one arm rescues entire rounds the other skips.
+                balanced = None
+                lost_slots = n
+                recovered_slots = 0
+            rounds.append({
+                "round": r,
+                "committed": ok,
+                "wall_s": round(wall, 3),
+                "mass": mass,
+                "balanced": balanced,
+                "lost_slots": lost_slots,
+                "recovered_slots": recovered_slots,
+            })
+        walls = sorted(r["wall_s"] for r in rounds)
+        p99 = (
+            walls[min(len(walls) - 1, int(round(0.99 * (len(walls) - 1))))]
+            if walls else None
+        )
+        with_mass = [r for r in rounds if r["mass"]]
+        hedge_spans = [
+            s for s in vols[0]["avg"].telemetry.tracer.spans()
+            if s["name"] == "hedge"
+        ]
+        out = {
+            "hedge": hedge,
+            "scenario": scenario,
+            "rounds": len(rounds),
+            "committed": sum(r["committed"] for r in rounds),
+            "lost_slots_total": sum(r["lost_slots"] for r in rounds),
+            "lost_weight_total": round(
+                sum(
+                    r["mass"]["excluded_weight"] + r["mass"]["aborted_weight"]
+                    for r in with_mass
+                ), 6,
+            ),
+            "recovered_slots_total": sum(r["recovered_slots"] for r in rounds),
+            "recovered_weight_total": round(
+                sum(r["mass"]["recovered_weight"] for r in with_mass), 6
+            ),
+            "all_balanced": all(r["balanced"] for r in with_mass),
+            "wall_p99_s": p99,
+            "hedge_stats": vols[0]["avg"].stats().get("hedge"),
+            "hedge_spans": hedge_spans[-40:],
+            "per_round": rounds,
+        }
+        out["flight_recorders"] = _flight_dumps(vols)
+        return out
+    finally:
+        await _teardown_vols(vols)
+
+
+async def tail_campaign(args):
+    out = {
+        "seed": args.seed,
+        "deadline_s": TAIL_DEADLINE_S,
+        "payload_elems": TAIL_N_ELEMS,
+        "scenarios": {},
+    }
+    for scen in TAIL_SCENARIOS:
+        print(f"[tail/{scen}] drop baseline ...")
+        drop = await _tail_arm(args, scen, hedge=False)
+        print(f"[tail/{scen}] hedged arm ...")
+        hedged = await _tail_arm(args, scen, hedge=True)
+        out["scenarios"][scen] = {"drop": drop, "hedged": hedged}
+        print(
+            f"[tail/{scen}] lost slots drop={drop['lost_slots_total']} "
+            f"hedged={hedged['lost_slots_total']} "
+            f"(recovered {hedged['recovered_slots_total']}), "
+            f"wall p99 {drop['wall_p99_s']}s -> {hedged['wall_p99_s']}s"
+        )
+    return out
+
+
+def tail_verdict(result: dict) -> dict:
+    verdict = {
+        "lost_mass_bar": TAIL_LOST_MASS_BAR,
+        "wall_tol": TAIL_WALL_TOL,
+    }
+    for scen, rec in result["scenarios"].items():
+        d, h = rec["drop"], rec["hedged"]
+        ratio = d["lost_slots_total"] / max(h["lost_slots_total"], 1e-9)
+        verdict[f"{scen}_lost_ratio"] = round(min(ratio, 999.0), 2)
+        # The scenario is only meaningful if the baseline actually loses
+        # mass at this deadline...
+        verdict[f"pass_{scen}_baseline_loses"] = d["lost_slots_total"] > 0
+        # ...and the headline bar: >= 1.5x less lost mass, same deadline.
+        verdict[f"pass_{scen}_lost_mass_reduction"] = ratio >= TAIL_LOST_MASS_BAR
+        verdict[f"pass_{scen}_wall_p99_within_tol"] = (
+            h["wall_p99_s"] is not None
+            and d["wall_p99_s"] is not None
+            and h["wall_p99_s"] <= d["wall_p99_s"] * (1.0 + TAIL_WALL_TOL)
+        )
+        verdict[f"pass_{scen}_mass_balanced"] = bool(
+            d["all_balanced"] and h["all_balanced"]
+        )
+        flights = h.get("flight_recorders") or {}
+        verdict[f"pass_{scen}_hedge_visible"] = (
+            len(h["hedge_spans"]) > 0
+            and any(
+                e.get("kind") == "hedge_issued"
+                for evs in flights.values() for e in evs
+            )
+            and any(
+                e.get("kind") == "mass_recovered_by_hedge"
+                for evs in flights.values() for e in evs
+            )
+        )
+    return verdict
 
 
 # -- watchdog campaign (ISSUE 13 acceptance) ---------------------------------
@@ -2369,6 +2614,18 @@ def main():
                          "live under the pinned schema")
     ap.add_argument("--watchdog-rounds", type=int, default=8,
                     help="fault rounds per scenario in the watchdog arm")
+    ap.add_argument("--tail", action="store_true",
+                    help="run the tail-optimal arm instead (ISSUE 14): "
+                         "hedged per-tile recovery vs the drop-the-"
+                         "straggler baseline at the SAME static round "
+                         "deadline under the heavy-tailed set_link model "
+                         "(x10 Pareto straggler + thin-link scenarios); "
+                         "the hedged arm must commit >=1.5x less lost "
+                         "gradient mass with round-wall p99 within 10%, "
+                         "balanced mass buckets every round, and hedge "
+                         "decisions visible as spans + flight events")
+    ap.add_argument("--tail-rounds", type=int, default=12,
+                    help="faulted rounds per scenario arm in the tail arm")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     if args.out is None:
@@ -2380,6 +2637,7 @@ def main():
             else "chaos_controlplane.json" if args.controlplane
             else "chaos_health.json" if args.health
             else "chaos_watchdog.json" if args.watchdog
+            else "chaos_tail.json" if args.tail
             else "chaos_soak.json",
         )
     if args.quick:
@@ -2392,7 +2650,19 @@ def main():
         args.controlplane_rounds = 2
         args.health_rounds = 8
         args.watchdog_rounds = 6
+        args.tail_rounds = 6
         args.no_train = True
+
+    if args.tail:
+        result = {"tail_campaign": asyncio.run(tail_campaign(args))}
+        result["verdict"] = tail_verdict(result["tail_campaign"])
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[done] artifact -> {args.out}")
+        print(json.dumps(result["verdict"], indent=2))
+        ok = all(v for k, v in result["verdict"].items() if k.startswith("pass_"))
+        sys.exit(0 if ok else 1)
 
     if args.watchdog:
         result = {"watchdog_campaign": asyncio.run(watchdog_campaign(args))}

@@ -113,8 +113,13 @@ def _rule_leader_crash_storm(bundle: dict) -> Optional[dict]:
 
 def _rule_straggler(bundle: dict) -> Optional[dict]:
     """Deadline-dropped gradient mass repeatedly attributed to one peer +
-    a mass-fraction alert -> a straggler losing its mass at the deadline."""
+    a mass-fraction alert -> a straggler losing its mass at the deadline.
+    DEMOTED when hedged recovery is committing that mass anyway
+    (``mass_recovered_by_hedge`` events dominating the loss events, or the
+    peer itself showing up in the recovered sets): a post-mortem must not
+    page on a problem the hedger already fixed."""
     losses = _events_of(bundle, "mass_lost_at_deadline")
+    recoveries = _events_of(bundle, "mass_recovered_by_hedge")
     if not losses:
         return None
     dropped = Counter()
@@ -133,15 +138,31 @@ def _rule_straggler(bundle: dict) -> Optional[dict]:
         e for e in _events_of(bundle, "peer_quality_flagged")
         if str(e.get("peer_flagged", e.get("peer"))) == peer
     ]
+    # Hedge mitigation evidence: rounds where the tail pipeline COMMITTED
+    # recovered mass, and specifically this peer's.
+    peer_recovered = sum(
+        1 for e in recoveries if str(peer) in [str(p) for p in (e.get("recovered") or [])]
+    )
+    saved = bool(recoveries) and (
+        peer_recovered >= n or len(recoveries) >= 2 * len(losses)
+    )
     score = (
         0.5 * _sat(n, 3)
         + 0.4 * _sat(len(mass) + len(slo), 1)
         + (-0.3 if flags else 0.1)
+        + (-0.4 * _sat(peer_recovered + len(recoveries), 2) if recoveries else 0.0)
     )
     chain = (
         f"peer {peer} dropped at the round deadline {n}x -> "
         f"mass_committed_frac drop ({len(mass)} alert(s))"
     )
+    if recoveries:
+        chain += (
+            f" [hedge_saved_mass: {len(recoveries)} recovered-mass round(s), "
+            f"{peer_recovered}x this peer — "
+            + ("mitigated, demoted" if saved else "partial mitigation")
+            + "]"
+        )
     return {
         "cause": "straggler_deadline_drop",
         "score": round(max(score, 0.0), 4),
@@ -152,6 +173,11 @@ def _rule_straggler(bundle: dict) -> Optional[dict]:
             "dropped_by_peer": dict(dropped),
             "mass_frac_alerts": len(mass),
             "slo_burn_alerts": len(slo),
+            "hedge_saved_mass": {
+                "recovered_mass_events": len(recoveries),
+                "peer_recovered_rounds": peer_recovered,
+                "mitigated": saved,
+            },
         },
     }
 

@@ -191,6 +191,16 @@ def main() -> None:
                          "quality-flag alerts): no alert bytes ride the "
                          "heartbeat report; tracing and the health probe "
                          "stay on")
+    ap.add_argument("--no-hedge", action="store_true",
+                    help="disable tail-optimal hedged recovery when this "
+                         "volunteer leads streaming rounds (soft-deadline "
+                         "sync.refetch re-requests for predicted-late tile "
+                         "ranges): restores pure deadline-drop semantics")
+    ap.add_argument("--tail-redundancy-frac", type=float, default=0.0,
+                    help="summand redundancy for the last k%% of tiles: "
+                         "each contribution's tail rides XOR-coded on its "
+                         "ring successor's sidecar, decoded by the leader "
+                         "only if the original misses commit (0 = off)")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve GET /metrics in Prometheus text format on "
                          "this local port (0 = off): any stock scraper can "
@@ -356,6 +366,8 @@ def main() -> None:
         telemetry=not args.no_telemetry,
         health_probe=not (args.no_telemetry or args.no_health_probe),
         watchdog=not (args.no_telemetry or args.no_watchdog),
+        hedge=not args.no_hedge,
+        tail_redundancy_frac=args.tail_redundancy_frac,
         metrics_port=args.metrics_port,
     )
     if cfg.averaging != "none":

@@ -103,6 +103,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "tailopt: tail-optimal aggregation tests (per-tile arrival "
+        "scoreboard, hedged range re-requests + (peer, tile, fence) "
+        "idempotency property test, recovered-mass accounting, summand "
+        "redundancy XOR decode, AIMD hedge budget, heavy-tailed link "
+        "jitter, hedged-vs-drop bench smoke failing loudly below the "
+        "lost-mass bar) — in the default lane, and selectable on their "
+        "own with -m tailopt",
+    )
+    config.addinivalue_line(
+        "markers",
         "watchdog: swarm-watchdog tests (online baselines + anomaly "
         "detectors with hysteresis/cooldown, SLO burn-rate windows, "
         "alert lifecycle + flight severity, incremental flight cursor, "

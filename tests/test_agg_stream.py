@@ -907,6 +907,320 @@ class TestSyncStreamingRound:
         assert repr(STREAMED) == "<streamed>"
 
 
+class TestHedgedRecovery:
+    """Tail-optimal hedged recovery at the aggregator (extends the PR-3
+    window-closure atomicity suite): the (slot, tile) bitmap makes the
+    original stream and hedged range replies idempotent in either order,
+    a hedge-completed slot classifies as ``recovered`` with the mass
+    report still balanced, and neither a fence nor an abort can be
+    bypassed by a hedge."""
+
+    pytestmark = pytest.mark.tailopt
+
+    N_ELEMS, CB = 230, 64 * 4  # 4 tiles, last one short
+
+    def _mk(self, method="mean", peers=("a", "b", "c"), **kw):
+        return StreamingAggregator(
+            self.N_ELEMS, list(peers), method, "f32", self.CB,
+            kw_fn=lambda n: {}, pool=TilePool(), **kw,
+        )
+
+    @staticmethod
+    def _chunks(buf, cb):
+        data = np.ascontiguousarray(buf, np.float32).tobytes()
+        return [(off, data[off : off + cb]) for off in range(0, len(data), cb)]
+
+    def test_hedge_then_original_is_single_fold(self):
+        """Hedge lands first, original second: the original's copy is a
+        counted duplicate, the tile's weight tally is single."""
+        rng = np.random.default_rng(0)
+        bufs = rng.standard_normal((3, self.N_ELEMS)).astype(np.float32)
+
+        async def main():
+            agg = self._mk("mean")
+            agg.add_dense("a", 1.0, bufs[0])
+            _feed_streamed(agg, "c", 1.0, bufs[2], self.CB)
+            chunks = self._chunks(bufs[1], self.CB)
+            # Hedged replies for the tail tiles arrive FIRST (out of order
+            # relative to the original stream — allowed for hedges).
+            for off, data in chunks[2:]:
+                assert agg.add_hedged("b", 1.0, off, data) == 1
+            sink = agg.make_sink("b", 1.0, self.N_ELEMS * 4)
+            for off, data in chunks:
+                sink(off, self.N_ELEMS * 4, data)
+            sink.close(True)
+            assert agg.hedge_duplicates == 2  # originals of tiles 2, 3
+            rep = agg.mass_report()
+            assert rep["per_peer"]["b"]["outcome"] == "recovered"
+            assert rep["recovered_slots"] == 1 and rep["included_slots"] == 2
+            assert (
+                rep["included_weight"] + rep["recovered_weight"]
+                + rep["excluded_weight"] + rep["aborted_weight"]
+                == rep["armed_weight"]
+            )
+            return await agg.finalize()
+
+        got = run(main())
+        expect = bufs.mean(axis=0)
+        np.testing.assert_allclose(got, expect, rtol=2e-6, atol=1e-7)
+
+    def test_original_then_hedge_is_duplicate(self):
+        rng = np.random.default_rng(1)
+        bufs = rng.standard_normal((3, self.N_ELEMS)).astype(np.float32)
+
+        async def main():
+            agg = self._mk("mean")
+            agg.add_dense("a", 1.0, bufs[0])
+            _feed_streamed(agg, "b", 1.0, bufs[1], self.CB)
+            _feed_streamed(agg, "c", 1.0, bufs[2], self.CB)
+            for off, data in self._chunks(bufs[1], self.CB):
+                assert agg.add_hedged("b", 1.0, off, data) == 0
+            assert agg.hedge_duplicates == agg.n_tiles
+            assert agg.tiles_recovered == 0
+            # Fully-streamed b stays INCLUDED: duplicates are not recovery.
+            assert agg.mass_report()["per_peer"]["b"]["outcome"] == "included"
+            return await agg.finalize()
+
+        got = run(main())
+        np.testing.assert_allclose(got, bufs.mean(axis=0), rtol=2e-6, atol=1e-7)
+
+    def test_silent_straggler_completed_by_hedges_is_recovered(self):
+        """A peer that never opened a stream is completed tile-by-tile from
+        hedged replies (weight adopted from the refetch meta) and seals as
+        ``recovered``; the scoreboard empties as tiles land."""
+        rng = np.random.default_rng(2)
+        bufs = rng.standard_normal((3, self.N_ELEMS)).astype(np.float32)
+
+        async def main():
+            agg = self._mk("median")
+            agg.add_dense("a", 1.0, bufs[0])
+            _feed_streamed(agg, "c", 1.0, bufs[2], self.CB)
+            board = agg.scoreboard()["b"]
+            assert not board["started"]
+            assert board["missing"] == [(0, agg.n_tiles)]
+            chunks = self._chunks(bufs[1], self.CB)
+            for off, data in reversed(chunks):  # any order
+                assert agg.add_hedged("b", 2.0, off, data) == 1
+            board = agg.scoreboard()["b"]
+            assert board["sealed"] and board["missing"] == []
+            assert board["hedged_tiles"] == agg.n_tiles
+            assert agg.weight_of("b") == 2.0
+            hs = agg.hedge_stats()
+            assert hs["slots_recovered"] == 1
+            assert hs["tiles_recovered"] == agg.n_tiles
+            rep = agg.mass_report()
+            assert rep["per_peer"]["b"]["outcome"] == "recovered"
+            assert rep["mass_committed_frac"] == 1.0
+            return await agg.finalize()
+
+        got = run(main())
+        np.testing.assert_allclose(
+            got, np.median(bufs, axis=0), rtol=2e-6, atol=1e-7
+        )
+
+    def test_hedge_completed_row_aggregates_in_dense_modes(self):
+        """Review regression: dense/d2_dense finalize must admit rows
+        completed via hedges (out-of-order tiles never advance the
+        in-order cursor) — a slot REPORTED recovered must contribute its
+        mass, or the accounting commits without the gradient."""
+        rng = np.random.default_rng(8)
+        bufs = rng.standard_normal((3, self.N_ELEMS)).astype(np.float32)
+
+        async def main():
+            agg = self._mk("geometric_median")
+            agg.add_dense("a", 1.0, bufs[0])
+            _feed_streamed(agg, "c", 1.0, bufs[2], self.CB)
+            for off, data in reversed(self._chunks(bufs[1], self.CB)):
+                assert agg.add_hedged("b", 1.0, off, data) == 1
+            rep = agg.mass_report()
+            assert rep["per_peer"]["b"]["outcome"] == "recovered"
+            return await agg.finalize()
+
+        got = run(main())
+        from distributedvolunteercomputing_tpu.ops import robust
+
+        expect = robust.aggregate(bufs.copy(), "geometric_median")
+        np.testing.assert_allclose(got, expect, rtol=2e-5, atol=1e-6)
+
+    def test_property_any_interleaving_folds_each_tile_exactly_once(self):
+        """The ISSUE-14 property: across random interleavings of the
+        original stream's chunks, hedged range replies, and an optional
+        mid-stream abort, every (peer, tile) folds EXACTLY once — checked
+        by the per-tile weight tally and by exact equality with the dense
+        recompute over the folded set — the mass report stays balanced,
+        and an aborted slot is never resurrected by a later hedge."""
+        for trial in range(40):
+            rng = np.random.default_rng(5000 + trial)
+            weights = rng.uniform(0.5, 2.0, 3)
+            bufs = rng.standard_normal((3, self.N_ELEMS)).astype(np.float32)
+
+            async def main():
+                agg = self._mk("mean")
+                n_tiles = agg.n_tiles
+                total = self.N_ELEMS * 4
+                chunks_b = self._chunks(bufs[1], self.CB)
+                chunks_c = self._chunks(bufs[2], self.CB)
+                # b's original stream may abort after k chunks (k < n_tiles).
+                abort_after = (
+                    int(rng.integers(0, n_tiles))
+                    if rng.random() < 0.4 else None
+                )
+                n_orig = n_tiles if abort_after is None else abort_after
+                ev_b = [("chunk", "b", t) for t in range(n_orig)]
+                if abort_after is not None:
+                    ev_b.append(("abort", "b"))
+                hedge_tiles = [t for t in range(n_tiles) if rng.random() < 0.7]
+                rng.shuffle(hedge_tiles)
+                ev_h = [("hedge", "b", t) for t in hedge_tiles]
+                ev_c = [("chunk", "c", t) for t in range(n_tiles)]
+                ev_a = [("dense", "a")]
+                # Random merge preserving each source's internal order.
+                streams = [s for s in (ev_b, ev_h, ev_c, ev_a) if s]
+                events = []
+                while streams:
+                    s = streams[int(rng.integers(0, len(streams)))]
+                    events.append(s.pop(0))
+                    if not s:
+                        streams.remove(s)
+                sink_b = agg.make_sink("b", float(weights[1]), total)
+                sink_c = agg.make_sink("c", float(weights[2]), total)
+                post_abort_hedges = 0
+                aborted = False
+                for ev in events:
+                    if ev[0] == "dense":
+                        agg.add_dense("a", float(weights[0]), bufs[0])
+                    elif ev[0] == "abort":
+                        sink_b.close(False)
+                        aborted = "b" not in [
+                            agg.slots[s] for s in agg._sealed
+                        ]
+                    elif ev[0] == "hedge":
+                        t = ev[2]
+                        folded = agg.add_hedged(
+                            "b", float(weights[1]), t * self.CB,
+                            chunks_b[t][1],
+                        )
+                        if aborted:
+                            post_abort_hedges += folded
+                    else:
+                        _, p, t = ev
+                        chunks = chunks_b if p == "b" else chunks_c
+                        sink = sink_b if p == "b" else sink_c
+                        sink(t * self.CB, total, chunks[t][1])
+                if not aborted:
+                    sink_c.close(True)
+                # -- exactly-once: the tile weight tally must equal the
+                # sum of weights over the folded bitmap, per tile.
+                have = agg._tile_have.copy()
+                for t in range(n_tiles):
+                    expect_w = sum(
+                        weights[i] for i in range(3) if have[i, t]
+                    )
+                    assert abs(agg._tile_w[t] - expect_w) < 1e-9, (
+                        f"trial {trial} tile {t}: tally {agg._tile_w[t]} "
+                        f"!= {expect_w} (double/missed fold)"
+                    )
+                # -- an aborted slot never resurrects.
+                assert post_abort_hedges == 0
+                rep = agg.mass_report()
+                assert (
+                    round(
+                        rep["included_weight"] + rep["recovered_weight"]
+                        + rep["excluded_weight"] + rep["aborted_weight"], 6,
+                    )
+                    == rep["armed_weight"]
+                )
+                out = await agg.finalize()
+                return out, have
+
+            got, have = run(main())
+            # Exact per-tile equivalence over the folded set: a double
+            # fold (or a missed one) cannot produce this value.
+            for t in range((self.N_ELEMS + self.CB // 4 - 1) // (self.CB // 4)):
+                e0 = t * (self.CB // 4)
+                e1 = min(e0 + self.CB // 4, self.N_ELEMS)
+                rows = [i for i in range(3) if have[i, t]]
+                if not rows:
+                    continue
+                expect = (
+                    sum(weights[i] * bufs[i, e0:e1].astype(np.float64) for i in rows)
+                    / sum(weights[i] for i in rows)
+                )
+                np.testing.assert_allclose(
+                    got[e0:e1], expect.astype(np.float32), rtol=3e-6, atol=1e-6,
+                    err_msg=f"trial {trial} tile {t} rows {rows}",
+                )
+
+    def test_fence_counts_hedged_chunks_never_folds(self):
+        rng = np.random.default_rng(3)
+        bufs = rng.standard_normal((2, self.N_ELEMS)).astype(np.float32)
+        agg = self._mk("mean", peers=("a", "b"))
+        agg.add_dense("a", 1.0, bufs[0])
+        agg.fence()
+        before = agg._tile_w.copy() if agg._tile_w is not None else None
+        for off, data in self._chunks(bufs[1], self.CB):
+            assert agg.add_hedged("b", 1.0, off, data) == 0
+        assert agg.chunks_after_fence == agg.n_tiles
+        assert agg.tiles_recovered == 0
+        if before is not None:
+            np.testing.assert_array_equal(agg._tile_w, before)
+
+    def test_aborted_slot_refuses_hedges(self):
+        """A mid-stream abort (tiles committed -> tainted) closes the slot
+        to hedged replies: dropped and counted, never folded."""
+        rng = np.random.default_rng(4)
+        bufs = rng.standard_normal((3, self.N_ELEMS)).astype(np.float32)
+        agg = self._mk("mean")
+        agg.add_dense("a", 1.0, bufs[0])
+        chunks = self._chunks(bufs[1], self.CB)
+        sink = agg.make_sink("b", 1.0, self.N_ELEMS * 4)
+        sink(0, self.N_ELEMS * 4, chunks[0][1])  # one tile folds
+        sink.close(False)  # dies mid-payload -> tainted
+        assert agg.taints("b")
+        for off, data in chunks[1:]:
+            assert agg.add_hedged("b", 1.0, off, data) == 0
+        assert agg.hedge_dropped == len(chunks) - 1
+        assert agg.mass_report()["per_peer"]["b"]["outcome"] == "aborted"
+
+    def test_malformed_hedge_drops_without_poisoning_slot(self):
+        """A bad hedge reply (misaligned offset / wrong length) only drops
+        itself — the healthy original stream still completes the slot."""
+        rng = np.random.default_rng(5)
+        bufs = rng.standard_normal((2, self.N_ELEMS)).astype(np.float32)
+        agg = self._mk("mean", peers=("a", "b"))
+        agg.add_dense("a", 1.0, bufs[0])
+        assert agg.add_hedged("b", 1.0, 13, b"x" * self.CB) == 0  # misaligned
+        assert agg.add_hedged("b", 1.0, 0, b"x" * 7) == 0  # wrong length
+        assert agg.hedge_dropped == 2
+        _feed_streamed(agg, "b", 1.0, bufs[1], self.CB)
+        assert agg.mass_report()["per_peer"]["b"]["outcome"] == "included"
+
+    def test_scoreboard_reports_suffix_and_holes(self):
+        rng = np.random.default_rng(6)
+        buf = rng.standard_normal(self.N_ELEMS).astype(np.float32)
+        agg = self._mk("mean", peers=("a", "b"))
+        chunks = self._chunks(buf, self.CB)
+        sink = agg.make_sink("b", 1.0, self.N_ELEMS * 4)
+        sink(0, self.N_ELEMS * 4, chunks[0][1])
+        agg.add_hedged("b", 1.0, 2 * self.CB, chunks[2][1])  # hole at tile 1
+        board = agg.scoreboard()["b"]
+        assert board["tiles_got"] == 2 and board["started"]
+        assert board["missing"] == [(1, 2), (3, agg.n_tiles)]
+        assert board["last_arrival_age_s"] is not None
+
+    def test_tail_bytes_retained_for_redundancy(self):
+        rng = np.random.default_rng(7)
+        buf = rng.standard_normal(self.N_ELEMS).astype(np.float32)
+        agg = self._mk("mean", peers=("a", "b"), tail_keep_tiles=2)
+        chunks = self._chunks(buf, self.CB)
+        _feed_streamed(agg, "b", 1.0, buf, self.CB)
+        assert agg.tail_bytes("b", agg.n_tiles - 1) == chunks[-1][1]
+        assert agg.tail_bytes("b", agg.n_tiles - 2) == chunks[-2][1]
+        assert agg.tail_bytes("b", 0) is None  # outside the tail window
+        agg.release()
+        assert agg.tail_bytes("b", agg.n_tiles - 1) is None
+
+
 class TestAggregationBenchSmoke:
     """Small-shape regression guard over the bench harness: streaming must
     hold at most half the materialize arm's peak bytes and commit no

@@ -1,0 +1,465 @@
+"""Tail-optimal aggregation end to end: hedged per-tile recovery.
+
+Covers the ISSUE-14 read path above the aggregator (whose idempotency
+property tests live in test_agg_stream.py::TestHedgedRecovery):
+
+- a real-TCP leader round where a SILENT straggler's entire contribution
+  is recovered over sync.refetch before the (unchanged) round deadline,
+  classified ``recovered`` in the balanced mass report;
+- the bench smoke: hedged committed mass must beat the drop-the-straggler
+  baseline by >= 1.2x lost-mass reduction at the SAME deadline, failing
+  loudly otherwise;
+- summand redundancy: ring share -> XOR sidecar -> leader decode of the
+  straggler's tail tiles at commit, plus the replica-holder refetch path;
+- the AIMD hedge budget in swarm/resilience.py and its per-peer tail
+  quantiles; ChaosTransport.set_link's heavy-tailed jitter; the doctor's
+  hedge_saved_mass demotion; the watchdog's mass-alert annotation.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+from distributedvolunteercomputing_tpu.swarm.chaos import ChaosTransport
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.matchmaking import Group
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.resilience import ResiliencePolicy
+from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+pytestmark = pytest.mark.tailopt
+
+
+def run(coro, timeout=90):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def _make_node(peer_id, *, chaos=None, **avg_kw):
+    t = chaos if chaos is not None else Transport(chunk_bytes=4096)
+    dht = DHTNode(t)
+    mem = SwarmMembership(dht, peer_id, ttl=10.0)
+    avg = SyncAverager(t, dht, mem, **avg_kw)
+    return t, avg
+
+
+N = 5000  # 20 000 B f32 payload -> 5 tiles at chunk_bytes=4096
+
+
+def _tree(value):
+    return {"w": np.full((N,), np.float32(value))}
+
+
+class TestHedgedRound:
+    """Leader rounds over real TCP with a silent straggler: the hedged arm
+    recovers its mass inside the SAME round deadline; the drop baseline
+    loses it."""
+
+    async def _run_round(
+        self, *, hedge, redundancy=0.0, budget=2.0,
+        member_values=(1.0, 2.0, 7.0), silent=(False, False, True),
+    ):
+        leader_t, leader = _make_node(
+            "leader", method="mean", min_group=2, gather_timeout=6.0,
+            hedge=hedge, tail_redundancy_frac=redundancy,
+        )
+        await leader_t.start()
+        members = []
+        for i in range(len(member_values)):
+            t, avg = _make_node(
+                f"m{i}", method="mean", tail_redundancy_frac=redundancy,
+            )
+            await t.start()
+            members.append((t, avg))
+        try:
+            buf = leader._pack(_tree(0.0))
+            tokens = {"leader": "ltok"}
+            tokens.update({f"m{i}": f"tok{i}" for i in range(len(members))})
+            all_members = [("leader", leader_t.addr)] + [
+                (f"m{i}", members[i][0].addr) for i in range(len(members))
+            ]
+
+            def group_for(pid, idx, tok):
+                return Group(
+                    epoch="round-h", members=list(all_members), my_index=idx,
+                    token=tok, member_tokens=tokens if idx == 0 else None,
+                    deadline=time.time() + budget, budget=budget,
+                )
+
+            lead_group = group_for("leader", 0, "ltok")
+            lead_task = asyncio.create_task(
+                leader._lead_round(lead_group, buf, 1.0)
+            )
+            await asyncio.sleep(0.15)  # leader armed
+
+            async def push(i):
+                t, avg = members[i]
+                mbuf = avg._pack(_tree(member_values[i]))
+                mgroup = group_for(f"m{i}", i + 1, f"tok{i}")
+                # The member-side retention average() would have installed:
+                # the straggler stays SILENT (its push never makes the
+                # deadline) but its retained bytes are refetchable, and
+                # redundancy shares go to the ring successor.
+                avg._retain_push(mgroup, mbuf, 1.0)
+                if redundancy:
+                    await avg._send_redund_share(mgroup, mbuf, 1.0)
+                if silent[i]:
+                    return None
+                payload = avg._wire_stream(mbuf)
+                return await t.call(
+                    leader_t.addr, "sync.contribute",
+                    {
+                        "epoch": "round-h", "peer": f"m{i}", "weight": 1.0,
+                        "schema": leader._schema, "token": f"tok{i}",
+                    },
+                    payload, timeout=5.0,
+                )
+
+            t0 = time.monotonic()
+            pushes = await asyncio.gather(
+                *(push(i) for i in range(len(members))), return_exceptions=True
+            )
+            result = await asyncio.wait_for(lead_task, timeout=budget + 30)
+            wall = time.monotonic() - t0
+            mass = leader.health._last_mass if leader.health else None
+            return leader, result, pushes, mass, wall
+        finally:
+            await leader_t.close()
+            for t, _ in members:
+                await t.close()
+
+    def test_silent_straggler_recovered_at_same_deadline(self):
+        leader, result, pushes, mass, _ = run(self._run_round(hedge=True))
+        assert all(not isinstance(p, Exception) for p in pushes)
+        # All four contributions committed: (0 + 1 + 2 + 7) / 4.
+        np.testing.assert_allclose(result["w"], 2.5, rtol=1e-6)
+        g = leader._agg_gauges
+        assert g["tiles_recovered"] == 5  # the straggler's whole payload
+        assert leader.hedges_issued >= 1 and leader.slots_recovered == 1
+        assert mass is not None
+        assert mass["recovered_slots"] == 1
+        assert mass["mass_committed_frac"] == 1.0
+        assert (
+            mass["included_weight"] + mass["recovered_weight"]
+            + mass["excluded_weight"] + mass["aborted_weight"]
+            == mass["armed_weight"]
+        )
+        # Hedge evidence on the telemetry plane: span + flight event.
+        hedge_spans = [
+            s for s in leader.telemetry.tracer.spans() if s["name"] == "hedge"
+        ]
+        assert hedge_spans
+        assert any(
+            (s.get("attrs") or {}).get("ok") and (s.get("attrs") or {}).get("folded")
+            for s in hedge_spans
+        )
+        events = leader.telemetry.recorder.dump(kinds=["hedge_issued"])
+        assert events and events[-1]["peer"] == "m2"
+
+    def test_drop_baseline_loses_the_mass(self):
+        leader, result, pushes, mass, _ = run(self._run_round(hedge=False))
+        # Straggler dropped at the deadline: (0 + 1 + 2) / 3.
+        np.testing.assert_allclose(result["w"], 1.0, rtol=1e-6)
+        assert leader.hedges_issued == 0
+        assert mass is not None and mass["recovered_slots"] == 0
+        assert mass["slot_committed_frac"] == 0.75
+
+    def test_bench_smoke_hedged_beats_drop_baseline(self):
+        """The ISSUE-14 micro-bench bar, as a loud default-suite smoke:
+        hedged lost mass must be >= 1.2x smaller than the drop baseline's
+        at the SAME round deadline, with round wall within 25% (CI grace
+        over the campaign's 10% bar)."""
+        _, _, _, mass_h, wall_h = run(self._run_round(hedge=True))
+        _, _, _, mass_d, wall_d = run(self._run_round(hedge=False))
+        lost_h = 1.0 - mass_h["slot_committed_frac"]
+        lost_d = 1.0 - mass_d["slot_committed_frac"]
+        ratio = lost_d / max(lost_h, 1e-9)
+        assert ratio >= 1.2, (
+            f"REGRESSION: hedged lost-mass reduction {ratio:.2f}x < 1.2x bar "
+            f"(hedged lost {lost_h:.3f}, drop baseline lost {lost_d:.3f})"
+        )
+        assert wall_h <= wall_d * 1.25 + 0.5, (
+            f"REGRESSION: hedged round wall {wall_h:.2f}s vs baseline "
+            f"{wall_d:.2f}s — hedging must not stretch the deadline"
+        )
+
+    def test_redundancy_sidecar_decodes_straggler_tail(self):
+        """Redundancy without hedging: the straggler's LAST-k% tiles are
+        decoded from its ring successor's XOR sidecar at commit (the
+        original missed), per-tile participation for the rest."""
+        leader, result, pushes, mass, _ = run(
+            self._run_round(hedge=False, redundancy=0.4)
+        )
+        assert all(not isinstance(p, Exception) for p in pushes)
+        g = leader._agg_gauges
+        # r_tiles = round(0.4 * 5) = 2: tiles 3..4 decoded from the sidecar.
+        assert g["tiles_recovered"] == 2
+        assert leader.redund_decodes == 2
+        w = result["w"]
+        # Head tiles exclude the straggler: (0+1+2)/3; decoded tail tiles
+        # include it: (0+1+2+7)/4.
+        np.testing.assert_allclose(w[: 3 * 1024], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(w[4 * 1024 :], 2.5, rtol=1e-6)
+
+    def test_replica_holder_refetch_serves_neighbor_tail(self):
+        """The second hedge hop: a ring successor serves its stashed share
+        of the straggler's tail through sync.refetch (peer != self)."""
+
+        async def main():
+            t0_t, holder = _make_node("m0", tail_redundancy_frac=0.4)
+            await t0_t.start()
+            t1_t, caller = _make_node("leader")
+            await t1_t.start()
+            try:
+                mbuf = holder._pack(_tree(3.0))
+                grp = Group(
+                    epoch="round-r", members=[("m0", t0_t.addr)], my_index=0,
+                    token="htok", deadline=time.time() + 5, budget=5.0,
+                )
+                holder._retain_push(grp, mbuf, 1.0)
+                tail = holder._encode_range(mbuf, 3 * 1024, N)
+                # The predecessor's share, as sync.redund_share stashes it.
+                holder._redund_shares[("round-r", "m2")] = (
+                    time.monotonic(), 2.5, 3, tail, 0,
+                )
+                ret, payload = await t1_t.call(
+                    t0_t.addr, "sync.refetch",
+                    {
+                        "epoch": "round-r", "fence": 0, "peer": "m2",
+                        "t0": 3, "t1": 5, "token": "htok",
+                    },
+                    timeout=5.0,
+                )
+                assert ret["weight"] == 2.5
+                assert bytes(payload) == tail
+                # The degraded case the replica hop EXISTS for: the
+                # holder's own round resolved (retention dropped) while
+                # the leader's round is still open — the stashed share
+                # must still serve.
+                holder._drop_retained("round-r")
+                ret, payload = await t1_t.call(
+                    t0_t.addr, "sync.refetch",
+                    {
+                        "epoch": "round-r", "fence": 0, "peer": "m2",
+                        "t0": 3, "t1": 5, "token": "",
+                    },
+                    timeout=5.0,
+                )
+                assert ret["weight"] == 2.5 and bytes(payload) == tail
+            finally:
+                await t0_t.close()
+                await t1_t.close()
+
+        run(main())
+
+
+class TestHedgeBudgetAIMD:
+    def test_lost_mass_opens_budget(self):
+        p = ResiliencePolicy()
+        soft0, infl0 = p.hedge_params("cross")
+        for _ in range(4):
+            p.record_hedge_outcome(
+                "cross", issued=2, tiles_recovered=1, lost_weight=1.0
+            )
+        soft, infl = p.hedge_params("cross")
+        assert infl > infl0 and soft < soft0
+        assert infl <= p.HEDGE_INFLIGHT_MAX
+        assert soft >= p.HEDGE_SOFT_FRAC_MIN
+
+    def test_wasted_hedges_close_budget(self):
+        p = ResiliencePolicy()
+        # Open it first, then waste: duplicates only, nothing recovered.
+        for _ in range(4):
+            p.record_hedge_outcome("flat", issued=2, lost_weight=1.0)
+        soft_hi, infl_hi = p.hedge_params("flat")
+        for _ in range(8):
+            p.record_hedge_outcome(
+                "flat", issued=2, duplicate_tiles=5, tiles_recovered=0,
+            )
+        soft, infl = p.hedge_params("flat")
+        assert infl < infl_hi and soft > soft_hi
+        assert infl >= p.HEDGE_INFLIGHT_MIN
+
+    def test_levels_learn_independently_and_export(self):
+        p = ResiliencePolicy()
+        p.record_hedge_outcome("cross", issued=1, lost_weight=1.0)
+        p.record_hedge_outcome("intra", issued=1, duplicate_tiles=3)
+        s = p.stats()["hedge"]
+        assert set(s) == {"cross", "intra"}
+        assert s["cross"]["soft_frac"] < s["intra"]["soft_frac"]
+        assert s["cross"]["issued"] == 1 and s["cross"]["rounds"] == 1
+
+    def test_quiet_rounds_leave_operating_point(self):
+        p = ResiliencePolicy()
+        before = p.hedge_params("flat")
+        p.record_hedge_outcome("flat", issued=0)
+        assert p.hedge_params("flat") == before
+
+
+class TestPeerTailQuantiles:
+    def test_quantiles_exported_in_stats(self):
+        p = ResiliencePolicy()
+        for i in range(20):
+            p.record_contribution_latency("slow", 0.1 + 0.1 * i)
+            p.record_contribution_latency("fast", 0.01)
+        st = p.stats()["peers"]
+        assert st["fast"]["lat_p50_s"] == 0.01
+        assert st["slow"]["lat_p95_s"] > st["slow"]["lat_p50_s"] > 0.5
+        assert st["slow"]["lat_samples"] == 20
+        q = p.peer_latency_quantiles("slow")
+        assert q is not None and q[1] >= q[0]
+
+    def test_no_samples_no_keys(self):
+        p = ResiliencePolicy()
+        p.record_round(duration_s=1.0, ok=True, on_time=["a"])
+        assert "lat_p50_s" not in p.stats()["peers"]["a"]
+        assert p.peer_latency_quantiles("a") is None
+
+    def test_rejects_bogus_samples(self):
+        p = ResiliencePolicy()
+        p.record_contribution_latency("a", -1.0)
+        p.record_contribution_latency("a", float("inf"))
+        assert p.peer_latency_quantiles("a") is None
+
+
+class TestHeavyTailLink:
+    def _tp(self, seed=7):
+        return ChaosTransport(seed=seed)
+
+    def test_pareto_jitter_is_heavy_tailed_and_seeded(self):
+        t = self._tp()
+        a, b = ("127.0.0.1", 1111), ("127.0.0.1", 2222)
+        t._host, t._port = a  # pin self.addr without binding
+        t.set_link(
+            a, b, latency_s=0.01,
+            jitter={"dist": "pareto", "scale": 0.05, "alpha": 1.3},
+        )
+        try:
+            draws = [t._link_delay(b, 0) for _ in range(4000)]
+            assert min(draws) >= 0.01  # base latency is the floor
+            med = sorted(draws)[len(draws) // 2]
+            assert med < 0.2  # most calls near the base...
+            assert max(draws) > 10 * med  # ...with a fat tail
+            # Seeded: same seed + same draw order reproduces exactly.
+            t2 = self._tp()
+            t2._host, t2._port = a
+            assert [t2._link_delay(b, 0) for _ in range(10)] == draws[:10]
+        finally:
+            t.clear_links()
+
+    def test_lognormal_jitter_median_near_scale(self):
+        t = self._tp()
+        a, b = ("127.0.0.1", 1111), ("127.0.0.1", 2222)
+        t._host, t._port = a
+        t.set_link(
+            a, b, jitter={"dist": "lognormal", "scale": 0.1, "sigma": 1.0},
+        )
+        try:
+            draws = sorted(t._link_delay(b, 0) for _ in range(4000))
+            med = draws[len(draws) // 2]
+            assert 0.05 < med < 0.2  # median ~= scale
+        finally:
+            t.clear_links()
+
+    def test_jitter_composes_with_bandwidth(self):
+        t = self._tp()
+        a, b = ("127.0.0.1", 1111), ("127.0.0.1", 2222)
+        t._host, t._port = a
+        t.set_link(
+            a, b, latency_s=0.5, bw_bps=1000.0,
+            jitter={"dist": "lognormal", "scale": 0.01, "sigma": 0.5},
+        )
+        try:
+            assert t._link_delay(b, 1000) >= 1.5  # latency + payload/bw
+        finally:
+            t.clear_links()
+
+    def test_jitter_validation(self):
+        t = self._tp()
+        a, b = ("127.0.0.1", 1), ("127.0.0.1", 2)
+        with pytest.raises(ValueError):
+            t.set_link(a, b, jitter={"dist": "cauchy", "scale": 1.0})
+        with pytest.raises(ValueError):
+            t.set_link(a, b, jitter={"dist": "pareto", "scale": 0.0, "alpha": 2})
+        with pytest.raises(ValueError):
+            t.set_link(a, b, jitter={"dist": "lognormal", "scale": 1.0, "sigma": 0})
+
+
+def _import_doctor():
+    import os
+    import sys
+
+    exp = os.path.join(os.path.dirname(os.path.dirname(__file__)), "experiments")
+    if exp not in sys.path:
+        sys.path.insert(0, exp)
+    import doctor_report
+
+    return doctor_report
+
+
+class TestDoctorHedgeDemotion:
+    def _bundle(self, recovered_rounds):
+        events = [
+            {
+                "kind": "mass_lost_at_deadline", "excluded": ["m2"],
+                "aborted": [],
+            }
+            for _ in range(4)
+        ]
+        events += [
+            {
+                "kind": "mass_recovered_by_hedge", "recovered": ["m2"],
+                "recovered_weight": 1.0, "recovered_slots": 1,
+            }
+            for _ in range(recovered_rounds)
+        ]
+        return {"flight": {"leader": events}, "alerts": [], "quality": {}}
+
+    def test_unmitigated_straggler_ranks(self):
+        diagnose = _import_doctor().diagnose
+
+        ranked = diagnose(self._bundle(0))
+        top = [r for r in ranked if r["cause"] == "straggler_deadline_drop"]
+        assert top and top[0]["score"] > 0.3
+        assert not top[0]["evidence"]["hedge_saved_mass"]["mitigated"]
+
+    def test_hedge_saved_mass_demotes(self):
+        diagnose = _import_doctor().diagnose
+
+        base = [
+            r for r in diagnose(self._bundle(0))
+            if r["cause"] == "straggler_deadline_drop"
+        ][0]
+        mitigated = [
+            r for r in diagnose(self._bundle(8))
+            if r["cause"] == "straggler_deadline_drop"
+        ][0]
+        assert mitigated["score"] < base["score"]
+        ev = mitigated["evidence"]["hedge_saved_mass"]
+        assert ev["mitigated"] and ev["recovered_mass_events"] == 8
+        assert "hedge_saved_mass" in mitigated["chain"]
+
+
+class TestWatchdogAnnotation:
+    def test_mass_alert_carries_hedge_recovery(self):
+        from distributedvolunteercomputing_tpu.swarm.watchdog import Watchdog
+
+        wd = Watchdog(enabled=True)
+        det = wd.detectors["mass_frac_drop"]
+        for _ in range(det.warmup + 2):
+            wd.observe("mass_frac_drop", 1.0)
+        for _ in range(4):
+            wd.observe("mass_frac_drop", 0.4)
+        firing = wd.alerts()
+        assert firing and firing[0]["kind"] == "mass_frac_drop"
+        wd.annotate(
+            "mass_frac_drop", "", hedge_recovered_weight=0.5,
+            hedge_recovered_slots=1,
+        )
+        firing = wd.alerts()
+        assert firing[0]["hedge_recovered_weight"] == 0.5
+        assert firing[0]["hedge_recovered_slots"] == 1
+        # Annotating a non-firing alert is a no-op, never a raise.
+        wd.annotate("commit_rate_collapse", "", hedge_recovered_weight=1.0)
