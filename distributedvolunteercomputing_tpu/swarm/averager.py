@@ -208,6 +208,7 @@ class AveragerBase:
         telemetry=None,
         hedge: bool = True,
         tail_redundancy_frac: float = 0.0,
+        controller=None,
     ):
         if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -461,6 +462,25 @@ class AveragerBase:
             self.health.zone_fn = lambda: self.zone
             if self.health.on_flag is None:
                 self.health.on_flag = self._surface_quality_flags
+        # Closed-loop adaptive controller (swarm/controller.py): reads
+        # the telemetry this averager produces and retunes topology /
+        # wire / cadence / per-level deadlines / hedge regime, epoch-
+        # fenced (decisions apply from the NEXT round — _apply_controller
+        # runs before formation). None = every knob stays hand-set (the
+        # --no-adapt contract).
+        self.controller = controller
+        # Bandwidth evidence source for the controller's wire/cadence
+        # gates: the transport's measured per-peer downlink EWMA by
+        # default. Pluggable because the chaos link model shapes WALL
+        # TIME but not measured arrival rates (the documented set_link
+        # fidelity limit) — campaigns and benches inject modeled
+        # advertisements here, the hierarchy_bench extra_info pattern.
+        self.bw_probe = self.transport.peer_bw_down
+        if controller is not None:
+            controller.attach(
+                wire=self.wire, schedule=group_schedule, max_group=max_group,
+            )
+            self.telemetry.registry.source("controller", controller.summary)
 
     def _surface_quality_flags(self, flagged: List[str]) -> None:
         """Carry this vantage's flagged-peer list in the next heartbeat
@@ -485,6 +505,22 @@ class AveragerBase:
         try:
             if quality:
                 h.observe_round_quality(quality, trace=trace)
+                if self.controller is not None and buf is not None:
+                    # Relative contribution dispersion for the cadence
+                    # knob: sqrt(mean per-peer d2) over the aggregate
+                    # norm — the leader-local form of the cross-zone
+                    # sketch-dispersion trend (only cross rounds feed
+                    # the trend; the controller filters by level).
+                    den = float(np.linalg.norm(buf))
+                    if den > 0:
+                        rel = float(
+                            np.sqrt(sum(quality.values()) / len(quality))
+                        ) / den
+                        self.controller.observe_dispersion(
+                            self._last_group.level
+                            if self._last_group is not None else None,
+                            rel,
+                        )
             if mass is not None:
                 h.note_round_mass(mass, trace=trace)
             if buf is not None:
@@ -820,11 +856,15 @@ class AveragerBase:
 
     def _round_budget(self) -> float:
         """Wall-clock budget (seconds) for the NEXT round: the resilience
-        policy's learned deadline when attached, else the static
-        ``round_deadline_s``, else the (possibly EWMA-adapted) gather
-        timeout. The leader stamps ``clock() + budget`` into the begin."""
+        policy's learned deadline when attached — PER HIERARCHY LEVEL,
+        read off the round-in-flight's assignment, so a cross-zone round
+        on a slow WAN runs its own learned budget while intra rounds stay
+        tight — else the static ``round_deadline_s``, else the (possibly
+        EWMA-adapted) gather timeout. The leader stamps ``clock() +
+        budget`` into the begin."""
         if self.resilience is not None:
-            return float(self.resilience.round_budget())
+            level = self._last_group.level if self._last_group is not None else None
+            return float(self.resilience.round_budget(level))
         if self.round_deadline_s:
             return float(self.round_deadline_s)
         return self.effective_gather_timeout
@@ -872,24 +912,120 @@ class AveragerBase:
 
     def _flush_round_outcome(self, duration_s: float, ok: bool) -> None:
         """Report the finished round to the resilience policy (once per
-        average() call; per-peer detail only where this node observed it)."""
-        if self.resilience is None:
-            self._last_outcomes = None
-            return
-        detail = self._last_outcomes or {}
+        average() call; per-peer detail only where this node observed it)
+        and feed the closed-loop controller's evidence stream."""
+        level = self._last_group.level if self._last_group is not None else None
+        if self.resilience is not None:
+            detail = self._last_outcomes or {}
+            self.resilience.record_round(
+                duration_s=duration_s,
+                ok=ok,
+                degraded=self._round_degraded,
+                group_id=(
+                    self._last_group.group_id
+                    if self._last_group is not None else None
+                ),
+                level=level,
+                **detail,
+            )
         self._last_outcomes = None
-        self.resilience.record_round(
-            duration_s=duration_s,
-            ok=ok,
-            degraded=self._round_degraded,
-            group_id=(
-                self._last_group.group_id if self._last_group is not None else None
-            ),
-            level=(
-                self._last_group.level if self._last_group is not None else None
-            ),
-            **detail,
-        )
+        self._feed_controller(level, ok, duration_s)
+
+    def _feed_controller(
+        self, level: Optional[str], ok: bool, duration_s: float
+    ) -> None:
+        """One finished round's evidence for the controller: outcome +
+        push size + the group's slowest measured link (the wire gate's
+        inputs), and — on cross rounds — the per-zone-pair bandwidth
+        floors the cadence knob learns from. Advisory: a controller bug
+        must never fail a round."""
+        c = self.controller
+        if c is None:
+            return
+        try:
+            push_bytes = bw_floor = None
+            if self._specs is not None and self.wire in ("f32", "bf16"):
+                esz = 4 if self.wire == "f32" else 2
+                push_bytes = sum(s.size for s in self._specs) * esz
+            expected = self._last_group_expected
+            bws = [
+                bw for bw in (
+                    self.bw_probe(addr)
+                    for pid, addr in expected if pid != self.peer_id
+                ) if bw
+            ]
+            if bws:
+                bw_floor = min(bws)
+            c.observe_round(
+                level=level, ok=ok, degraded=self._round_degraded,
+                duration_s=duration_s, push_bytes=push_bytes,
+                bw_floor=bw_floor, budget_s=self._round_budget(),
+            )
+            if level == "cross":
+                # Zone-pair evidence: my zone against each other zone in
+                # the MEMBERSHIP view (not just this round's group — the
+                # hashed cross arcs give each vantage a different member
+                # mix per rotation, and pair evidence fed only from group
+                # composition left different volunteers' cadence gates
+                # firing on different rounds, the exact divergence the
+                # shared-evidence design exists to avoid). The pair's
+                # floor is the slowest probed link to that zone.
+                myz = self.zone
+                my_addr = (str(self.transport.addr[0]), int(self.transport.addr[1]))
+                by_zone: Dict[str, list] = {}
+                for addr, z in self.membership.zone_by_addr().items():
+                    if addr == my_addr or z == myz:
+                        continue
+                    by_zone.setdefault(z, []).append(addr)
+                for z, addrs in by_zone.items():
+                    pair = "|".join(sorted((myz, z)))
+                    pbws = [
+                        bw for bw in (self.bw_probe(a) for a in addrs) if bw
+                    ]
+                    c.observe_cross_pair(
+                        pair,
+                        bw_floor=min(pbws) if pbws else None,
+                        ok=ok, degraded=self._round_degraded,
+                    )
+        except Exception as e:  # noqa: BLE001 — controller evidence is advisory
+            log.debug("controller feed failed: %s", errstr(e))
+
+    def _apply_controller(self) -> None:
+        """Promote the controller's fenced decisions and apply them to
+        the knobs this averager owns: schedule geometry (topology),
+        cross-zone cadence, and the dense wire. Called ONCE per
+        average() call, BEFORE rendezvous/formation — the epoch-fence
+        contract: a decision staged during round N takes effect from
+        round N+1 and can never mix two configurations into one round."""
+        c = self.controller
+        if c is None:
+            return
+        try:
+            if not c.advance():
+                return
+            sched = self.group_schedule
+            if sched is not None:
+                ts = c.target_group_size()
+                if ts:
+                    sched.retune(
+                        target_size=min(
+                            max(ts, max(2, self.min_group)), self.max_group
+                        )
+                    )
+                k = c.cross_zone_k()
+                if k:
+                    sched.retune(cross_zone_every_k=k)
+            if c.wire in ("f32", "bf16") and c.wire != self.wire:
+                self.set_wire(c.wire)
+                if self.wire != c.wire:
+                    # set_wire refused (chunk-alignment guard): the
+                    # controller must adopt the ACTUAL wire or its gate
+                    # evidence (push bytes at the wrong element size)
+                    # and every future flip decision desync from
+                    # reality.
+                    c.wire = self.wire
+        except Exception as e:  # noqa: BLE001 — a controller bug must not kill rounds
+            log.warning("controller apply failed: %s", errstr(e))
 
     # -- leader failover bookkeeping ---------------------------------------
 
@@ -966,24 +1102,62 @@ class AveragerBase:
         buf, specs, treedef = flatten_to_buffer(tree)
         if self._schema is None:
             self._specs, self._treedef = specs, treedef
-            # The namespace is part of the schema hash: a params tree and a
-            # grads tree of the same model flatten to IDENTICAL shapes, so
-            # shapes+dtypes+wire alone can't stop a cross-mode payload from
-            # being accepted on the receive path (e.g. a gossip push banked
-            # into the wrong inbox). With the namespace folded in, every
-            # averager's _check_schema rejects it at the door.
-            wire_tag = self.wire
-            if self.wire == "topk":
-                wire_tag = f"topk:{self.topk_frac}"
-            elif self.wire == "powersgd":
-                wire_tag = f"powersgd:{self.powersgd_rank}"
-            self._schema = hashlib.sha1(
-                repr(
-                    [(s.shape, s.dtype) for s in specs] + [wire_tag, self.namespace]
-                ).encode()
-            ).hexdigest()[:16]
+            self._schema = self._compute_schema()
         self._apply_pending_wire_state()
         return buf
+
+    def _compute_schema(self) -> str:
+        """Schema hash over (specs, wire, namespace) — ``self._specs``
+        must exist. The namespace is part of the hash: a params tree and
+        a grads tree of the same model flatten to IDENTICAL shapes, so
+        shapes+dtypes+wire alone can't stop a cross-mode payload from
+        being accepted on the receive path (e.g. a gossip push banked
+        into the wrong inbox). With the namespace folded in, every
+        averager's _check_schema rejects it at the door. The wire is in
+        the hash too, which is what makes a controller wire flip safe by
+        construction: a peer still on the old wire pushes under the old
+        schema and is REJECTED (one excluded contribution), never
+        mis-decoded."""
+        wire_tag = self.wire
+        if self.wire == "topk":
+            wire_tag = f"topk:{self.topk_frac}"
+        elif self.wire == "powersgd":
+            wire_tag = f"powersgd:{self.powersgd_rank}"
+        return hashlib.sha1(
+            repr(
+                [(s.shape, s.dtype) for s in self._specs]
+                + [wire_tag, self.namespace]
+            ).encode()
+        ).hexdigest()[:16]
+
+    def set_wire(self, wire: str) -> None:
+        """Adopt a controller-selected DENSE wire (f32 <-> bf16), between
+        rounds only (the controller's epoch fence guarantees the call
+        site). Restricted to the dense elementwise pair: they share tile
+        geometry and carry no compressor state, so the flip re-keys the
+        schema hash and changes nothing else. Compressed wires (topk /
+        powersgd / sign) carry error-feedback and warm factors whose
+        churn would cost real gradient mass — those stay construction-
+        time choices (the controller only RANKS them)."""
+        if wire == self.wire:
+            return
+        if wire not in ("f32", "bf16") or self.wire not in ("f32", "bf16"):
+            raise ValueError(
+                f"live wire switch only supports f32<->bf16, "
+                f"got {self.wire!r} -> {wire!r}"
+            )
+        esz = 4 if wire == "f32" else 2
+        if self.transport.chunk_bytes % esz:
+            log.warning(
+                "wire switch to %s refused: chunk_bytes %d not divisible "
+                "by element size %d", wire, self.transport.chunk_bytes, esz,
+            )
+            return
+        old = self.wire
+        self.wire = wire
+        if self._specs is not None:
+            self._schema = self._compute_schema()
+        log.info("wire: %s -> %s (schema re-keyed)", old, wire)
 
     def _unpack(self, buf: np.ndarray) -> Any:
         return unflatten_from_buffer(buf, self._specs, self._treedef)
@@ -1212,6 +1386,21 @@ class AveragerBase:
                 return
             if self.wire == "f32":
                 h.note_codec_error("f32", 0.0)
+                if self.controller is not None:
+                    # Prospective bf16 sample: the controller's f32->bf16
+                    # flip is gated on MEASURED bf16 distortion, which a
+                    # fleet running f32 would otherwise never produce
+                    # (the gauge only samples the active wire). One
+                    # 64Ki-slice round-trip per round is the cheap probe
+                    # that keeps the flip reachable.
+                    p = buf[: min(buf.size, 65_536)]
+                    mc = self.mesh_codec
+                    prt = mc.decode_bf16(mc.encode_bf16(p))
+                    pden = float(np.linalg.norm(p))
+                    h.note_codec_error(
+                        "bf16",
+                        float(np.linalg.norm(prt - p)) / pden if pden > 0 else 0.0,
+                    )
                 return
             s = buf[: min(buf.size, 65_536)]
             if self.wire == "bf16":
@@ -1517,6 +1706,8 @@ class AveragerBase:
             out["groups"] = self.group_stats()
         if self.resilience is not None:
             out["resilience"] = self.resilience.stats()
+        if self.controller is not None:
+            out["controller"] = self.controller.summary()
         # Control-plane accounting: messages this node spends per heartbeat
         # interval (the batching headline metric) plus the failover
         # client's replica view — proves the batched path is actually in
@@ -2495,6 +2686,10 @@ class SyncAverager(AveragerBase):
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
+        # Fenced controller decisions apply HERE — before this round's
+        # rendezvous — so a mid-round regime shift can never mix two
+        # configurations into one round (the epoch-fence contract).
+        self._apply_controller()
         await self._maybe_backoff()
         tele = self.telemetry
         # Round-trace bookkeeping: the JOIN phase (rendezvous + formation)
@@ -4017,6 +4212,10 @@ class ByzantineAverager(AveragerBase):
 
     async def average(self, tree: Any, round_no: int, weight: float = 1.0) -> Optional[Any]:
         self._sweep_rounds(self._rounds)
+        # Same fencing contract as the sync path: staged controller
+        # decisions (regime -> hedge floor, wire, cadence when a schedule
+        # is attached) promote HERE, before this round's rendezvous.
+        self._apply_controller()
         await self._maybe_backoff()
         round_key = await self._rendezvous()
         group = await self._form_group(round_key)

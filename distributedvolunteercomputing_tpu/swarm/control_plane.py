@@ -63,6 +63,7 @@ from distributedvolunteercomputing_tpu.swarm.dht import (
     key_id,
 )
 from distributedvolunteercomputing_tpu.swarm.membership import PEERS_KEY
+from distributedvolunteercomputing_tpu.swarm import controller as controller_mod
 from distributedvolunteercomputing_tpu.swarm import health as health_mod
 from distributedvolunteercomputing_tpu.swarm import telemetry as telemetry_mod
 from distributedvolunteercomputing_tpu.swarm import watchdog as watchdog_mod
@@ -921,6 +922,16 @@ class ControlPlaneReplica:
             # scores, the flagged-peer union, and per-wire codec
             # distortion. Pinned by health.STATUS_HEALTH_SCHEMA.
             "health": self._stamp_age(health_roll, fresh, now),
+            # Closed-loop controller rollup (versioned; None until some
+            # volunteer reports a controller summary — a --no-adapt
+            # fleet serves no section at all): worst regime per level,
+            # topology/wire census, the tightest per-zone-pair cadence,
+            # max learned deadline per level, transition totals + the
+            # freshest transition with its reason. Pinned by
+            # controller.STATUS_CONTROLLER_SCHEMA.
+            "controller": self._stamp_age(
+                controller_mod.rollup_status(fresh), fresh, now
+            ),
             # Watchdog plane (versioned, ALWAYS dicts — the plane exists
             # the moment a replica does): declarative objectives with
             # fast/slow burn rates, and the swarm-wide firing-alert rollup

@@ -148,6 +148,37 @@ class GroupSchedule:
     def rotation(self) -> int:
         return int(self.clock() // self.rotation_s)
 
+    def retune(
+        self,
+        target_size: Optional[int] = None,
+        cross_zone_every_k: Optional[int] = None,
+    ) -> None:
+        """Live re-tune by the closed-loop controller (swarm/controller.py):
+        group geometry (the topology knob — sync-group / butterfly /
+        gossip map onto target sizes) and the cross-zone cadence (the
+        learned k replacing the static flag). Validated like the ctor.
+
+        Consistency note: the schedule is LOCAL — every volunteer
+        computes its own split — so a retune takes effect at this
+        volunteer's next ``assign`` and peers whose controllers have not
+        (yet) made the same decision compute a different split for one or
+        more rotations. That divergence is the schedule's documented
+        degradation class: an underfilled rendezvous or a skipped round,
+        never mixed tensors (the epoch hash covers the frozen member
+        list). Hysteresis + shared evidence converge the fleet; the
+        chaos_adaptive campaign measures the cost."""
+        if target_size is not None:
+            if target_size < 2:
+                raise ValueError(f"target_size must be >= 2, got {target_size}")
+            self.target_size = int(target_size)
+        if cross_zone_every_k is not None:
+            if cross_zone_every_k < 0:
+                raise ValueError(
+                    f"cross_zone_every_k must be >= 0 (0 = flat), got "
+                    f"{cross_zone_every_k}"
+                )
+            self.cross_zone_every_k = int(cross_zone_every_k)
+
     def level_of(self, rot: int, zones_by_peer: Optional[Dict[str, str]] = None) -> str:
         """Hierarchy level rotation ``rot`` schedules at, given the zone
         advertisements in view ("flat" when the hierarchy is off or fewer

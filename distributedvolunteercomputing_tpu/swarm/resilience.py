@@ -3,13 +3,20 @@
 One object per volunteer that watches how rounds actually go and adjusts
 the knobs the averaging tier runs on, instead of static configuration:
 
-- **round deadline** (``round_budget()``): the wall-clock budget a round is
-  allowed before it commits with partial participation. Learned from
-  COMPLETE rounds' durations (EWMA + 4 deviations, the classic adaptive-RTO
-  shape) and AIMD-backed-off on failures — a healthy swarm converges to
-  tight deadlines where a stalled peer costs little; a genuinely slow
-  network ratchets the budget back toward the configured ceiling instead
-  of failing forever.
+- **round deadline** (``round_budget(level)``): the wall-clock budget a
+  round is allowed before it commits with partial participation. Learned
+  from COMPLETE rounds' durations (EWMA + 4 deviations, the classic
+  adaptive-RTO shape) and AIMD-backed-off on failures — a healthy swarm
+  converges to tight deadlines where a stalled peer costs little; a
+  genuinely slow network ratchets the budget back toward the configured
+  ceiling instead of failing forever. The AIMD state is split PER
+  HIERARCHY LEVEL (flat / intra / cross): intra-zone rounds run on fat
+  local links and cross-zone rounds on thin WAN links BY DESIGN, so one
+  shared estimate would either starve cross rounds or slacken intra ones.
+  A level's estimator seeds from the flat record's current operating
+  point the first time that level runs, then diverges on its own
+  evidence; ``round_budget()`` with no level keeps the pre-split
+  behavior (the flat record) for every existing caller.
 - **retry backoff** (``backoff_s()``): consecutive failed rounds back off
   exponentially (capped), so a partitioned volunteer stops hammering
   matchmaking at full cadence and re-probes on a widening schedule.
@@ -109,10 +116,10 @@ class ResiliencePolicy:
             raise ValueError(f"decay must be in (0, 1], got {decay}")
         self.max_deadline_s = float(max_deadline_s)
         self.min_deadline_s = float(min_deadline_s)
-        self._deadline = float(
+        init_deadline = float(
             max_deadline_s if initial_deadline_s is None else initial_deadline_s
         )
-        self._deadline = min(max(self._deadline, min_deadline_s), max_deadline_s)
+        init_deadline = min(max(init_deadline, min_deadline_s), max_deadline_s)
         self.decay = float(decay)
         self.preexclude_misses = int(preexclude_misses)
         self.escalate_rejections = float(escalate_rejections)
@@ -125,9 +132,21 @@ class ResiliencePolicy:
         # supplied; None = transitions are logged only.
         self.recorder = recorder
         self.peers: Dict[str, PeerOutcomes] = {}
-        # Adaptive-deadline estimate over COMPLETE (non-degraded) rounds.
-        self._rt_ewma: Optional[float] = None
-        self._rt_ewdev = 0.0
+        # Adaptive-deadline estimate over COMPLETE (non-degraded) rounds,
+        # PER HIERARCHY LEVEL. "flat" is the default record every
+        # level-less caller reads and writes — byte-identical to the
+        # pre-split single estimator; "intra"/"cross" records are created
+        # on first use, seeded from flat's current deadline so a level
+        # starts at the shared operating point and then diverges on its
+        # own evidence (the ISSUE-15 acceptance: cross > intra on a
+        # two-zone swarm with a slow WAN).
+        self._deadline_levels: Dict[str, dict] = {
+            "flat": {
+                "deadline": init_deadline,
+                "rt_ewma": None,
+                "rt_ewdev": 0.0,
+            }
+        }
         self._consecutive_failures = 0
         self.rounds_seen = 0
         self.rounds_degraded = 0
@@ -158,6 +177,15 @@ class ResiliencePolicy:
         # intra / cross — cross-zone rounds hedge on slow links by design,
         # so one shared operating point would be wrong for both).
         self._hedge_levels: Dict[str, dict] = {}
+        # Per-level regime stamped by the closed-loop controller
+        # (swarm/controller.py): "calm" | "churn" | "degraded". Folds the
+        # hedge budget into the controller's shared regime model — under
+        # churn the hedger's own AIMD would need several lossy rounds to
+        # re-open a budget the regime change already predicts, so
+        # hedge_params() floors the operating point instead of waiting
+        # for the loss evidence. Empty (every level "calm") without a
+        # controller: the PR-13 behavior, unchanged.
+        self._hedge_regime: Dict[str, str] = {}
         # One slow round must count ONCE: a peer whose push lands after the
         # commit is seen twice (absent in the commit batch, late on the RPC
         # path), in either order. These two sets reconcile the duplicate —
@@ -168,11 +196,40 @@ class ResiliencePolicy:
         self._last_absent: set = set()
         self._late_noted: set = set()
 
-    # -- deadline ----------------------------------------------------------
+    # -- deadline (per hierarchy level) ------------------------------------
 
-    def round_budget(self) -> float:
-        """Wall-clock budget for the NEXT round, in seconds."""
-        return self._deadline
+    @property
+    def _deadline(self) -> float:
+        """The flat record's deadline — the pre-split scalar every legacy
+        reader (group gauges, stats headline) still sees."""
+        return self._deadline_levels["flat"]["deadline"]
+
+    def _dl_rec(self, level: Optional[str]) -> dict:
+        lv = level or "flat"
+        rec = self._deadline_levels.get(lv)
+        if rec is None:
+            # Seed a new level at the FLAT record's current operating
+            # point: a cross-zone round's first deadline should start
+            # where the swarm already learned to run, not back at the
+            # ceiling — then diverge on its own durations/failures.
+            rec = self._deadline_levels[lv] = {
+                "deadline": self._deadline_levels["flat"]["deadline"],
+                "rt_ewma": None,
+                "rt_ewdev": 0.0,
+            }
+        return rec
+
+    def round_budget(self, level: Optional[str] = None) -> float:
+        """Wall-clock budget for the NEXT round at ``level`` (flat when
+        None — the pre-split behavior), in seconds."""
+        return self._dl_rec(level)["deadline"]
+
+    def deadlines(self) -> Dict[str, float]:
+        """Current learned deadline per hierarchy level (stats/status)."""
+        return {
+            lv: round(rec["deadline"], 3)
+            for lv, rec in self._deadline_levels.items()
+        }
 
     def backoff_s(self) -> float:
         """Extra wait before retrying after failed rounds (0 when healthy)."""
@@ -181,27 +238,31 @@ class ResiliencePolicy:
             return 0.0
         return float(min(0.5 * (2.0 ** (k - 1)), 30.0))
 
-    def _observe_duration(self, dt: float) -> None:
-        if self._rt_ewma is None:
-            self._rt_ewma, self._rt_ewdev = dt, dt / 2.0
+    def _observe_duration(self, dt: float, level: Optional[str] = None) -> None:
+        rec = self._dl_rec(level)
+        if rec["rt_ewma"] is None:
+            rec["rt_ewma"], rec["rt_ewdev"] = dt, dt / 2.0
         else:
-            self._rt_ewdev += 0.25 * (abs(dt - self._rt_ewma) - self._rt_ewdev)
-            self._rt_ewma += 0.25 * (dt - self._rt_ewma)
-        est = self._rt_ewma + 4.0 * self._rt_ewdev + 0.5
+            rec["rt_ewdev"] += 0.25 * (abs(dt - rec["rt_ewma"]) - rec["rt_ewdev"])
+            rec["rt_ewma"] += 0.25 * (dt - rec["rt_ewma"])
+        est = rec["rt_ewma"] + 4.0 * rec["rt_ewdev"] + 0.5
         # Multiplicative decrease TOWARD the estimate (never jumping below
         # it): one fast outlier round must not slam the deadline down onto
         # the next round's normal tail.
         target = min(max(est, self.min_deadline_s), self.max_deadline_s)
-        if target < self._deadline:
-            self._deadline = max(0.7 * self._deadline + 0.3 * target, target)
+        if target < rec["deadline"]:
+            rec["deadline"] = max(0.7 * rec["deadline"] + 0.3 * target, target)
         else:
-            self._deadline = target
+            rec["deadline"] = target
 
-    def _observe_failure(self) -> None:
+    def _observe_failure(self, level: Optional[str] = None) -> None:
         # AIMD: a failed round doubles the budget toward the ceiling — a
         # genuinely slow network recovers instead of timing out forever.
-        self._deadline = min(self._deadline * 2.0, self.max_deadline_s)
-        self._rt_ewma = None  # re-learn at the new regime
+        # Only the failing LEVEL pays: a partitioned WAN must not slacken
+        # the intra-zone deadline that is still committing fine.
+        rec = self._dl_rec(level)
+        rec["deadline"] = min(rec["deadline"] * 2.0, self.max_deadline_s)
+        rec["rt_ewma"] = None  # re-learn at the new regime
 
     # -- outcomes ----------------------------------------------------------
 
@@ -254,6 +315,10 @@ class ResiliencePolicy:
         rec["ok"] += int(ok)
         rec["degraded"] += int(degraded)
         rec["last_dt_s"] = round(duration_s, 3)
+        # The level's LEARNED deadline rides its round record so stats()
+        # (and coord.status) show the per-level split next to the
+        # outcomes that drove it.
+        rec["deadline_s"] = round(self._dl_rec(level)["deadline"], 3)
 
     def record_round(
         self,
@@ -313,10 +378,10 @@ class ResiliencePolicy:
             if degraded:
                 self.rounds_degraded += 1
             else:
-                self._observe_duration(duration_s)
+                self._observe_duration(duration_s, level)
         else:
             self._consecutive_failures += 1
-            self._observe_failure()
+            self._observe_failure(level)
         self._maybe_escalate()
 
     def record_late_arrival(self, peer: str) -> None:
@@ -399,12 +464,34 @@ class ResiliencePolicy:
             }
         return rec
 
+    def set_regime(self, level: Optional[str], regime: str) -> None:
+        """Adopt the controller's regime verdict for ``level`` (one shared
+        model for topology/wire/hedge instead of three AIMD loops fighting
+        each other). Unknown regimes are treated as "calm"."""
+        self._hedge_regime[level or "flat"] = str(regime)
+
     def hedge_params(self, level: Optional[str] = None) -> Tuple[float, int]:
         """(soft_deadline_frac, max_inflight_hedges) for the NEXT round at
         ``level``: wait soft_frac x the round budget before the first
-        hedged re-request, and keep at most max_inflight in flight."""
+        hedged re-request, and keep at most max_inflight in flight.
+
+        The controller's regime (``set_regime``) floors the learned
+        operating point: under "churn" the soft deadline is pulled to at
+        most 0.5x the budget with >= 2 hedges allowed, under "degraded"
+        to 0.4x with >= 3 — the AIMD state itself is untouched, so when
+        the regime clears the learned point resumes exactly where the
+        loss evidence left it."""
         rec = self._hedge_rec(level)
-        return float(rec["soft_frac"]), max(1, int(round(rec["max_inflight"])))
+        soft = float(rec["soft_frac"])
+        inflight = max(1, int(round(rec["max_inflight"])))
+        regime = self._hedge_regime.get(level or "flat", "calm")
+        if regime == "churn":
+            soft = min(soft, 0.5)
+            inflight = max(inflight, 2)
+        elif regime == "degraded":
+            soft = min(soft, 0.4)
+            inflight = max(inflight, 3)
+        return soft, inflight
 
     def record_hedge_outcome(
         self,
@@ -509,6 +596,9 @@ class ResiliencePolicy:
     def stats(self) -> dict:
         out = {
             "deadline_s": round(self._deadline, 3),
+            # The per-level deadline split (ISSUE 15): flat always
+            # present; intra/cross appear once those levels have run.
+            "deadlines": self.deadlines(),
             "rounds_seen": self.rounds_seen,
             "rounds_degraded": self.rounds_degraded,
             "leaders_deposed": self.leaders_deposed,
@@ -527,6 +617,7 @@ class ResiliencePolicy:
                 lv: {
                     "soft_frac": round(rec["soft_frac"], 3),
                     "max_inflight": max(1, int(round(rec["max_inflight"]))),
+                    "regime": self._hedge_regime.get(lv, "calm"),
                     "rounds": rec["rounds"],
                     "issued": rec["issued"],
                     "tiles_recovered": rec["tiles_recovered"],

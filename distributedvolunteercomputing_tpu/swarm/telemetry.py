@@ -100,6 +100,10 @@ KIND_SEVERITY: Dict[str, str] = {
     # mass_lost_at_deadline.
     "hedge_issued": "info",
     "mass_recovered_by_hedge": "info",
+    # Closed-loop controller: an applied policy transition is an
+    # INTENTIONAL retune (context for the anomaly it pre-empts or
+    # explains, not itself an anomaly).
+    "policy_changed": "info",
     "alert_raised": "page",
     "alert_cleared": "info",
 }

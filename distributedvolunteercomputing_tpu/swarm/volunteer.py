@@ -158,6 +158,14 @@ class VolunteerConfig:
     # phi at/above which a peer counts as suspected (8 ~ one-in-1e8 under
     # the fitted heartbeat model — the classic accrual-detector default).
     phi_threshold: float = 8.0
+    # Closed-loop adaptive controller (swarm/controller.py): reads the
+    # telemetry plane and retunes, live and epoch-fenced, the averaging
+    # topology / dense wire / cross-zone cadence / per-level deadlines /
+    # hedge regime. Rides the resilience layer (needs its policy and
+    # evidence), so it engages only with --resilience; --no-adapt pins
+    # today's static behavior end-to-end — no controller is constructed
+    # and no controller bytes ride the report beat.
+    adapt: bool = True
     # Static wall-clock budget per averaging round, seconds (0 = use the
     # gather timeout; the resilience policy, when on, supersedes both with
     # its learned deadline). The leader stamps clock()+budget into the
@@ -498,6 +506,7 @@ class Volunteer:
         self.clocksync = None
         self.failure_detector = None
         self.resilience_policy = None
+        self.controller = None
         self.averager = None
         self.state_sync: Optional[StateSyncService] = None
         self.trainer: Optional[Trainer] = None
@@ -600,36 +609,7 @@ class Volunteer:
                 self.transport, self.dht, telemetry=self.telemetry
             )
             await self.replica.start()
-        if self.cfg.resilience:
-            # Resilience layer: phi-accrual liveness fed by membership
-            # heartbeats, and the adaptive policy (learned round deadlines,
-            # failure backoff, estimator escalation) the averager and
-            # matchmaker consult. Constructed BEFORE membership so the very
-            # first observed peer records start the heartbeat distributions.
-            from distributedvolunteercomputing_tpu.swarm.failure_detector import (
-                PhiAccrualDetector,
-            )
-            from distributedvolunteercomputing_tpu.swarm.resilience import (
-                ResiliencePolicy,
-            )
-
-            self.failure_detector = PhiAccrualDetector(
-                threshold=self.cfg.phi_threshold,
-                # Heartbeats arrive at the announce cadence (ttl/3, see
-                # SwarmMembership.join): seed the bootstrap gap there so a
-                # peer heard from once accrues suspicion on the right scale.
-                bootstrap_s=max(self.cfg.heartbeat_ttl / 3.0, 1.0),
-            )
-            self.resilience_policy = ResiliencePolicy(
-                max_deadline_s=self.cfg.gather_timeout,
-                # A tight-LAN --gather-timeout below the stock 2s deadline
-                # floor must not trip the ctor's range check at startup.
-                min_deadline_s=min(2.0, float(self.cfg.gather_timeout)),
-                initial_deadline_s=self.cfg.round_deadline_s or None,
-                failure_detector=self.failure_detector,
-                # Escalation/backoff transitions land in the flight recorder.
-                recorder=self.telemetry.recorder,
-            )
+        self._build_resilience_layer()
         extra_info = {
             "model": self.cfg.model,
             # Full averaging namespace (model/average_what): gossip picks
@@ -698,6 +678,10 @@ class Volunteer:
                 round_deadline_s=self.cfg.round_deadline_s or None,
                 resilience=self.resilience_policy,
                 failure_detector=self.failure_detector,
+                # Closed-loop controller (None under --no-adapt / without
+                # --resilience): the averager is both its evidence feed
+                # and its actuator.
+                controller=self.controller,
                 # Matchmaking rendezvous reads ride the replicated control
                 # plane's micro-cache when a replica answers (direct DHT
                 # fallback otherwise).
@@ -961,6 +945,54 @@ class Volunteer:
             self.cfg.peer_id, *self.transport.addr, self.cfg.model, self.cfg.averaging,
         )
 
+    def _build_resilience_layer(self) -> None:
+        """Construct the resilience layer (phi detector + adaptive policy)
+        and, with ``adapt`` on, the closed-loop controller over it.
+        Synchronous and side-effect-free beyond the three attributes, so
+        the --no-adapt plumbing tests can exercise it without a full
+        start(). No-op without --resilience. Called from start() BEFORE
+        membership so the very first observed peer records start the
+        heartbeat distributions."""
+        if not self.cfg.resilience:
+            return
+        from distributedvolunteercomputing_tpu.swarm.failure_detector import (
+            PhiAccrualDetector,
+        )
+        from distributedvolunteercomputing_tpu.swarm.resilience import (
+            ResiliencePolicy,
+        )
+
+        self.failure_detector = PhiAccrualDetector(
+            threshold=self.cfg.phi_threshold,
+            # Heartbeats arrive at the announce cadence (ttl/3, see
+            # SwarmMembership.join): seed the bootstrap gap there so a
+            # peer heard from once accrues suspicion on the right scale.
+            bootstrap_s=max(self.cfg.heartbeat_ttl / 3.0, 1.0),
+        )
+        self.resilience_policy = ResiliencePolicy(
+            max_deadline_s=self.cfg.gather_timeout,
+            # A tight-LAN --gather-timeout below the stock 2s deadline
+            # floor must not trip the ctor's range check at startup.
+            min_deadline_s=min(2.0, float(self.cfg.gather_timeout)),
+            initial_deadline_s=self.cfg.round_deadline_s or None,
+            failure_detector=self.failure_detector,
+            # Escalation/backoff transitions land in the flight recorder.
+            recorder=self.telemetry.recorder,
+        )
+        if self.cfg.adapt and self.cfg.averaging in ("sync", "byzantine"):
+            # Closed-loop controller over the policy + telemetry: the
+            # averager feeds it evidence and applies its epoch-fenced
+            # decisions. Round-structured gather modes only — gossip has
+            # no rounds to fence a decision against.
+            from distributedvolunteercomputing_tpu.swarm.controller import (
+                SwarmController,
+            )
+
+            self.controller = SwarmController(
+                policy=self.resilience_policy,
+                telemetry=self.telemetry,
+            )
+
     def _build_report(self) -> dict:
         """This volunteer's metrics report (the coord.report payload).
         Piggybacked on every batched control-plane exchange by the
@@ -1005,6 +1037,14 @@ class Volunteer:
             summary = wd.summary()
             if summary is not None:
                 report["watchdog"] = summary
+        if self.controller is not None:
+            # Closed-loop controller rollup (current policy per level /
+            # zone-pair, last transition + reason, transitions/hour):
+            # rides the batched beat; replicas roll it into
+            # coord.status["controller"]. Absent entirely — no controller
+            # bytes on the heartbeat — under --no-adapt (the
+            # --no-health-probe pattern).
+            report["controller"] = self.controller.summary()
         health = self.telemetry.health.summary()
         if health is not None:
             # Training-health summary (post-round parameter sketch, mass

@@ -274,7 +274,82 @@ def _rule_control_plane_outage(bundle: dict) -> Optional[dict]:
     }
 
 
+def _rule_policy_flap(bundle: dict) -> Optional[dict]:
+    """A controller knob OSCILLATING — revisiting a value it just left —
+    while wall/commit anomalies fire -> the controller itself is the
+    root cause, and the wall/commit symptoms are downstream of it. Ranked
+    ABOVE the symptom rules by construction: when a knob demonstrably
+    flapped, chasing the straggler/thin-link it manufactured wastes the
+    operator's time. A healthy controller (monotone transitions tracking
+    a real regime change) scores ~0 here: transitions alone are not
+    flapping — only value REVISITS within the window are."""
+    changes = _events_of(bundle, "policy_changed")
+    if len(changes) < 3:
+        return None
+    # Group by (peer, knob, key) and count A->B->A-style revisits. The
+    # PEER is part of the group: every volunteer runs its own
+    # controller, so three vantages each walking a knob MONOTONICALLY
+    # through the same values (2->4->8 on three recorders) is a healthy
+    # fleet converging, not a flap — only one controller revisiting a
+    # value it already left is.
+    by_knob: Dict[tuple, List[dict]] = {}
+    for e in changes:
+        by_knob.setdefault(
+            (
+                str(e.get("peer") or ""),
+                str(e.get("knob")),
+                str(e.get("key") or ""),
+            ),
+            [],
+        ).append(e)
+    flaps = 0
+    worst_knob, worst_n = None, 0
+    for knob, evs in by_knob.items():
+        # A revisit = returning to a value this controller already LEFT:
+        # event i's target appeared as some EARLIER event's old value.
+        # The prefix matters — in a monotone walk 2->4->8 the "4" is
+        # both a target and (later) an old value, and comparing against
+        # the whole from-set would count it; against the prefix it is
+        # a plain forward step.
+        revisits = sum(
+            1
+            for i, e in enumerate(evs)
+            if str(e.get("to")) in {str(p.get("from")) for p in evs[:i]}
+        )
+        if revisits > worst_n:
+            worst_knob, worst_n = knob, revisits
+        flaps += revisits
+    if not flaps:
+        return None
+    wall = _alerts_of(bundle, "round_wall_inflation")
+    rate = _alerts_of(bundle, "commit_rate_collapse")
+    # Saturates fast and carries a symptom bonus, so a demonstrated
+    # oscillation out-ranks the symptom rules it explains.
+    score = 0.7 * _sat(flaps, 3) + 0.4 * _sat(len(wall) + len(rate), 1)
+    peer, knob_name, key = worst_knob
+    label = f"{knob_name}[{key or '-'}]@{peer or '?'}"
+    chain = (
+        f"controller knob {label} revisited values {worst_n}x "
+        f"({len(changes)} transitions) -> unstable policy "
+        f"-> wall/commit anomalies"
+    )
+    return {
+        "cause": "policy_flap",
+        "score": round(min(score, 1.0), 4),
+        "peers": [peer] if peer else [],
+        "chain": chain,
+        "evidence": {
+            "policy_changed_events": len(changes),
+            "value_revisits": flaps,
+            "worst_knob": label,
+            "round_wall_alerts": len(wall),
+            "commit_rate_alerts": len(rate),
+        },
+    }
+
+
 RULES = (
+    _rule_policy_flap,
     _rule_leader_crash_storm,
     _rule_straggler,
     _rule_thin_cross_zone_link,

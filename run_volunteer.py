@@ -265,6 +265,15 @@ def main() -> None:
                          "backs off retries after failures, and escalates "
                          "the robust estimator on rejection evidence "
                          "(docs/RESILIENCE.md)")
+    ap.add_argument("--no-adapt", action="store_true",
+                    help="disable the closed-loop adaptive controller "
+                         "(swarm/controller.py): topology, dense-wire, "
+                         "cross-zone-cadence, per-level-deadline, and "
+                         "hedge-regime decisions stay at their configured "
+                         "static values end-to-end, and no controller "
+                         "section rides the report beat. Only meaningful "
+                         "with --resilience (the controller rides its "
+                         "policy engine)")
     ap.add_argument("--phi-threshold", type=float, default=8.0,
                     help="suspicion threshold for the phi-accrual detector "
                          "(8 ~ one-in-1e8 false-positive odds under the "
@@ -358,6 +367,7 @@ def main() -> None:
         gather_timeout=args.gather_timeout,
         adaptive_timeout=args.adaptive_timeout,
         resilience=args.resilience,
+        adapt=not args.no_adapt,
         phi_threshold=args.phi_threshold,
         round_deadline_s=args.round_deadline_s,
         outer_optimizer=args.outer_optimizer,

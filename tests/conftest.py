@@ -113,6 +113,17 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "controller: closed-loop adaptive-controller tests (decision "
+        "hysteresis property tests — noisy in-band series produce zero "
+        "transitions, a step change exactly one per knob — epoch-fence "
+        "application, per-level deadline divergence, regime-folded hedge "
+        "budget, dense-wire selection + schema re-key, cadence learning, "
+        "coord.status controller schema walk, --no-adapt end-to-end "
+        "plumbing, controller overhead smoke) — in the default lane, and "
+        "selectable on their own with -m controller",
+    )
+    config.addinivalue_line(
+        "markers",
         "watchdog: swarm-watchdog tests (online baselines + anomaly "
         "detectors with hysteresis/cooldown, SLO burn-rate windows, "
         "alert lifecycle + flight severity, incremental flight cursor, "

@@ -1,0 +1,816 @@
+"""Closed-loop adaptive-controller tests (ISSUE 15): decision hysteresis
+property tests (noisy in-band evidence produces ZERO transitions, a step
+change exactly one per knob), the epoch fence (a decision staged during a
+round never applies to the round in flight), the per-level deadline
+split, the regime-folded hedge budget, dense-wire selection + schema
+re-key, per-zone-pair cadence learning, watchdog annotation of
+intentional transitions, the policy_flap doctor rule, the pinned
+coord.status controller schema, --no-adapt end-to-end plumbing, and the
+controller overhead smoke.
+
+In-process swarms over real localhost TCP (the test_telemetry.py harness
+shape); the multi-scenario adaptive-vs-fixed matrix is exercised by
+experiments/chaos_soak.py --adaptive.
+"""
+
+import asyncio
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm import controller as C
+from distributedvolunteercomputing_tpu.swarm import telemetry as T
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+from distributedvolunteercomputing_tpu.swarm.control_plane import (
+    ControlPlaneClient,
+    ControlPlaneReplica,
+)
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.matchmaking import GroupSchedule
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.resilience import ResiliencePolicy
+from distributedvolunteercomputing_tpu.swarm.transport import Transport
+
+pytestmark = pytest.mark.controller
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def make_tree(value: float, elems: int = 4096):
+    return {"w": np.full((elems,), value, np.float32)}
+
+
+def make_controller(**kw):
+    policy = kw.pop("policy", None) or ResiliencePolicy(max_deadline_s=10.0)
+    tele = kw.pop("telemetry", None) or T.Telemetry(peer_id="c0")
+    c = C.SwarmController(policy=policy, telemetry=tele, **kw)
+    return c, policy, tele
+
+
+def feed_rounds(c, outcomes, level="flat", advance=True, **evidence):
+    """Drive the averager's call order: advance() (round start), then
+    observe_round (round end) per outcome."""
+    for ok in outcomes:
+        if advance:
+            c.advance()
+        c.observe_round(level=level, ok=bool(ok), duration_s=1.0, **evidence)
+
+
+# -- evidence gate -----------------------------------------------------------
+
+
+class TestEvidenceGate:
+    def test_fire_needs_consecutive_breaches(self):
+        g = C.EvidenceGate(0.5, 0.2, min_breaches=2)
+        assert not g.observe(0.9)
+        assert not g.observe(0.1)  # breach streak broken
+        assert not g.observe(0.9)
+        assert g.observe(0.9)
+
+    def test_between_bands_changes_nothing(self):
+        g = C.EvidenceGate(0.5, 0.2)
+        for _ in range(50):
+            assert not g.observe(0.35)  # between clear and fire
+        g.observe(0.9)
+        g.observe(0.9)
+        assert g.firing
+        for _ in range(50):
+            assert g.observe(0.35)  # still firing: in-between never clears
+
+    def test_low_direction(self):
+        g = C.EvidenceGate(100.0, 400.0, low=True)
+        assert not g.observe(50.0)
+        assert g.observe(50.0)
+        assert g.observe(200.0)  # above fire, below clear: still firing
+        g.observe(500.0)
+        assert not g.observe(500.0)
+
+
+# -- decision hysteresis (ISSUE-15 property test) ----------------------------
+
+
+class TestDecisionHysteresis:
+    def test_noisy_in_band_stream_zero_transitions(self):
+        """A noisy evidence stream oscillating INSIDE the clear band must
+        produce ZERO transitions on every knob — the no-flap property."""
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=GroupSchedule(
+            target_size=4, cross_zone_every_k=4), max_group=8)
+        rng = np.random.default_rng(0)
+        tele.health.note_codec_error("bf16", 1e-3)
+        for _ in range(200):
+            c.advance()
+            # Outcome noise well inside calm (fail EWMA stays ~0.05 <<
+            # CHURN_FIRE), bandwidth noise far above the wire gate.
+            ok = rng.random() > 0.05
+            c.observe_round(
+                level="flat", ok=bool(ok), duration_s=1.0,
+                push_bytes=1_000_000,
+                bw_floor=50e6 * (1.0 + 0.3 * rng.standard_normal()),
+                budget_s=5.0,
+            )
+        assert c.transitions_total == 0, c.scrape()["transitions"]
+        assert c.summary()["regime"]["flat"] == "calm"
+        assert c.wire == "f32" and c.topology == c.topology_preference
+
+    def test_step_change_exactly_one_transition_per_knob(self):
+        """A clean step change in the evidence produces EXACTLY ONE
+        transition per affected knob (regime, then topology one fenced
+        round later) — not one per observation."""
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=GroupSchedule(target_size=4),
+                 max_group=8)
+        feed_rounds(c, [True] * 20)
+        assert c.transitions_total == 0
+        # Step: every round fails from here on.
+        feed_rounds(c, [False] * 30)
+        trans = c.scrape()["transitions"]
+        by_knob = {}
+        for p in trans:
+            by_knob.setdefault((p["knob"], p["key"]), []).append(p)
+        # regime flat: calm -> churn -> degraded is TWO moves of one knob
+        # (a monotone walk, not a flap); topology follows each.
+        regimes = [p["to"] for p in by_knob.get(("regime", "flat"), [])]
+        assert regimes == ["churn", "degraded"], trans
+        topos = [p["to"] for p in by_knob.get(("topology", ""), [])]
+        assert topos == ["gossip"], trans
+        assert all(
+            len({(p["from"], p["to"]) for p in ps}) == len(ps)
+            for ps in by_knob.values()
+        ), "a knob repeated an identical transition"
+
+    def test_recovery_climbs_back(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=GroupSchedule(target_size=4),
+                 max_group=8)
+        feed_rounds(c, [True] * 8)
+        feed_rounds(c, [False] * 30)
+        assert c.summary()["regime"]["flat"] == "degraded"
+        assert c.topology == "gossip"
+        feed_rounds(c, [True] * 60)
+        assert c.summary()["regime"]["flat"] == "calm"
+        assert c.topology == c.topology_preference
+
+
+# -- epoch fence -------------------------------------------------------------
+
+
+class TestEpochFence:
+    def test_decision_never_applies_to_in_flight_round(self):
+        """A transition staged by round N's evidence must not change any
+        knob readout until the NEXT round's advance() — the fencing
+        contract the averager's call order implements."""
+        c, policy, tele = make_controller()
+        sched = GroupSchedule(target_size=4)
+        c.attach(wire="f32", schedule=sched, max_group=8)
+        feed_rounds(c, [True] * 6)
+        # Round N starts...
+        c.advance()
+        before = (c.topology, c.wire, c.regime("flat"))
+        # ...and its (bad) outcome stages transitions mid-flight.
+        for _ in range(10):
+            c.observe_round(level="flat", ok=False, duration_s=2.0)
+        assert (c.topology, c.wire, c.regime("flat")) == before, (
+            "a staged decision leaked into the in-flight round"
+        )
+        assert c.summary()["pending"] > 0
+        applied = c.advance()  # round N+1 starts: NOW it applies
+        assert applied and c.regime("flat") != before[2]
+
+    def test_applied_transition_records_fence_seq(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=GroupSchedule(target_size=4),
+                 max_group=8)
+        feed_rounds(c, [False] * 10)
+        for p in c.scrape()["transitions"]:
+            assert p["seq"] >= p["fence"], p
+
+
+# -- per-level deadlines -----------------------------------------------------
+
+
+class TestPerLevelDeadlines:
+    def test_levels_learn_independently(self):
+        """Fast intra rounds + slow cross rounds must diverge the learned
+        budgets (cross > intra) while the flat record — the pre-split
+        surface every legacy caller reads — stays untouched by either."""
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=1.0)
+        flat0 = p.round_budget()
+        for _ in range(12):
+            p.record_round(duration_s=0.4, ok=True, level="intra")
+        for _ in range(12):
+            p.record_round(duration_s=9.0, ok=True, level="cross")
+        intra, cross = p.round_budget("intra"), p.round_budget("cross")
+        assert cross > intra, (intra, cross)
+        assert intra < 4.0 and cross > 9.0
+        assert p.round_budget() == flat0, "flat record moved without flat rounds"
+        assert set(p.deadlines()) == {"flat", "intra", "cross"}
+
+    def test_cross_failure_does_not_slacken_intra(self):
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=1.0)
+        for _ in range(12):
+            p.record_round(duration_s=0.4, ok=True, level="intra")
+        tight = p.round_budget("intra")
+        for _ in range(4):
+            p.record_round(duration_s=5.0, ok=False, level="cross")
+        assert p.round_budget("intra") == pytest.approx(tight)
+        assert p.round_budget("cross") == 20.0  # AIMD'd to the ceiling
+
+    def test_new_level_seeds_from_flat(self):
+        p = ResiliencePolicy(max_deadline_s=20.0, min_deadline_s=1.0)
+        for _ in range(12):
+            p.record_round(duration_s=0.5, ok=True)  # flat learns tight
+        flat = p.round_budget()
+        assert p.round_budget("cross") == pytest.approx(flat), (
+            "a new level must start at the flat operating point"
+        )
+
+    def test_stats_carries_per_level_deadlines(self):
+        p = ResiliencePolicy(max_deadline_s=20.0)
+        p.record_round(duration_s=0.5, ok=True, level="intra")
+        st = p.stats()
+        assert st["deadlines"]["flat"] == st["deadline_s"]
+        assert st["levels"]["intra"]["deadline_s"] > 0
+
+
+# -- regime-folded hedge budget ----------------------------------------------
+
+
+class TestHedgeRegime:
+    def test_regime_floors_hedge_budget_without_touching_aimd(self):
+        p = ResiliencePolicy(max_deadline_s=20.0)
+        # AIMD learned a lazy operating point (duplicate-only rounds).
+        for _ in range(6):
+            p.record_hedge_outcome(
+                "cross", issued=2, duplicate_tiles=4, tiles_recovered=0
+            )
+        soft_calm, inflight_calm = p.hedge_params("cross")
+        assert soft_calm > 0.6 and inflight_calm == 1
+        p.set_regime("cross", "degraded")
+        soft, inflight = p.hedge_params("cross")
+        assert soft <= 0.4 and inflight >= 3
+        p.set_regime("cross", "calm")
+        assert p.hedge_params("cross") == (soft_calm, inflight_calm), (
+            "regime floor must not mutate the learned AIMD state"
+        )
+
+    def test_controller_applies_regime_to_policy(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=GroupSchedule(target_size=4),
+                 max_group=8)
+        feed_rounds(c, [False] * 12, level="cross")
+        assert policy._hedge_regime.get("cross") in ("churn", "degraded")
+        assert policy.stats().get("hedge", {}).get("cross", {}).get(
+            "regime", "calm"
+        ) != "calm" or policy.hedge_params("cross")[1] >= 2
+
+
+# -- wire selection ----------------------------------------------------------
+
+
+class TestWireSelection:
+    def _starved(self, c, n=8):
+        # 4 MB pushes over a 200 KB/s floor against a 5 s budget: f32
+        # transfer share ~4x the budget — decisively over the fire band.
+        feed_rounds(
+            c, [True] * n, push_bytes=4_000_000, bw_floor=200_000.0,
+            budget_s=5.0,
+        )
+
+    def test_bandwidth_starvation_selects_bf16(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=None)
+        tele.health.note_codec_error("bf16", 1e-3)  # measured, under bound
+        self._starved(c)
+        assert c.wire == "bf16"
+        trans = [p for p in c.scrape()["transitions"] if p["knob"] == "wire"]
+        assert len(trans) == 1 and trans[0]["to"] == "bf16"
+        assert "bf16_rel_err" in trans[0]["evidence"]
+
+    def test_distortion_bound_blocks_flip(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=None)
+        tele.health.note_codec_error("bf16", 0.5)  # way over the bound
+        self._starved(c)
+        assert c.wire == "f32", "distortion-bounded flip happened anyway"
+
+    def test_unmeasured_distortion_blocks_flip(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=None)
+        self._starved(c)
+        assert c.wire == "f32"
+
+    def test_recovery_flips_back_to_configured(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32", schedule=None)
+        tele.health.note_codec_error("bf16", 1e-3)
+        self._starved(c)
+        assert c.wire == "bf16"
+        # Bandwidth recovers decisively: f32 share under the clear band.
+        feed_rounds(
+            c, [True] * 8, push_bytes=4_000_000, bw_floor=50e6, budget_s=5.0,
+        )
+        assert c.wire == "f32"
+
+    def test_wire_ranking_measured_first(self):
+        c, policy, tele = make_controller()
+        tele.health.note_codec_error("bf16", 1e-3)
+        tele.health.note_codec_error("f32", 0.0)
+        rank = c.wire_ranking()
+        measured = [r["wire"] for r in rank if r["measured"]]
+        assert rank[0]["wire"] in ("bf16", "f32")
+        assert set(measured) == {"bf16", "f32"}
+        # bf16 at half the bytes and negligible distortion out-scores f32.
+        assert rank[0]["wire"] == "bf16"
+
+    def test_averager_set_wire_rekeys_schema(self):
+        t = Transport()
+        dht = DHTNode(t)
+        mem = SwarmMembership(dht, "v0", ttl=10.0)
+        avg = SyncAverager(t, dht, mem)
+        avg._pack(make_tree(1.0))
+        s_f32 = avg._schema
+        avg.set_wire("bf16")
+        assert avg.wire == "bf16" and avg._schema != s_f32
+        assert not avg._check_schema({"schema": s_f32}), (
+            "old-wire push accepted after the flip"
+        )
+        avg.set_wire("f32")
+        assert avg._schema == s_f32, "schema re-key must be deterministic"
+        with pytest.raises(ValueError):
+            avg.set_wire("topk")
+
+
+# -- cadence -----------------------------------------------------------------
+
+
+class TestCadence:
+    def _cross(self, c, pair="dc|home", bw=None, rounds=1, ok=True):
+        for _ in range(rounds):
+            c.advance()
+            c.observe_cross_pair(pair, bw_floor=bw, ok=ok)
+
+    def test_thin_pair_relaxes_k(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32",
+                 schedule=GroupSchedule(target_size=4, cross_zone_every_k=3),
+                 max_group=8)
+        assert c.cross_zone_k() == 3
+        self._cross(c, bw=10_000.0, rounds=12)  # far under PAIR_BW_FLOOR
+        c.advance()
+        assert c.cross_zone_k() > 3, c.summary()["cadence"]
+        per_pair = c.summary()["cadence"]["per_pair"]
+        assert per_pair["dc|home"]["k"] == c.cross_zone_k()
+
+    def test_stalled_dispersion_tightens_k(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32",
+                 schedule=GroupSchedule(target_size=4, cross_zone_every_k=4),
+                 max_group=8)
+        self._cross(c, bw=10e6, rounds=2)
+        # Dispersion refuses to converge: flat above the floor.
+        for _ in range(2 * c.DISPERSION_WINDOW + 2):
+            c.advance()
+            c.observe_dispersion("cross", 0.4)
+            c.observe_cross_pair("dc|home", bw_floor=10e6)
+        c.advance()
+        assert c.cross_zone_k() < 4, c.summary()["cadence"]
+
+    def test_converged_dispersion_relaxes_k(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32",
+                 schedule=GroupSchedule(target_size=4, cross_zone_every_k=4),
+                 max_group=8)
+        self._cross(c, bw=10e6, rounds=2)
+        for _ in range(2 * c.DISPERSION_WINDOW + 2):
+            c.advance()
+            c.observe_dispersion("cross", 0.001)  # under the floor
+            c.observe_cross_pair("dc|home", bw_floor=10e6)
+        c.advance()
+        assert c.cross_zone_k() > 4, c.summary()["cadence"]
+
+    def test_intra_dispersion_does_not_feed_the_trend(self):
+        c, policy, tele = make_controller()
+        c.attach(wire="f32",
+                 schedule=GroupSchedule(target_size=4, cross_zone_every_k=4),
+                 max_group=8)
+        for _ in range(20):
+            c.observe_dispersion("intra", 0.4)
+        assert len(c._disp) == 0
+
+    def test_schedule_retune_validates(self):
+        sched = GroupSchedule(target_size=4, cross_zone_every_k=3)
+        sched.retune(target_size=2, cross_zone_every_k=6)
+        assert sched.target_size == 2 and sched.cross_zone_every_k == 6
+        with pytest.raises(ValueError):
+            sched.retune(target_size=1)
+        with pytest.raises(ValueError):
+            sched.retune(cross_zone_every_k=-1)
+
+
+# -- watchdog annotation -----------------------------------------------------
+
+
+class TestWatchdogAnnotation:
+    def test_transition_annotates_firing_wall_alert(self):
+        """An intentional controller transition stamps itself onto an
+        in-window round_wall_inflation alert (the PR-13 hedge-annotation
+        pattern): the alert says a retune is in progress, it does not
+        page as an unexplained anomaly."""
+        tele = T.Telemetry(peer_id="p")
+        wd = tele.watchdog
+        for _ in range(6):
+            wd.observe("round_wall_inflation", 1.0, key="cross")
+        for _ in range(2):
+            wd.observe("round_wall_inflation", 30.0, key="cross")
+        assert wd.alerts(), "wall alert should be firing"
+        c, policy, _ = make_controller(telemetry=tele)
+        c.attach(wire="f32", schedule=GroupSchedule(target_size=4),
+                 max_group=8)
+        feed_rounds(c, [False] * 10, level="cross")
+        alert = [a for a in wd.alerts() if a["kind"] == "round_wall_inflation"][0]
+        assert "policy_changed" in alert and "policy_reason" in alert, alert
+
+    def test_alert_raised_after_transition_gets_stamp_via_probe(self):
+        tele = T.Telemetry(peer_id="p")
+        wd = tele.watchdog
+        c, policy, _ = make_controller(telemetry=tele)
+        c.attach(wire="f32", schedule=GroupSchedule(target_size=4),
+                 max_group=8)
+        feed_rounds(c, [False] * 10, level="cross")  # transitions applied
+        # The wall alert fires AFTER the transition...
+        for _ in range(6):
+            wd.observe("round_wall_inflation", 1.0, key="cross")
+        for _ in range(2):
+            wd.observe("round_wall_inflation", 30.0, key="cross")
+        wd.tick()  # ...and the controller's probe stamps it in-window.
+        alert = [a for a in wd.alerts() if a["kind"] == "round_wall_inflation"][0]
+        assert "policy_changed" in alert, alert
+
+    def test_policy_changed_lands_in_flight_recorder(self):
+        tele = T.Telemetry(peer_id="p")
+        c, policy, _ = make_controller(telemetry=tele)
+        c.attach(wire="f32", schedule=GroupSchedule(target_size=4),
+                 max_group=8)
+        feed_rounds(c, [False] * 10)
+        evs = tele.recorder.dump(kinds=["policy_changed"])
+        assert evs, "transitions must land in the flight recorder"
+        for e in evs:
+            assert e["sev"] == "info"
+            assert e["reason"] and isinstance(e["evidence"], dict)
+
+
+# -- policy_flap doctor rule -------------------------------------------------
+
+
+class TestPolicyFlapRule:
+    def _diagnose(self, bundle):
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "experiments"),
+        )
+        from doctor_report import diagnose
+
+        return diagnose(bundle)
+
+    def test_oscillation_ranks_above_symptoms(self):
+        flip = {"knob": "wire", "key": "", "kind": "policy_changed"}
+        events = []
+        for i in range(6):
+            events.append({
+                **flip,
+                "from": "f32" if i % 2 == 0 else "bf16",
+                "to": "bf16" if i % 2 == 0 else "f32",
+            })
+        # The symptoms the flap manufactures: wall alerts + a straggler's
+        # mass-loss trail that would otherwise top the ranking.
+        events.append({
+            "kind": "mass_lost_at_deadline", "excluded": ["v3"],
+            "lost_slots": 1,
+        })
+        bundle = {
+            "alerts": [
+                {"kind": "round_wall_inflation", "key": "cross"},
+                {"kind": "mass_frac_drop", "key": ""},
+            ],
+            "flight": {"v0": events},
+        }
+        ranked = self._diagnose(bundle)
+        assert ranked and ranked[0]["cause"] == "policy_flap", ranked
+        assert ranked[0]["evidence"]["value_revisits"] >= 2
+
+    def test_monotone_transitions_do_not_flap(self):
+        """A healthy controller tracking a real regime change (monotone
+        walk, no revisits) must NOT diagnose as a flap."""
+        events = [
+            {"kind": "policy_changed", "knob": "regime", "key": "flat",
+             "from": "calm", "to": "churn"},
+            {"kind": "policy_changed", "knob": "regime", "key": "flat",
+             "from": "churn", "to": "degraded"},
+            {"kind": "policy_changed", "knob": "topology", "key": "",
+             "from": "butterfly", "to": "gossip"},
+        ]
+        ranked = self._diagnose({"alerts": [], "flight": {"v0": events}})
+        assert not any(h["cause"] == "policy_flap" for h in ranked), ranked
+
+    def test_fleet_converging_on_same_walk_does_not_flap(self):
+        """Regression (found diagnosing the real chaos_adaptive artifact):
+        three vantages each walking the SAME knob monotonically through
+        the same values (per-pair cadence 2->4->8->16 on every thin-WAN
+        volunteer) is a healthy fleet converging, not an oscillation —
+        the rule must group by PEER, and within one peer a value that is
+        both a target and a LATER event's old value (every middle step
+        of a monotone walk) must not count as a revisit."""
+        flight = {}
+        for pid in ("v0", "v1", "v2"):
+            flight[pid] = [
+                {"kind": "policy_changed", "knob": "cadence",
+                 "key": "dc|home", "peer": pid, "from": k, "to": k * 2}
+                for k in (2, 4, 8)
+            ]
+        ranked = self._diagnose({
+            "alerts": [{"kind": "round_wall_inflation", "key": "cross"}],
+            "flight": flight,
+        })
+        assert not any(h["cause"] == "policy_flap" for h in ranked), ranked
+
+
+# -- coord.status["controller"] schema (satellite) ---------------------------
+
+
+def _walk(schema, obj, path=""):
+    for key, typ in schema.items():
+        assert key in obj, f"missing documented key {path}{key}"
+        typs = typ if isinstance(typ, tuple) else (typ,)
+        assert isinstance(obj[key], typs), (
+            f"{path}{key}: expected {typs}, got {type(obj[key]).__name__}"
+        )
+
+
+class TestStatusControllerSchema:
+    def test_status_controller_schema_walk(self):
+        """coord.status carries the controller rollup under the pinned
+        schema with the usual age_s staleness stamp, merged across
+        reporters (worst regime, tightest pair k, max deadline)."""
+
+        async def main():
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=None)
+            rep = ControlPlaneReplica(t, dht, rid="cp0", interval=0.5)
+            await rep.start()
+            try:
+                for pid, fail in (("v0", True), ("v1", False)):
+                    c, policy, tele = make_controller(
+                        telemetry=T.Telemetry(peer_id=pid)
+                    )
+                    c.attach(
+                        wire="f32",
+                        schedule=GroupSchedule(
+                            target_size=4, cross_zone_every_k=3
+                        ),
+                        max_group=8,
+                    )
+                    feed_rounds(c, [not fail] * 12, level="cross")
+                    c.observe_cross_pair("dc|home", bw_floor=10_000.0)
+                    for _ in range(12):
+                        c.advance()
+                        c.observe_cross_pair("dc|home", bw_floor=10_000.0)
+                    c.advance()
+                    await rep._rpc_report(
+                        {"peer": pid, "samples_per_sec": 1.0,
+                         "controller": c.summary()},
+                        b"",
+                    )
+                await asyncio.sleep(0.2)
+                status, _ = await rep._rpc_status({}, b"")
+            finally:
+                await rep.stop()
+                await dht.stop()
+                await t.close()
+            return status
+
+        status = run(main())
+        sec = status["controller"]
+        assert isinstance(sec, dict)
+        _walk(C.STATUS_CONTROLLER_SCHEMA, sec, "controller.")
+        assert sec["schema_version"] == C.CONTROLLER_SCHEMA_VERSION
+        assert sec["reporting"] == 2
+        # Worst regime across reporters wins the merge.
+        assert sec["regime"]["cross"] in ("churn", "degraded")
+        # Tightest pair k + its bw evidence survive the merge.
+        assert sec["cadence"]["per_pair"]["dc|home"]["k"] >= 1
+        assert sec["transitions_total"] >= 1
+        assert isinstance(sec["age_s"], float) and 0 <= sec["age_s"] < 30.0
+        assert sec["last_transition"] and sec["last_transition"]["reason"]
+
+    def test_no_reporters_serves_no_controller_section(self):
+        async def main():
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=None)
+            rep = ControlPlaneReplica(t, dht, rid="cp0", interval=0.5)
+            await rep.start()
+            try:
+                await rep._rpc_report(
+                    {"peer": "v0", "samples_per_sec": 1.0}, b""
+                )
+                status, _ = await rep._rpc_status({}, b"")
+            finally:
+                await rep.stop()
+                await dht.stop()
+                await t.close()
+            return status
+
+        status = run(main())
+        assert status["controller"] is None, (
+            "a --no-adapt fleet must serve no controller section"
+        )
+
+
+# -- --no-adapt plumbing -----------------------------------------------------
+
+
+class TestNoAdaptPlumbing:
+    def test_volunteer_config_plumbs_adapt(self):
+        from distributedvolunteercomputing_tpu.swarm.volunteer import (
+            Volunteer,
+            VolunteerConfig,
+        )
+
+        v = Volunteer(VolunteerConfig(
+            averaging="sync", resilience=True, adapt=False,
+        ))
+        v._build_resilience_layer()
+        assert v.resilience_policy is not None and v.controller is None
+        assert "controller" not in v._build_report()
+        v_on = Volunteer(VolunteerConfig(averaging="sync", resilience=True))
+        v_on._build_resilience_layer()
+        assert v_on.controller is not None
+        rep = v_on._build_report()
+        assert rep["controller"]["schema_version"] == C.CONTROLLER_SCHEMA_VERSION
+        # Gossip has no rounds to fence a decision against: no controller
+        # even with adapt on.
+        v_g = Volunteer(VolunteerConfig(averaging="gossip", resilience=True))
+        v_g._build_resilience_layer()
+        assert v_g.controller is None
+
+    def test_no_controller_bytes_on_heartbeat_when_disabled(self):
+        """End-to-end: a batched cp.exchange beat from a --no-adapt
+        volunteer carries NO controller key (and an adapt one does) —
+        the --no-health-probe pattern."""
+        from distributedvolunteercomputing_tpu.swarm.volunteer import (
+            Volunteer,
+            VolunteerConfig,
+        )
+
+        async def main():
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=None)
+            rep = ControlPlaneReplica(t, dht, rid="cp0", interval=0.5)
+            await rep.start()
+            seen = {}
+            try:
+                for pid, adapt in (("aoff", False), ("aon", True)):
+                    vol = Volunteer(VolunteerConfig(
+                        peer_id=pid, averaging="sync", resilience=True,
+                        adapt=adapt,
+                    ))
+                    vol._build_resilience_layer()
+                    vt = Transport()
+                    vdht = DHTNode(vt)
+                    await vdht.start(bootstrap=[t.addr])
+                    cp = ControlPlaneClient(vt, vdht, pid)
+                    mem = SwarmMembership(
+                        vdht, pid, ttl=10.0, control_plane=cp,
+                        report_source=vol._build_report,
+                        telemetry=vol.telemetry,
+                    )
+                    await mem.join()
+                    await mem._beat_once()
+                    assert mem.last_beat_batched, "beat must ride cp.exchange"
+                    seen[pid] = dict(rep.latest_metrics.get(pid) or {})
+                    await mem.leave()
+                    await vdht.stop()
+                    await vt.close()
+            finally:
+                await rep.stop()
+                await dht.stop()
+                await t.close()
+            return seen
+
+        seen = run(main())
+        assert "controller" not in seen["aoff"], "--no-adapt leaked bytes"
+        assert "controller" in seen["aon"]
+        assert (
+            seen["aon"]["controller"]["schema_version"]
+            == C.CONTROLLER_SCHEMA_VERSION
+        )
+
+
+# -- overhead smoke (satellite) ----------------------------------------------
+
+
+async def _spawn(n, *, controller=False, **avg_kw):
+    vols = []
+    boot = None
+    kw = {"join_timeout": 6.0, "gather_timeout": 8.0, "min_group": 2, **avg_kw}
+    for i in range(n):
+        t = Transport()
+        dht = DHTNode(t)
+        await dht.start(bootstrap=[boot] if boot else None)
+        if boot is None:
+            boot = t.addr
+        pid = f"{'c' if controller else 'p'}{i}"
+        mem = SwarmMembership(dht, pid, ttl=10.0)
+        await mem.join()
+        tele = T.Telemetry(peer_id=pid)
+        tele.register_rpcs(t)
+        extra = {}
+        if controller:
+            policy = ResiliencePolicy(max_deadline_s=kw["gather_timeout"])
+            extra["resilience"] = policy
+            extra["controller"] = C.SwarmController(
+                policy=policy, telemetry=tele,
+            )
+        avg = SyncAverager(t, dht, mem, telemetry=tele, **extra, **kw)
+        vols.append({"t": t, "dht": dht, "mem": mem, "avg": avg, "tele": tele})
+    return vols
+
+
+async def _teardown(vols):
+    for v in vols:
+        try:
+            await v["mem"].leave()
+        except Exception:
+            pass
+        try:
+            await v["t"].close()
+        except Exception:
+            pass
+
+
+class TestOverheadSmoke:
+    def test_controller_overhead_within_5pct(self):
+        """Rounds with the controller in the loop (advance + evidence
+        feed every round) must stay within 5% of the controller-less
+        median commit latency. Interleaved arms, medians compared, small
+        absolute grace — the telemetry/watchdog smoke pattern; fails
+        loudly on regression."""
+        blocks, rounds_per_block, elems = 3, 3, 65_536
+
+        async def one_round(vols, r):
+            res = await asyncio.gather(
+                *(
+                    v["avg"].average(make_tree(float(i), elems), round_no=r)
+                    for i, v in enumerate(vols)
+                ),
+                return_exceptions=True,
+            )
+            return all(
+                x is not None and not isinstance(x, BaseException)
+                for x in res
+            )
+
+        async def main():
+            vols_off = await _spawn(3, controller=False)
+            dts = {False: [], True: []}
+            try:
+                vols_on = await _spawn(3, controller=True)
+            except BaseException:
+                await _teardown(vols_off)
+                raise
+            arms = {False: vols_off, True: vols_on}
+            try:
+                r = 0
+                for vols in (vols_off, vols_on):  # warmup both arms
+                    await one_round(vols, r)
+                    r += 1
+                for _ in range(blocks):
+                    for enabled in (False, True):
+                        for _ in range(rounds_per_block):
+                            r += 1
+                            t0 = time.perf_counter()
+                            if await one_round(arms[enabled], r):
+                                dts[enabled].append(time.perf_counter() - t0)
+            finally:
+                await _teardown(vols_off)
+                await _teardown(vols_on)
+            return dts
+
+        dts = run(main(), timeout=300)
+        need = blocks * rounds_per_block // 2
+        assert len(dts[True]) >= need and len(dts[False]) >= need
+        med_on = statistics.median(dts[True])
+        med_off = statistics.median(dts[False])
+        assert med_on <= med_off * 1.05 + 0.030, (
+            f"controller overhead: enabled median {med_on:.4f}s vs "
+            f"plain {med_off:.4f}s — exceeds the 5% budget"
+        )
