@@ -209,6 +209,15 @@ def main() -> int:
     last_diag: dict | None = None
     last_err = "bench child never ran"
 
+    # Fast path first: a persistent warm-backend worker (chip_probe.py serve)
+    # already paid init + compile and can measure NOW, live, in seconds —
+    # the fresh-child ladder below stays as the fallback when no worker is
+    # up or it answers wrong.
+    worker = _warm_worker_probe(model_name)
+    if worker is not None:
+        _emit(worker)
+        return 0
+
     for attempt in range(n_attempts):
         remaining = budget - (time.monotonic() - t_start)
         attempts_left = n_attempts - attempt
@@ -293,7 +302,9 @@ def main() -> int:
     if recorded is not None:
         recorded["error_live"] = last_err[:300]
         _emit(recorded)
-        return 0
+        # A stale record (liveness epoch missing/expired) is evidence, not a
+        # result: rc=1 so the driver treats the round's bench as failed.
+        return 1 if recorded.get("stale") else 0
 
     diag = last_diag or {}
     _emit(
@@ -311,18 +322,90 @@ def main() -> int:
     return 1
 
 
-def _recorded_probe(model_name: str) -> dict | None:
-    # Only a record of the EXACT configured benchmark may stand in for it:
-    # same model, no config overrides, same batch size, default (f32) dtype,
-    # default remat schedule (the probe records with the model default).
-    if (
+def _default_config_only() -> bool:
+    """True iff no env override moves the bench off the default flagship
+    config — the only config the chip-probe record and the warm worker
+    measure, so the only one either may stand in for."""
+    return not (
         os.environ.get("DVC_BENCH_MODEL_KW")
         or os.environ.get("DVC_BENCH_PARAM_DTYPE")
         or os.environ.get("DVC_BENCH_REMAT") == "0"
         or os.environ.get("DVC_BENCH_ACCUM", "1") not in ("", "1")
         or os.environ.get("DVC_BENCH_STEPS_PER_CALL", "1") not in ("", "1")
         or os.environ.get("DVC_ATTN_IMPL", "auto") not in ("", "auto")
+    )
+
+
+def _warm_worker_probe(model_name: str) -> dict | None:
+    """Ask the persistent warm-backend worker (chip_probe.py serve) for a
+    live measurement. Unlike _recorded_probe this is NOT a replay: the
+    worker runs the timed hot loop on its cached compiled step at request
+    time, so the returned number is measured in THIS round's window and is
+    emitted with status "live". Any miss — no worker, different model or
+    batch, wedged socket — falls through to the fresh-child ladder."""
+    if os.environ.get("DVC_BENCH_TRY_WORKER", "1") != "1":
+        return None
+    if not _default_config_only():
+        return None
+    batch_size = int(os.environ.get("DVC_BENCH_BATCH", "8"))
+    try:
+        from experiments.chip_probe import request_worker  # no jax at import
+    except ImportError:
+        return None
+    info = request_worker({"cmd": "ping"}, timeout=5.0)
+    if (
+        not info
+        or not info.get("ok")
+        or info.get("model") != model_name
+        or info.get("batch_size") != batch_size
     ):
+        return None
+    print(
+        f"bench: warm worker alive (epoch {info.get('epoch')}); "
+        "requesting live measurement",
+        file=sys.stderr,
+    )
+    timeout = float(os.environ.get("DVC_BENCH_WORKER_TIMEOUT", "240"))
+    iters = int(os.environ.get("DVC_BENCH_ITERS", "20"))
+    resp = request_worker({"cmd": "bench", "iters": iters}, timeout=timeout)
+    if not resp or not resp.get("ok"):
+        print(
+            f"bench: warm worker bench failed: "
+            f"{(resp or {}).get('error', 'no response')}; using ladder",
+            file=sys.stderr,
+        )
+        return None
+    payload = resp.get("payload") or {}
+    if not payload.get("value") or payload.get("batch_size") != batch_size:
+        return None
+    payload["status"] = "live"  # measured now by the resident backend
+    payload["source"] = "experiments/chip_probe.py (persistent warm worker, via bench.py)"
+    # vs_baseline against the same per-config ratchet the child path uses
+    # (the worker measures the default config: f32, default remat).
+    model_key = _ratchet_key(model_name, "", batch_size, "float32", "on")
+    try:
+        with open(_ratchet_path()) as fh:
+            prior = json.load(fh)
+        rec = prior.get(model_key)
+        if isinstance(rec, dict) and rec.get("value"):
+            payload["vs_baseline"] = round(
+                float(payload["value"]) / float(rec["value"]), 4
+            )
+        else:
+            payload["vs_baseline"] = 1.0
+            prior[model_key] = {"value": float(payload["value"])}
+            with open(_ratchet_path(), "w") as fh:
+                json.dump(prior, fh)
+    except (OSError, ValueError, TypeError):
+        payload.setdefault("vs_baseline", 1.0)
+    return payload
+
+
+def _recorded_probe(model_name: str) -> dict | None:
+    # Only a record of the EXACT configured benchmark may stand in for it:
+    # same model, no config overrides, same batch size, default (f32) dtype,
+    # default remat schedule (the probe records with the model default).
+    if not _default_config_only():
         return None
     batch_size = int(os.environ.get("DVC_BENCH_BATCH", "8"))
     path = os.path.join(
@@ -368,6 +451,32 @@ def _recorded_probe(model_name: str) -> dict | None:
         rec.get("source", "")
         + f" [recorded {age_s / 60:.0f} min before this run; live attempts failed]"
     )
+    # BENCH_r02 fix: a cached figure may only headline while the backend
+    # that produced it is provably the CURRENT, live one. The probe/worker
+    # stamp each record with a liveness epoch (results/backend_epoch.json,
+    # re-stamped on every observed-alive event, TTL DVC_BENCH_EPOCH_TTL).
+    # Epoch missing from the record, mismatched, or expired means the number
+    # describes a backend nobody has seen alive recently — it is surfaced
+    # as evidence ("stale": true, recorded_value) but the headline value is
+    # zeroed so no round reports a dead chip's throughput as its own.
+    epoch_ok = False
+    try:
+        with open(os.path.join(os.path.dirname(path), "backend_epoch.json")) as fh:
+            ep = json.load(fh)
+        ttl = float(os.environ.get("DVC_BENCH_EPOCH_TTL", "900"))
+        epoch_ok = (
+            bool(rec.get("backend_epoch"))
+            and rec.get("backend_epoch") == ep.get("epoch")
+            and time.time() - float(ep.get("alive_at", 0)) <= ttl
+        )
+    except (OSError, ValueError, TypeError):
+        epoch_ok = False
+    if not epoch_ok:
+        rec["stale"] = True
+        rec["recorded_value"] = rec["value"]
+        rec["value"] = 0.0
+        rec["vs_baseline"] = 0.0
+        rec["source"] += " [STALE: backend liveness epoch missing or expired]"
     return rec
 
 

@@ -171,7 +171,13 @@ class MeshCodec:
     axis. ``None`` uses the default jax device only.
     """
 
-    def __init__(self, mesh=None, backend: str = "auto", pallas: Optional[str] = None):
+    def __init__(
+        self,
+        mesh=None,
+        backend: str = "auto",
+        pallas: Optional[str] = None,
+        collective: Optional[str] = None,
+    ):
         if backend not in ("auto", "mesh", "host"):
             raise ValueError(f"unknown mesh-codec backend {backend!r}")
         self._lock = threading.Lock()
@@ -193,6 +199,7 @@ class MeshCodec:
         self.device_s = 0.0
         self._pallas_mode = self._resolve_pallas(pallas)
         self._backend = self._resolve_backend(backend)
+        self._collective = self._resolve_collective(collective)
 
     # -- selection ---------------------------------------------------------
 
@@ -229,6 +236,29 @@ class MeshCodec:
         except Exception:  # noqa: BLE001
             return "off"
 
+    @staticmethod
+    def _resolve_collective(collective: Optional[str]) -> str:
+        """"ring" | "off" — the fused reduce pipeline (ops.mesh_collective).
+
+        Explicit "ring"/"off" wins; otherwise DVC_MESH_COLLECTIVE, then
+        auto: ring on TPU silicon (where the remote-DMA kernel compiles),
+        off elsewhere — the CPU test/bench planes opt in explicitly so the
+        PR 5 staged folder stays the default sharded path off-silicon."""
+        if collective is None:
+            collective = os.environ.get("DVC_MESH_COLLECTIVE", "auto").strip().lower()
+        if collective in ("ring", "1", "on"):
+            return "ring"
+        if collective in ("off", "0", "none", "host"):
+            return "off"
+        if collective != "auto":
+            raise ValueError(f"unknown mesh collective {collective!r}")
+        try:
+            from distributedvolunteercomputing_tpu.utils.jaxenv import tpu_backend
+
+            return "ring" if tpu_backend() else "off"
+        except Exception:  # noqa: BLE001 — no usable jax == no collective
+            return "off"
+
     @property
     def backend(self) -> str:
         return "host" if self.degraded else self._backend
@@ -244,6 +274,7 @@ class MeshCodec:
             "configured": self._backend,
             "devices": self._ndev if self._codec_mesh is not None else None,
             "pallas": self._pallas_mode,
+            "collective": self._collective,
             "ops_mesh": int(self.ops_mesh),
             "ops_host": int(self.ops_host),
             "fallbacks": int(self.fallbacks),
@@ -726,12 +757,25 @@ class MeshCodec:
         """A device mean folder for one round, or None when this codec
         can't host one (inactive, or the tile dim doesn't split over the
         codec axis — chunk sizes and device counts are both powers of two
-        in practice, so the None case is the host backend)."""
+        in practice, so the None case is the host backend).
+
+        With the ring collective enabled (and a bf16 wire on >= 2 devices)
+        the folder is the fused ring pipeline (ops.mesh_collective): chunks
+        land WHOLE on devices and decode+fold+forward run in one device
+        pass, instead of the staged element-split scatter-add. On one
+        device the ring degenerates to a plain fold — the staged folder IS
+        that plain fold, so it is returned unchanged."""
         if not self.active:
             return None
         self._ensure_mesh()
         if tile_elems % self._ndev:
             return None
+        if self._collective == "ring" and wire == "bf16" and self._ndev >= 2:
+            from distributedvolunteercomputing_tpu.ops import mesh_collective
+
+            return mesh_collective.RingMeanFolder(
+                self, n_elems, tile_elems, n_tiles, wire
+            )
         return MeshMeanFolder(self, n_elems, tile_elems, n_tiles, wire)
 
 
@@ -753,6 +797,8 @@ class MeshMeanFolder:
     Only if the accumulated state itself is unrecoverable does the round
     fail, and the codec is degraded either way so the next round starts on
     host."""
+
+    kind = "staged"  # vs "ring" (ops.mesh_collective.RingMeanFolder)
 
     def __init__(
         self, codec: MeshCodec, n_elems: int, tile_elems: int, n_tiles: int, wire: str
@@ -884,72 +930,86 @@ class MeshMeanFolder:
             self._staged_bytes = 0
         return batch
 
+    def _batch_arrays(self, batch: List[Tuple[int, float, bytes]], kb: int):
+        """(tiles [kb] i32, ws [kb] f32, raw [kb, row_bytes] u8) — the
+        staged batch as padded host arrays. Padding rows carry weight 0
+        into tile 0: a no-op fold. Shared by the staged scatter-add and the
+        ring collective flush (one home for the wire-chunk layout)."""
+        k = len(batch)
+        tiles = np.zeros(kb, np.int32)
+        ws = np.zeros(kb, np.float32)
+        tiles[:k] = [t for t, _, _ in batch]
+        ws[:k] = [w for _, w, _ in batch]
+        row_bytes = self.tile_elems * self.esz
+        raw = np.zeros((kb, row_bytes), np.uint8)
+        for i, (_, _, data) in enumerate(batch):
+            raw[i, : len(data)] = np.frombuffer(data, np.uint8)
+        return tiles, ws, raw
+
+    def _flush_dev(self, batch: List[Tuple[int, float, bytes]]) -> bool:
+        """Device half of flush: the PR 5 staged path — batch element-split
+        over the codec axis, ONE jitted scatter-add (bf16 decode fused).
+        Overridden by the ring collective folder."""
+        # Pad the batch to the next power of two: the scatter-add jits
+        # per batch LENGTH, and chunk arrival makes that length
+        # arbitrary — bucketing bounds the compile count at ~log(max
+        # batch).
+        k = len(batch)
+        kb = 1 << max(k - 1, 0).bit_length()
+        tiles, ws, raw = self._batch_arrays(batch, kb)
+
+        if self.wire == "f32":
+            x = raw.view(np.float32)
+
+            def body(a, x_, t_, w_):
+                return a.at[t_].add(w_[:, None] * x_)
+        else:
+            x = raw.view(np.uint16)
+
+            def body(a, x_, t_, w_):
+                return a.at[t_].add(w_[:, None] * _bf16_widen(x_))
+
+        from jax.sharding import PartitionSpec as P
+
+        fn = self.codec._jit(
+            ("folder_flush", self.wire, kb, self.tile_elems),
+            lambda: self.codec._shard_map(
+                body,
+                (P(None, "codec"), P(None, "codec"), P(), P()),
+                P(None, "codec"),
+                donate_argnums=(0,),
+            ),
+        )
+        with self._lock:
+            if self._host_acc is not None:
+                raise MeshCodecError("folder already degraded")  # -> host()
+            acc = self._device_acc()
+            self._acc = fn(acc, self._put(x), tiles, ws)
+        return True
+
+    def _flush_host(self, batch: List[Tuple[int, float, bytes]]) -> bool:
+        """Host half of flush: the degraded-slice replay — the SAME batch
+        folds with host numpy, committing the in-flight round."""
+        from distributedvolunteercomputing_tpu import native
+
+        with self._lock:
+            self._to_host_locked()
+            acc = self._host_acc
+            for tile, w, data in batch:
+                e0 = tile * self.tile_elems
+                x = self._decode_host(data)
+                native.weighted_sum_inplace(acc[e0 : e0 + x.size], x, w)
+        return True
+
     def flush(self) -> None:
         """Fold every staged chunk (worker-thread context)."""
         batch = self._pop_staged()
         if not batch:
             return
         self.flushes += 1
-
-        def dev() -> bool:
-            # Pad the batch to the next power of two: the scatter-add jits
-            # per batch LENGTH, and chunk arrival makes that length
-            # arbitrary — bucketing bounds the compile count at ~log(max
-            # batch). Padding rows carry weight 0 into tile 0: a no-op fold.
-            k = len(batch)
-            kb = 1 << max(k - 1, 0).bit_length()
-            tiles = np.zeros(kb, np.int32)
-            ws = np.zeros(kb, np.float32)
-            tiles[:k] = [t for t, _, _ in batch]
-            ws[:k] = [w for _, w, _ in batch]
-            row_bytes = self.tile_elems * self.esz
-            raw = np.zeros((kb, row_bytes), np.uint8)
-            for i, (_, _, data) in enumerate(batch):
-                raw[i, : len(data)] = np.frombuffer(data, np.uint8)
-            jnp = _jnp()
-
-            if self.wire == "f32":
-                x = raw.view(np.float32)
-
-                def body(a, x_, t_, w_):
-                    return a.at[t_].add(w_[:, None] * x_)
-            else:
-                x = raw.view(np.uint16)
-
-                def body(a, x_, t_, w_):
-                    return a.at[t_].add(w_[:, None] * _bf16_widen(x_))
-
-            from jax.sharding import PartitionSpec as P
-
-            fn = self.codec._jit(
-                ("folder_flush", self.wire, kb, self.tile_elems),
-                lambda: self.codec._shard_map(
-                    body,
-                    (P(None, "codec"), P(None, "codec"), P(), P()),
-                    P(None, "codec"),
-                    donate_argnums=(0,),
-                ),
-            )
-            with self._lock:
-                if self._host_acc is not None:
-                    raise MeshCodecError("folder already degraded")  # -> host()
-                acc = self._device_acc()
-                self._acc = fn(acc, self._put(x), tiles, ws)
-            return True
-
-        def host() -> bool:
-            from distributedvolunteercomputing_tpu import native
-
-            with self._lock:
-                self._to_host_locked()
-                acc = self._host_acc
-                for tile, w, data in batch:
-                    e0 = tile * self.tile_elems
-                    x = self._decode_host(data)
-                    native.weighted_sum_inplace(acc[e0 : e0 + x.size], x, w)
-            return True
-
-        self.codec._run(dev, host)
+        self.codec._run(
+            lambda: self._flush_dev(batch), lambda: self._flush_host(batch)
+        )
 
     def result(self) -> np.ndarray:
         """Flush the tail and return the flat RAW accumulator [n_elems]
@@ -989,13 +1049,20 @@ def get_default() -> MeshCodec:
     return _default
 
 
-def configure(mesh=None, backend: str = "auto", pallas: Optional[str] = None) -> MeshCodec:
+def configure(
+    mesh=None,
+    backend: str = "auto",
+    pallas: Optional[str] = None,
+    collective: Optional[str] = None,
+) -> MeshCodec:
     """Select THIS volunteer's codec at startup (the per-volunteer
     selection surfaced in stats()): called by the volunteer once its local
     training mesh exists, before the first averaging round."""
     global _default
     with _default_lock:
-        _default = MeshCodec(mesh=mesh, backend=backend, pallas=pallas)
+        _default = MeshCodec(
+            mesh=mesh, backend=backend, pallas=pallas, collective=collective
+        )
     return _default
 
 

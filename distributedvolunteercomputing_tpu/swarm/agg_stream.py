@@ -313,6 +313,10 @@ class StreamingAggregator:
         self.codec = codec if codec is not None else mesh_codec_mod.get_default()
         self._folder: Optional[mesh_codec_mod.MeshMeanFolder] = None
         self.folder_flushes = 0
+        # Captured from the folder before release() drops it, so the gauges
+        # can still say which fold path served a COMMITTED round.
+        self.folder_kind = ""
+        self.ring_flushes = 0
         # Folder staged-bytes high-water, captured before the folder is
         # dropped (summed into the peak gauge: staged raw chunks are real
         # resident memory beside the accumulator).
@@ -322,6 +326,7 @@ class StreamingAggregator:
             self._folder = self.codec.mean_folder(
                 self.n_elems, self.tile_elems, self.n_tiles, wire
             )
+            self.folder_kind = getattr(self._folder, "kind", "")
         elif self.mode == "d2_dense":
             self._d2 = np.zeros((n, n), np.float64)
         # The committed/result buffer is O(D) — except in mean+folder mode,
@@ -1161,6 +1166,9 @@ class StreamingAggregator:
                         self._folder.result(), np.float32
                     )
                     self.folder_flushes = self._folder.flushes
+                    self.ring_flushes = int(
+                        getattr(self._folder, "ring_flushes", 0)
+                    )
                 # Per-tile re-normalization by the weight that ARRIVED: the
                 # deadline-commit re-weighting, applied at tile granularity.
                 for tile in range(self.n_tiles):
@@ -1278,4 +1286,12 @@ class StreamingAggregator:
             # "host" after a mid-round degrade — that IS the signal).
             "codec_backend": self.codec.backend,
             "folder_flushes": int(self.folder_flushes),
+            # "ring" when the fused reduce pipeline (ops.mesh_collective)
+            # carries the mean folds, "staged" for the PR 5 staged path,
+            # "" when the round has no folder (non-mean modes / host codec).
+            # Captured at construction so it survives release().
+            "folder_kind": self.folder_kind,
+            "ring_flushes": int(
+                getattr(folder, "ring_flushes", None) or self.ring_flushes
+            ),
         }

@@ -1751,6 +1751,9 @@ class AveragerBase:
             "tiles_recovered", "hedge_duplicates", "hedge_dropped",
         ):
             agg[k] = agg.get(k, 0) + g[k]
+        agg["ring_flushes"] = agg.get("ring_flushes", 0) + g.get("ring_flushes", 0)
+        if g.get("folder_kind"):
+            agg["folder_kind"] = g["folder_kind"]
         agg["codec_backend"] = g["codec_backend"]
         agg["agg_busy_s"] = round(agg.get("agg_busy_s", 0.0) + g["agg_busy_s"], 6)
         agg["last_busy_frac"] = g["agg_busy_frac"]

@@ -190,6 +190,12 @@ class VolunteerConfig:
     # CPU platforms; "mesh"/"host" force. Selected once at startup,
     # surfaced in stats()["mesh_codec"], degrades to host on slice failure.
     mesh_codec: str = "auto"
+    # Fused ring reduce pipeline for the leader's mean folds
+    # (ops/mesh_collective.py): decode + fold + neighbor-forward in one
+    # pallas grid step over the codec mesh. "auto" selects ring on TPU
+    # silicon with >= 2 codec devices; "ring"/"off" force. Rides the
+    # mesh codec's degraded-slice contract.
+    mesh_collective: str = "auto"
     fsdp: bool = False
     seq_sharded: bool = False
     sp_impl: str = "ring"  # ring | ulysses (all-to-all seq<->heads)
@@ -794,7 +800,11 @@ class Volunteer:
         # lazily, so configuring here covers the averager built earlier).
         from distributedvolunteercomputing_tpu.ops import mesh_codec as mesh_codec_mod
 
-        codec = mesh_codec_mod.configure(mesh=mesh, backend=self.cfg.mesh_codec)
+        codec = mesh_codec_mod.configure(
+            mesh=mesh,
+            backend=self.cfg.mesh_codec,
+            collective=self.cfg.mesh_collective,
+        )
         # Slice-loss degrades land in this volunteer's flight recorder.
         codec.recorder = self.telemetry.recorder
         log.info(

@@ -91,6 +91,12 @@ run_tpu blocking $AVG --no-overlap
 # item's acceptance asks for; the CPU peer keeps the host backend but
 # must share the wire (schema hash).
 CPU_EXTRA="--wire bf16" run_tpu overlap_mesh $AVG --overlap --wire bf16 --mesh-codec mesh
+# Fused ring arm (ISSUE 18): same on-mesh topology with the fused
+# decode+fold+forward ring collective enabled on the TPU volunteer
+# (--mesh-collective ring; it engages when the local mesh has >= 2 devices,
+# and falls back to the staged folder — identical numerics — on one). The
+# overlap_mesh row above is its staged-path control in the same window.
+CPU_EXTRA="--wire bf16" run_tpu overlap_fused $AVG --overlap --wire bf16 --mesh-codec mesh --mesh-collective ring
 CPU_EXTRA=""
 echo "chip_overlap done:"
 cat "$OUT"

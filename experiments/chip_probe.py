@@ -8,11 +8,15 @@ processes, and once passed in a process that compiled smaller configs first.
 This probe IS that smaller-configs-first process: if the warmup-ladder
 hypothesis is right, the gpt2 stage should pass here more often than cold.
 
-Usage: python experiments/chip_probe.py [max_stage]
+Usage:
+    python experiments/chip_probe.py [max_stage]   # staged escalation probe
+    python experiments/chip_probe.py serve         # persistent warm worker
+    python experiments/chip_probe.py ping          # is a worker alive?
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -21,6 +25,44 @@ import time
 # is experiments/ — put the repo root first so the package imports without an
 # install step (the workdir is re-provisioned between rounds).
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_RESULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+
+def _epoch_path() -> str:
+    return os.path.join(_RESULTS_DIR, "backend_epoch.json")
+
+
+def _stamp_epoch(device_kind: str) -> str:
+    """Record that a live backend was observed NOW; return its epoch id.
+
+    The epoch names one continuous stretch of proven backend liveness:
+    re-stamping within DVC_BENCH_EPOCH_TTL keeps the same id (the chip
+    stayed observably alive), past it a fresh id is minted. bench.py's
+    recorded-probe fallback replays a cached measurement only when the
+    record's stamped epoch is still the current, alive one — the BENCH_r02
+    fix, where a 57.5 samples/sec figure cached before a wedge headlined a
+    round whose chip was long dead.
+    """
+    now = time.time()
+    ttl = float(os.environ.get("DVC_BENCH_EPOCH_TTL", "900"))
+    epoch = None
+    try:
+        with open(_epoch_path()) as fh:
+            cur = json.load(fh)
+        if now - float(cur.get("alive_at", 0)) <= ttl and cur.get("epoch"):
+            epoch = cur["epoch"]
+    except (OSError, ValueError, TypeError):
+        pass
+    if epoch is None:
+        epoch = f"{int(now)}-{os.getpid()}"
+    os.makedirs(_RESULTS_DIR, exist_ok=True)
+    tmp = _epoch_path() + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"epoch": epoch, "alive_at": now, "device_kind": device_kind}, fh)
+    os.replace(tmp, _epoch_path())
+    return epoch
+
 
 STAGES = []
 
@@ -39,6 +81,9 @@ def _backend(ctx):
 
     ctx["jax"] = jax
     devs = jax.devices()
+    # Liveness epoch: the backend answered, so the current alive-window
+    # extends through NOW (see _stamp_epoch / bench.py _recorded_probe).
+    _stamp_epoch(devs[0].device_kind)
     return f"{devs[0].device_kind} x{len(devs)}"
 
 
@@ -98,10 +143,67 @@ def _gpt2_init(ctx):
     return f"{n / 1e6:.1f}M params"
 
 
+def _timed_loop(step, st, batch, iters):
+    """The bench hot loop: `iters` compiled steps, scalar-materialized at
+    the end (host copy surfaces deferred OOM; block_until_ready may not)."""
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        st, m = step(st, batch)
+    loss = float(m["loss"])
+    return st, loss, time.perf_counter() - t0
+
+
+def _bench_payload(jax, bundle, n_params, batch_size, sps, loss, source,
+                   model_name="gpt2_small"):
+    """Bench-grade record shared by the gpt2_small_step stage and the warm
+    worker — identical shape so bench.py's consumers can't tell them apart
+    except by the `source` line and the liveness epoch stamp."""
+    device_kind = jax.devices()[0].device_kind
+    payload = {
+        "metric": f"samples/sec/volunteer-chip ({model_name}, bs={batch_size})",
+        "value": round(sps, 3),
+        "unit": "samples/sec/chip",
+        "batch_size": batch_size,
+        "n_params": n_params,
+        "device_kind": device_kind,
+        "loss": round(loss, 4),
+        "source": source,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    seq_len = getattr(bundle.config, "max_len", None)
+    if seq_len:
+        payload["tokens_per_sec_chip"] = round(sps * seq_len, 1)
+        # est_mfu via the same 6ND convention as bench.py (lower bound:
+        # remat recompute not counted). Repo root is on sys.path already.
+        try:
+            from bench import _peak_flops
+
+            peak = _peak_flops(device_kind)
+            if peak:
+                payload["est_mfu"] = round(
+                    6.0 * n_params * payload["tokens_per_sec_chip"] / peak, 4
+                )
+        except Exception:
+            pass
+    # The measurement itself is proof of backend liveness: stamp the epoch
+    # and tie the record to it, so a future round can tell "this backend,
+    # still alive" from "a number cached before the chip wedged".
+    payload["backend_epoch"] = _stamp_epoch(device_kind)
+    return payload
+
+
+def _write_probe_record(payload) -> str:
+    os.makedirs(_RESULTS_DIR, exist_ok=True)
+    out = os.path.join(_RESULTS_DIR, "tpu_probe_success.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, out)
+    return out
+
+
 @stage("gpt2_small_step")
 def _gpt2_step(ctx):
-    import json
-
     jax = ctx["jax"]
     from distributedvolunteercomputing_tpu.training.optim import make_optimizer
     from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
@@ -113,46 +215,18 @@ def _gpt2_step(ctx):
     step = make_train_step(b.loss_fn, tx)
     batch_size = 8
     batch = b.make_batch(jax.random.PRNGKey(0), batch_size)
-    for _ in range(3):
-        st, m = step(st, batch)
-    loss = float(m["loss"])  # materialize: surfaces deferred OOM before timing
+    st, _, _ = _timed_loop(step, st, batch, 3)  # warmup + deferred-OOM check
     iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        st, m = step(st, batch)
-    loss = float(m["loss"])
-    dt = time.perf_counter() - t0
+    st, loss, dt = _timed_loop(step, st, batch, iters)
     sps = batch_size * iters / dt
     # A full bench-grade measurement in the process that proved the chip
     # alive: record it so the round has a real TPU number even if the chip
     # wedges again before the driver's end-of-round bench.py run.
-    payload = {
-        "metric": f"samples/sec/volunteer-chip (gpt2_small, bs={batch_size})",
-        "value": round(sps, 3),
-        "unit": "samples/sec/chip",
-        "batch_size": batch_size,
-        "n_params": n_params,
-        "device_kind": jax.devices()[0].device_kind,
-        "loss": round(loss, 4),
-        "tokens_per_sec_chip": round(sps * b.config.max_len, 1),
-        "source": "experiments/chip_probe.py (staged warm-up ladder)",
-        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-    }
-    # est_mfu via the same 6ND convention as bench.py (lower bound: remat
-    # recompute not counted). Repo root is already on sys.path (module top).
-    try:
-        from bench import _peak_flops
-
-        peak = _peak_flops(jax.devices()[0].device_kind)
-        if peak:
-            payload["est_mfu"] = round(
-                6.0 * n_params * payload["tokens_per_sec_chip"] / peak, 4
-            )
-    except Exception:
-        pass
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results", "tpu_probe_success.json")
-    with open(out, "w") as fh:
-        json.dump(payload, fh)
+    payload = _bench_payload(
+        jax, b, n_params, batch_size, sps, loss,
+        source="experiments/chip_probe.py (staged warm-up ladder)",
+    )
+    out = _write_probe_record(payload)
     return f"loss={loss:.3f} {sps:.2f} samples/s -> {out}"
 
 
@@ -239,7 +313,224 @@ def _attn_ab(ctx):
     return f"{summary} -> {out_path}"
 
 
+# ------------------------------------------------- persistent warm worker ----
+
+_DEFAULT_SOCK = "/tmp/dvc_warm_backend.sock"
+
+
+def _sock_path() -> str:
+    return os.environ.get("DVC_BENCH_WORKER_SOCK", _DEFAULT_SOCK)
+
+
+def request_worker(req: dict, timeout: float = 10.0) -> dict | None:
+    """Client half: one JSON-line request to the warm worker, or None on any
+    miss (no socket, wedged worker, garbage reply). Imports nothing heavy —
+    bench.py calls this BEFORE deciding whether to pay the fresh-child
+    ladder, so it must stay cheap and side-effect free."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        s.connect(_sock_path())
+        s.sendall((json.dumps(req) + "\n").encode())
+        raw = b""
+        while not raw.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            raw += chunk
+        s.close()
+        return json.loads(raw.decode() or "null")
+    except (OSError, ValueError):
+        return None
+
+
+def ping_worker() -> int:
+    resp = request_worker({"cmd": "ping"}, timeout=10.0)
+    print(json.dumps(resp or {"ok": False, "error": "no worker"}))
+    return 0 if resp and resp.get("ok") else 1
+
+
+class WarmBackendWorker:
+    """Long-lived bench server: pay backend init + the flagship XLA compile
+    ONCE, then serve bench requests over a unix socket for the rest of the
+    round.
+
+    Motivation (BENCH_r01..r03): the dominant cost AND the dominant failure
+    mode both live in cold start — backend init raises or hangs, the
+    flagship compile takes tens of seconds, and the same config passes in a
+    process that compiled smaller programs first. A worker that rode out one
+    successful warm-up is the best place to take the round-end measurement:
+    the compiled step is cached, so a bench request is just the timed hot
+    loop (~seconds), taken NOW, on a backend that is provably alive.
+
+    Protocol: one JSON line per connection at DVC_BENCH_WORKER_SOCK.
+      {"cmd": "ping"}               -> {"ok": true, "epoch", "device_kind", "model"}
+      {"cmd": "bench", "iters": N}  -> {"ok": true, "payload": <bench record>}
+    Liveness: every served request re-stamps results/backend_epoch.json and
+    an idle heartbeat re-stamps every DVC_WORKER_HEARTBEAT (120s), so cached
+    probe records stay epoch-current exactly as long as the worker is
+    healthy. Self-watchdog: a request still in flight past
+    DVC_WORKER_REQ_DEADLINE (420s) means the backend wedged mid-request —
+    the worker os._exit(3)s so window_watcher.sh's cold-restart line can
+    replace it instead of banking silence.
+    """
+
+    def __init__(self, model_name: str = "gpt2_small", batch_size: int = 8):
+        self.model_name = model_name
+        self.batch_size = batch_size
+        self._busy_since: float | None = None
+
+    def warm(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from distributedvolunteercomputing_tpu.models import get_model
+        from distributedvolunteercomputing_tpu.training.optim import make_optimizer
+        from distributedvolunteercomputing_tpu.training.steps import TrainState, make_train_step
+
+        self.jax = jax
+        self.device_kind = jax.devices()[0].device_kind
+        self.epoch = _stamp_epoch(self.device_kind)
+        # r03 warm-up ladder: a small compile first raises the flagship's
+        # odds on this chip (judge-bisected, see module docstring).
+        x = jnp.ones((1024, 1024), dtype=jnp.bfloat16)
+        float((x @ x).sum())
+        b = get_model(self.model_name)
+        tx = make_optimizer("adamw", lr=1e-4)
+        params = b.init(jax.random.PRNGKey(1))
+        self.n_params = sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+        st = TrainState.create(params, tx, jax.random.PRNGKey(2))
+        del params  # donated into the first step
+        step = make_train_step(b.loss_fn, tx)
+        batch = b.make_batch(jax.random.PRNGKey(0), self.batch_size)
+        st, loss, _ = _timed_loop(step, st, batch, 3)  # compile + deferred-OOM check
+        self.bundle, self.step, self.state, self.batch = b, step, st, batch
+        self.epoch = _stamp_epoch(self.device_kind)
+        print(
+            f"warm-worker: compiled step cached ({self.n_params / 1e6:.1f}M params, "
+            f"{self.device_kind}, warm loss={loss:.3f})",
+            flush=True,
+        )
+
+    def handle(self, req: dict) -> dict:
+        cmd = req.get("cmd", "ping")
+        if cmd == "ping":
+            self.epoch = _stamp_epoch(self.device_kind)
+            return {
+                "ok": True,
+                "epoch": self.epoch,
+                "device_kind": self.device_kind,
+                "model": self.model_name,
+                "batch_size": self.batch_size,
+            }
+        if cmd == "bench":
+            iters = max(int(req.get("iters", 20)), 1)
+            self.state, loss, dt = _timed_loop(self.step, self.state, self.batch, iters)
+            sps = self.batch_size * iters / dt
+            payload = _bench_payload(
+                self.jax, self.bundle, self.n_params, self.batch_size, sps, loss,
+                source="experiments/chip_probe.py (persistent warm worker)",
+                model_name=self.model_name,
+            )
+            # Keep the on-disk record fresh too: if the chip wedges between
+            # this request and the round-end bench, the replay fallback now
+            # holds THIS measurement, stamped with a still-alive epoch.
+            _write_probe_record(payload)
+            return {"ok": True, "payload": payload}
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+    def serve(self) -> int:
+        import socket
+        import threading
+
+        deadline = float(os.environ.get("DVC_WORKER_REQ_DEADLINE", "420"))
+
+        def _watchdog():
+            while True:
+                time.sleep(5.0)
+                busy = self._busy_since
+                if busy is not None and time.monotonic() - busy > deadline:
+                    print(
+                        f"warm-worker: request wedged past {deadline:.0f}s; "
+                        "exiting hard for a cold restart",
+                        flush=True,
+                    )
+                    os._exit(3)
+
+        threading.Thread(target=_watchdog, daemon=True).start()
+
+        try:
+            self.warm()
+        except Exception as err:
+            print(
+                f"warm-worker FAIL warm-up: {type(err).__name__}: {str(err)[:300]}",
+                flush=True,
+            )
+            return 1
+
+        def _heartbeat():
+            hb = max(float(os.environ.get("DVC_WORKER_HEARTBEAT", "120")), 1.0)
+            while True:
+                time.sleep(hb)
+                if self._busy_since is None:
+                    try:
+                        # A heartbeat is an assertion the backend ANSWERS, not
+                        # just that this process exists: a trivial device op
+                        # must complete before the epoch may be extended.
+                        float(self.jax.numpy.zeros(()) + 1.0)
+                        self.epoch = _stamp_epoch(self.device_kind)
+                    except Exception:
+                        print("warm-worker: heartbeat device op failed; exiting", flush=True)
+                        os._exit(3)
+
+        threading.Thread(target=_heartbeat, daemon=True).start()
+
+        path = _sock_path()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(1)
+        print(f"warm-worker: serving on {path} (epoch {self.epoch})", flush=True)
+        while True:
+            conn, _ = srv.accept()
+            with conn:
+                resp: dict
+                try:
+                    conn.settimeout(10.0)
+                    raw = b""
+                    while not raw.endswith(b"\n"):
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        raw += chunk
+                    self._busy_since = time.monotonic()
+                    resp = self.handle(json.loads(raw.decode() or "{}"))
+                except Exception as err:
+                    resp = {
+                        "ok": False,
+                        "error": f"{type(err).__name__}: {str(err)[:300]}",
+                    }
+                finally:
+                    self._busy_since = None
+                try:
+                    conn.sendall((json.dumps(resp) + "\n").encode())
+                except OSError:
+                    pass
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        return WarmBackendWorker(
+            model_name=os.environ.get("DVC_BENCH_MODEL", "gpt2_small"),
+            batch_size=int(os.environ.get("DVC_BENCH_BATCH", "8")),
+        ).serve()
+    if len(sys.argv) > 1 and sys.argv[1] == "ping":
+        return ping_worker()
     max_stage = int(sys.argv[1]) if len(sys.argv) > 1 else len(STAGES)
     ctx: dict = {}
     t_start = time.monotonic()

@@ -205,6 +205,117 @@ def run_config(n_peers: int, payload_mb: float, method: str,
     }
 
 
+def _feed_mean_folder(folder, bits, weights, tile, n_tiles):
+    total_w = float(weights.sum())
+    for p in range(bits.shape[0]):
+        raw = bits[p]
+        for t in range(n_tiles):
+            e0 = t * tile
+            if folder.add(t, float(weights[p]) / total_w,
+                          raw[e0 : e0 + tile].tobytes()):
+                folder.flush()
+    return folder.result()
+
+
+def _assert_ring_interpret_equivalence(mesh, n_devices: int) -> None:
+    """Correctness half of the fused-arm contract: the PALLAS ring kernel
+    (interpret mode — the exact grid schedule and DMA descriptors the
+    silicon path compiles) must match the host fold bit-for-bit at a small
+    shape. The throughput arms below run the xla lowering; this pins the
+    kernel itself inside the same bench run."""
+    from distributedvolunteercomputing_tpu import native
+    from distributedvolunteercomputing_tpu.ops import mesh_codec
+
+    codec = mesh_codec.MeshCodec(
+        mesh=mesh, backend="mesh", pallas="interpret", collective="ring"
+    )
+    tile, n_tiles = 256 * n_devices, 4
+    n_elems = tile * n_tiles
+    folder = codec.mean_folder(n_elems, tile, n_tiles, "bf16")
+    assert folder.kind == "ring", f"ring folder not selected: {folder.kind}"
+    # Pin the pallas interpret lowering regardless of DVC_RING_LOWER.
+    folder._lower_cfg, folder._eager = "interpret", False
+    rng = np.random.default_rng(3)
+    weights = rng.uniform(0.2, 1.0, 3)
+    bits = np.stack(
+        [native.f32_to_bf16(rng.standard_normal(n_elems).astype(np.float32))
+         for _ in range(3)]
+    )
+    got = _feed_mean_folder(folder, bits, weights, tile, n_tiles)
+    ref = np.zeros(n_elems, np.float32)
+    total_w = float(weights.sum())
+    for p in range(3):
+        native.weighted_sum_inplace(
+            ref, native.bf16_to_f32(bits[p]), float(weights[p]) / total_w
+        )
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-5)
+    assert not codec.degraded, f"interpret ring degraded: {codec.degrade_reason}"
+
+
+def run_fused_config(n_peers: int, payload_mb: float,
+                     chunk_bytes: int = CHUNK_BYTES, repeats: int = 2) -> dict:
+    """The fused-pipeline arm (ISSUE 18): ring collective folder
+    (ops/mesh_collective.py) vs the PR 5 staged folder, BOTH on the same
+    multi-device mesh — the mean fold is the only phase that differs, so
+    the ratio isolates the fused reduce pipeline. Returns None on a
+    1-device mesh, where the ring degenerates to the plain fold and the
+    comparison measures nothing."""
+    import jax
+
+    from distributedvolunteercomputing_tpu import native
+    from distributedvolunteercomputing_tpu.ops import mesh_codec
+    from distributedvolunteercomputing_tpu.parallel.mesh import make_mesh
+
+    n_devices = len(jax.devices())
+    tile = chunk_bytes // 2
+    if n_devices < 2 or tile % n_devices:
+        return None
+    mesh = make_mesh(dp=n_devices)
+    _assert_ring_interpret_equivalence(mesh, n_devices)
+
+    n_elems = int(payload_mb * (1 << 20)) // 4
+    n_tiles = -(-n_elems // tile)
+    rng = np.random.default_rng(0)
+    weights = rng.uniform(0.5, 2.0, n_peers)
+    bits = np.stack(
+        [native.f32_to_bf16(rng.standard_normal(n_elems).astype(np.float32))
+         for _ in range(n_peers)]
+    )
+    staged = mesh_codec.MeshCodec(mesh=mesh, backend="mesh", collective="off")
+    ring = mesh_codec.MeshCodec(mesh=mesh, backend="mesh", collective="ring")
+
+    def fold(codec):
+        folder = codec.mean_folder(n_elems, tile, n_tiles, "bf16")
+        return _feed_mean_folder(folder, bits, weights, tile, n_tiles)
+
+    # Warm both jit caches AND check xla-lowering equivalence in-bench.
+    ref = fold(staged)
+    np.testing.assert_allclose(fold(ring), ref, rtol=2e-5, atol=1e-5)
+    src = native.bf16_to_f32(bits[0])
+    encode_s = _best_of(lambda: ring.encode_bf16(src), repeats)
+    staged_s = _best_of(lambda: fold(staged), repeats)
+    ring_s = _best_of(lambda: fold(ring), repeats)
+    ring_folder = ring.mean_folder(n_elems, tile, n_tiles, "bf16")
+    row = {
+        "n_peers": n_peers,
+        "payload_mb": payload_mb,
+        "devices": n_devices,
+        "ring_lower": ring_folder._lower_cfg,
+        "encode_s": round(encode_s, 6),
+        "staged_fold_s": round(staged_s, 6),
+        "ring_fold_s": round(ring_s, 6),
+        "staged_mb_s": round(payload_mb * n_peers / max(staged_s, 1e-9), 1),
+        "ring_mb_s": round(payload_mb * n_peers / max(ring_s, 1e-9), 1),
+        "ratios": {
+            "fold": round(staged_s / max(ring_s, 1e-9), 2),
+            "encode_fold": round(
+                (encode_s + staged_s) / max(encode_s + ring_s, 1e-9), 2
+            ),
+        },
+    }
+    return row
+
+
 def run_bench(peers=(8, 16), payloads_mb=(8, 64), methods=("mean", "trimmed_mean"),
               chunk_bytes: int = CHUNK_BYTES, repeats: int = 2) -> dict:
     import jax
@@ -230,6 +341,25 @@ def run_bench(peers=(8, 16), payloads_mb=(8, 64), methods=("mean", "trimmed_mean
                     f"combined {row['ratios']['encode_fold']}x",
                     flush=True,
                 )
+    fused_rows = []
+    for mb in payloads_mb:
+        row = run_fused_config(max(peers), mb, chunk_bytes, repeats)
+        if row is None:
+            print("fused arm skipped: 1-device mesh (ring degenerates to "
+                  "the plain fold)", flush=True)
+            break
+        fused_rows.append(row)
+        marker = "" if row["ratios"]["fold"] >= 1.0 else \
+            "  ** BELOW STAGED FLOOR **"
+        print(
+            f"fused        n={row['n_peers']:2d} {mb:3g}MB  "
+            f"fold {row['staged_fold_s']*1e3:8.1f}ms -> "
+            f"{row['ring_fold_s']*1e3:8.1f}ms "
+            f"({row['ratios']['fold']}x vs staged, "
+            f"{row['devices']} devices, {row['ring_lower']} lowering)"
+            f"{marker}",
+            flush=True,
+        )
     return {
         "bench": "swarm_codec_host_vs_mesh",
         "host": platform.node(),
@@ -241,6 +371,9 @@ def run_bench(peers=(8, 16), payloads_mb=(8, 64), methods=("mean", "trimmed_mean
         "native_available": native.available(),
         "codec": codec.stats(),
         "rows": rows,
+        # staged-vs-ring on the same mesh; [] when 1-device made the
+        # comparison meaningless (never silently measured-as-tied).
+        "fused_rows": fused_rows,
     }
 
 
@@ -248,11 +381,15 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--quick", action="store_true", help="small sanity run")
     ap.add_argument("--out", default=os.path.join(RESULTS, "codec_bench.json"))
+    ap.add_argument("--devices", type=int, default=8,
+                    help="force at least N host (CPU) devices so the fused "
+                         "ring arm has a real mesh to reduce over; ignored "
+                         "on platforms with native multi-chip (0 = off)")
     args = ap.parse_args()
     # The bench compares backends, not platforms: run the mesh arm on
     # whatever jax platform is active (CPU in the sandbox, the TPU slice
     # on hardware) and say which in the artifact.
-    pin_platform(None)
+    pin_platform(None, min_host_devices=args.devices or None)
     from distributedvolunteercomputing_tpu import native
 
     native.ensure_built()

@@ -61,6 +61,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "mesh_collective: fused ring reduce-scatter/all-gather tests "
+        "(interpret-mode kernel equivalence vs host/staged folds, eager "
+        "xla ingest, NaN propagation, mid-round degrade, aggregator "
+        "parity, fused bench smoke) — in the default lane, and selectable "
+        "on their own with -m mesh_collective",
+    )
+    config.addinivalue_line(
+        "markers",
         "multigroup: rotating multi-group schedule tests (grid partition, "
         "Moshpit mixing bound, group-scoped rounds, group-local failover, "
         "per-group stats rollups, scale-bench smoke) — in the default "

@@ -1,0 +1,334 @@
+"""Fused ring collective (ops.mesh_collective): the ISSUE-18 contract.
+
+Covers:
+- folder selection: the ring folder engages only where it can win (bf16
+  wire, >= 2 devices, divisible tiles) and degenerates to the staged
+  folder — identical numerics — everywhere else;
+- interpret-mode bit-equivalence of the fused decode+fold+forward ring
+  kernel against the host fold AND the staged device folder, across tile
+  shapes x n_devices in {2, 8} x partial-participation weights (zero
+  weights, ragged tails);
+- the xla lowering (eager per-chunk ingest, the CPU-bench path) against
+  the same references, so interpret and xla can never drift apart;
+- NaN handling: mean folds PROPAGATE NaN exactly like the host fold, and
+  the window sorting-network guard (NaN -> +inf, PR-5) is unaffected by
+  the collective being enabled;
+- the degraded-slice contract: a device failure mid-round (between
+  flushes, or at the final gather) replays on host and the round commits
+  without losing folded mass;
+- StreamingAggregator parity end-to-end with the ring folder underneath,
+  plus the folder_kind/ring_flushes gauges;
+- a small-shape fused-bench floor smoke (experiments/codec_bench.py
+  run_fused_config): the fused path must not fall below the staged path
+  at bench-representative payloads on the 8-virtual-device mesh.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu import native
+from distributedvolunteercomputing_tpu.ops import mesh_codec, robust
+from distributedvolunteercomputing_tpu.parallel.mesh import make_mesh
+from distributedvolunteercomputing_tpu.swarm.agg_stream import (
+    StreamingAggregator,
+    TilePool,
+)
+
+pytestmark = pytest.mark.mesh_collective
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.default_rng(18)
+
+
+def _ring_codec(n_devices, pallas=None):
+    import jax
+
+    if len(jax.devices()) < n_devices:
+        pytest.skip(f"needs {n_devices} devices")
+    return mesh_codec.MeshCodec(
+        mesh=make_mesh(dp=n_devices), backend="mesh", pallas=pallas,
+        collective="ring",
+    )
+
+
+def _host_ref(bufs, weights, n_elems):
+    ref = np.zeros(n_elems, np.float32)
+    for p in range(len(bufs)):
+        bits = native.f32_to_bf16(bufs[p])
+        native.weighted_sum_inplace(ref, native.bf16_to_f32(bits), float(weights[p]))
+    return ref
+
+
+def _feed(folder, bufs, weights, tile, n_elems):
+    for p in range(bufs.shape[0]):
+        bits = native.f32_to_bf16(bufs[p])
+        for e0 in range(0, n_elems, tile):
+            n = min(tile, n_elems - e0)
+            if folder.add(e0 // tile, float(weights[p]), bits[e0 : e0 + n].tobytes()):
+                folder.flush()
+
+
+class TestFolderSelection:
+    def test_one_device_falls_back_to_staged(self):
+        # MeshCodec() without a mesh pins ONE device: a 1-ring has nothing
+        # to forward to, and the staged folder IS the degenerate plain fold.
+        c = mesh_codec.MeshCodec(backend="mesh", collective="ring")
+        folder = c.mean_folder(8192, 2048, 4, "bf16")
+        assert folder is not None and folder.kind == "staged"
+
+    def test_two_devices_select_ring(self):
+        c = _ring_codec(2)
+        folder = c.mean_folder(8192, 2048, 4, "bf16")
+        assert folder is not None and folder.kind == "ring"
+        assert c.stats()["collective"] == "ring"
+
+    def test_f32_wire_stays_staged(self):
+        # The ring decodes bf16 on device; the f32 wire keeps the staged
+        # folder (no decode to fuse, nothing to win).
+        c = _ring_codec(2)
+        folder = c.mean_folder(8192, 2048, 4, "f32")
+        assert folder is not None and folder.kind == "staged"
+
+    def test_collective_off_stays_staged(self):
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        c = mesh_codec.MeshCodec(
+            mesh=make_mesh(dp=2), backend="mesh", collective="off"
+        )
+        folder = c.mean_folder(8192, 2048, 4, "bf16")
+        assert folder is not None and folder.kind == "staged"
+
+
+class TestRingEquivalence:
+    """The fused kernel against the host fold and the staged device folder.
+
+    Weights include a ZERO (a peer that joined but contributed nothing —
+    partial participation) and non-uniform values; n_elems leaves a ragged
+    tail so short-chunk zero-padding is always exercised."""
+
+    CONFIGS = [  # (tile, n_tiles, n_elems): ragged tails on purpose
+        (2048, 4, 8000),
+        (1024, 3, 3010),
+        (512, 7, 3500),
+    ]
+    WEIGHTS = [0.5, 1.75, 0.0, 2.25, 1.0]
+
+    @pytest.mark.parametrize("n_devices", [2, 8])
+    @pytest.mark.parametrize("tile,n_tiles,n_elems", CONFIGS)
+    def test_interpret_matches_host_and_staged(
+        self, np_rng, n_devices, tile, n_tiles, n_elems
+    ):
+        if tile % n_devices:
+            pytest.skip("tile not divisible by device count")
+        bufs = np_rng.standard_normal((5, n_elems)).astype(np.float32)
+        c = _ring_codec(n_devices, pallas="interpret")
+        folder = c.mean_folder(n_elems, tile, n_tiles, "bf16")
+        assert folder.kind == "ring" and folder._lower_cfg == "interpret"
+        _feed(folder, bufs, self.WEIGHTS, tile, n_elems)
+        got = folder.result()
+        ref = _host_ref(bufs, self.WEIGHTS, n_elems)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        assert folder.ring_flushes >= 1 and not c.degraded
+        # Staged folder on the SAME mesh (collective off): the two device
+        # paths must agree with each other, not just with the host.
+        c2 = mesh_codec.MeshCodec(
+            mesh=make_mesh(dp=n_devices), backend="mesh", collective="off"
+        )
+        staged = c2.mean_folder(n_elems, tile, n_tiles, "bf16")
+        assert staged.kind == "staged"
+        _feed(staged, bufs, self.WEIGHTS, tile, n_elems)
+        np.testing.assert_allclose(got, staged.result(), rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("n_devices", [2, 8])
+    def test_xla_lowering_matches_interpret(self, np_rng, n_devices):
+        """The eager-ingest xla lowering (CPU bench path) and the interpret
+        kernel must produce the same fold — drift here would make the bench
+        measure a different computation than the kernel ships."""
+        tile, n_tiles, n_elems = 1024, 4, 4000
+        bufs = np_rng.standard_normal((3, n_elems)).astype(np.float32)
+        ws = [1.0, 0.25, 2.0]
+        outs = {}
+        for pallas, lower in ((None, "xla"), ("interpret", "interpret")):
+            c = _ring_codec(n_devices, pallas=pallas)
+            folder = c.mean_folder(n_elems, tile, n_tiles, "bf16")
+            assert folder.kind == "ring" and folder._lower_cfg == lower
+            _feed(folder, bufs, ws, tile, n_elems)
+            outs[lower] = folder.result()
+        ref = _host_ref(bufs, ws, n_elems)
+        np.testing.assert_allclose(outs["xla"], ref, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(outs["interpret"], ref, rtol=1e-5, atol=1e-6)
+
+    def test_eager_short_tail_chunk_pads_with_zeros(self, np_rng):
+        """xla lowering stages chunks on device AT ARRIVAL: a short tail
+        chunk must zero-pad to a full tile there too (zeros fold
+        harmlessly), not just in the staged-batch path."""
+        tile, n_tiles, n_elems = 1024, 2, 1030  # tail chunk = 6 elems
+        c = _ring_codec(2)
+        folder = c.mean_folder(n_elems, tile, n_tiles, "bf16")
+        assert folder._eager
+        bufs = np_rng.standard_normal((2, n_elems)).astype(np.float32)
+        _feed(folder, bufs, [1.0, 3.0], tile, n_elems)
+        ref = _host_ref(bufs, [1.0, 3.0], n_elems)
+        np.testing.assert_allclose(folder.result(), ref, rtol=1e-5, atol=1e-6)
+
+
+class TestNaNHandling:
+    def test_mean_fold_propagates_nan_like_host(self, np_rng):
+        """The fused fold is a weighted sum: a NaN contribution must poison
+        exactly the coordinates the host fold poisons — no more (kernel
+        scribbling), no fewer (NaN silently flushed to zero)."""
+        tile, n_tiles, n_elems = 1024, 4, 4096
+        bufs = np_rng.standard_normal((3, n_elems)).astype(np.float32)
+        bufs[1, 100:200] = np.nan  # one peer, one poisoned span
+        ws = [1.0, 1.0, 0.5]
+        for pallas in (None, "interpret"):
+            c = _ring_codec(2, pallas=pallas)
+            folder = c.mean_folder(n_elems, tile, n_tiles, "bf16")
+            _feed(folder, bufs, ws, tile, n_elems)
+            got = folder.result()
+            ref = _host_ref(bufs, ws, n_elems)
+            assert np.array_equal(np.isnan(got), np.isnan(ref))
+            finite = ~np.isnan(ref)
+            np.testing.assert_allclose(got[finite], ref[finite], rtol=1e-5, atol=1e-6)
+
+    def test_window_sorting_network_guard_unaffected(self, np_rng):
+        """The PR-5 guard (NaN -> +inf before the sorting network, so a
+        NaN-filled byzantine row is trimmed like the host drops it) lives
+        in aggregate(); enabling the ring collective must not change it."""
+        c = _ring_codec(2)
+        stack = np_rng.standard_normal((6, 4099)).astype(np.float32)
+        stack[2] = np.nan
+        got = c.aggregate(stack, "trimmed_mean", trim=1)
+        ref = robust.aggregate(stack, "trimmed_mean", trim=1)
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+
+class TestDegrade:
+    @pytest.mark.parametrize("pallas", [None, "interpret"])
+    def test_mid_round_failure_degrades_without_losing_mass(self, np_rng, pallas):
+        """First device failure between flushes -> host replay: the already-
+        folded device mass survives, the failed batch refolds from the
+        staged host bytes, and the round commits."""
+        tile, n_tiles, n_elems = 2048, 4, 8192
+        c = _ring_codec(2, pallas=pallas)
+        folder = c.mean_folder(n_elems, tile, n_tiles, "bf16")
+        assert folder.kind == "ring"
+        bufs = np_rng.standard_normal((2, n_elems)).astype(np.float32)
+        # Peer 0 folds on device...
+        _feed(folder, bufs[:1], [1.0], tile, n_elems)
+        folder.flush()
+        assert not c.degraded
+        # ...the slice dies; peer 1 must fold through the host replay.
+        c.inject_failure(1)
+        _feed(folder, bufs[1:], [2.0], tile, n_elems)
+        folder.flush()
+        assert c.degraded
+        ref = _host_ref(bufs, [1.0, 2.0], n_elems)
+        np.testing.assert_allclose(folder.result(), ref, rtol=1e-5, atol=1e-6)
+        assert c.stats()["fallbacks"] == 1
+
+    def test_failure_at_final_gather_still_commits(self, np_rng):
+        """The all-gather in result() is inside the degrade contract too:
+        a failure there replays the whole round on host."""
+        tile, n_tiles, n_elems = 1024, 4, 4096
+        c = _ring_codec(2, pallas="interpret")
+        folder = c.mean_folder(n_elems, tile, n_tiles, "bf16")
+        bufs = np_rng.standard_normal((2, n_elems)).astype(np.float32)
+        _feed(folder, bufs, [1.0, 0.5], tile, n_elems)
+        folder.flush()
+        c.inject_failure(1)
+        out = folder.result()  # gather fails -> host replay
+        assert c.degraded
+        ref = _host_ref(bufs, [1.0, 0.5], n_elems)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestAggregatorParity:
+    def test_streaming_round_with_ring_matches_host(self, np_rng):
+        n_peers, n_elems, chunk = 4, 24000, 1 << 14
+        bufs = np_rng.standard_normal((n_peers, n_elems)).astype(np.float32)
+        ws = np_rng.uniform(0.5, 2.0, n_peers)
+
+        async def one(c):
+            peers = [f"p{i}" for i in range(n_peers)]
+            agg = StreamingAggregator(
+                n_elems, peers, "mean", "bf16", chunk,
+                kw_fn=lambda n: {}, pool=TilePool(), codec=c,
+            )
+            wires = [native.f32_to_bf16(bufs[p]).tobytes() for p in range(n_peers)]
+            sinks = [
+                agg.make_sink(peers[p], float(ws[p]), n_elems * 2)
+                for p in range(n_peers)
+            ]
+            for off in range(0, n_elems * 2, chunk):
+                for p in range(n_peers):
+                    sinks[p](off, n_elems * 2, wires[p][off : off + chunk])
+                await asyncio.sleep(0)
+            for s in sinks:
+                s.close(True)
+            out = await agg.finalize(peers)
+            return out, agg.gauges()
+
+        ring_out, ring_g = run(one(_ring_codec(2)))
+        host_out, host_g = run(one(mesh_codec.MeshCodec(backend="host")))
+        np.testing.assert_allclose(ring_out, host_out, rtol=2e-5, atol=1e-5)
+        # The gauges must say WHICH folder served the round: a silent
+        # fall-back to staged would otherwise pass every numeric check.
+        assert ring_g["folder_kind"] == "ring"
+        assert ring_g["ring_flushes"] >= 1
+        assert host_g["folder_kind"] in ("", "staged")
+
+
+class TestFusedBenchSmoke:
+    """The ISSUE's acceptance floor at test scale: the fused arm must not
+    fall below the staged path on the 8-virtual-device mesh at a payload
+    big enough to amortize per-chunk ingest (small payloads legitimately
+    favor staged batching — the bench prints those rows honestly)."""
+
+    def test_fused_not_slower_than_staged(self, eight_devices):
+        from experiments.codec_bench import run_fused_config
+
+        # Best-of-3 on the ratio, early exit at parity: the first row pays
+        # every jit compile, and inside the full suite's process the timing
+        # inherits allocator/cache state from hundreds of earlier tests.
+        # The clean-process margin at this payload is ~1.14x; the 0.95
+        # floor is parity-within-jitter — losing the fused overlap (eager
+        # per-chunk with no decode/fold/forward fusion) lands near the
+        # 2 MB honesty rows at ~0.8x and still fails loudly.
+        ratio, rows = 0.0, []
+        for _ in range(3):
+            row = run_fused_config(8, 8.0, repeats=2)
+            assert row is not None
+            rows.append(row)
+            ratio = max(ratio, row["ratios"]["fold"])
+            if ratio >= 1.0:
+                break
+        assert ratio >= 0.95, (
+            f"fused ring fold fell below the staged floor: {ratio}x "
+            f"(need >= 0.95x best-of-3) — {rows[-1]}"
+        )
+
+    def test_fused_config_skips_on_one_device(self, monkeypatch):
+        import jax
+
+        from experiments.codec_bench import run_fused_config
+
+        monkeypatch.setattr(jax, "devices", lambda *a, **k: [object()])
+        assert run_fused_config(4, 1.0) is None
+
+    def test_fused_config_skips_on_indivisible_tile(self):
+        from experiments.codec_bench import run_fused_config
+
+        # tile = chunk_bytes // 2 = 7 elems: not divisible by any ndev >= 2.
+        assert run_fused_config(4, 1.0, chunk_bytes=14) is None
