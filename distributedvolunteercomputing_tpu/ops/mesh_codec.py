@@ -197,6 +197,16 @@ class MeshCodec:
         self.ops_host = 0
         self.fallbacks = 0
         self.device_s = 0.0
+        # Ring-lowering gauges, written by RingMeanFolder: the configured
+        # lowering, the last lowering actually used, and how many flushes
+        # were quietly re-lowered to xla by the VMEM estimate. Without
+        # these a fleet pinned to xla by DVC_RING_VMEM_MB (or a mis-sized
+        # estimate) is indistinguishable from one running the kernel.
+        self.ring_lower: Optional[str] = None
+        self.ring_lower_effective: Optional[str] = None
+        self.ring_lower_fallback: Optional[str] = None
+        self.ring_vmem_fallbacks = 0
+        self._ring_vmem_warned = False
         self._pallas_mode = self._resolve_pallas(pallas)
         self._backend = self._resolve_backend(backend)
         self._collective = self._resolve_collective(collective)
@@ -281,6 +291,10 @@ class MeshCodec:
             "device_s": round(self.device_s, 6),
             "degraded": bool(self.degraded),
             "degrade_reason": self.degrade_reason,
+            "ring_lower": self.ring_lower,
+            "ring_lower_effective": self.ring_lower_effective,
+            "ring_lower_fallback": self.ring_lower_fallback,
+            "ring_vmem_fallbacks": int(self.ring_vmem_fallbacks),
         }
 
     # -- failure handling --------------------------------------------------

@@ -260,6 +260,12 @@ class RingMeanFolder(MeshMeanFolder):
         self.shard = tile_elems // codec._ndev
         self.ring_flushes = 0
         self._lower_cfg = self._resolve_lower(codec)
+        # Surface the lowering choice on the codec so coord.status can see
+        # it: a fleet quietly re-lowered to xla by the VMEM estimate looks
+        # identical to one running the kernel otherwise.
+        codec.ring_lower = self._lower_cfg
+        if codec.ring_lower_effective is None:
+            codec.ring_lower_effective = self._lower_cfg
         # Eager ingest (xla lowering): every chunk is ALSO put to its column
         # shard at add() time, so the host-link crossing overlaps chunk
         # arrival and flush() folds device-resident bits with no host
@@ -293,12 +299,32 @@ class RingMeanFolder(MeshMeanFolder):
         buf_bytes = self.n_tiles * self.shard * 4
         est = 5 * buf_bytes + 2 * per_dev * self.tile_elems
         if est > _VMEM_CAP_BYTES:
-            log.debug(
-                "ring flush working set %.1fMB > VMEM cap; xla lowering",
-                est / (1 << 20),
-            )
+            self._note_vmem_fallback("flush", est)
             return "xla"
+        self.codec.ring_lower_effective = lower
         return lower
+
+    def _note_vmem_fallback(self, site: str, est: int) -> None:
+        """Book a compiled->xla re-lowering on the codec gauges and warn
+        exactly once per codec — the fallback is correct but should never
+        be silent, or a whole fleet pinned to xla by DVC_RING_VMEM_MB
+        reads as if the kernel were live."""
+        codec = self.codec
+        reason = "%s working set %.1fMB > VMEM cap %.0fMB" % (
+            site,
+            est / (1 << 20),
+            _VMEM_CAP_BYTES / (1 << 20),
+        )
+        codec.ring_lower_effective = "xla"
+        codec.ring_lower_fallback = reason
+        codec.ring_vmem_fallbacks += 1
+        if not codec._ring_vmem_warned:
+            codec._ring_vmem_warned = True
+            log.warning(
+                "ring lowering fell back compiled->xla: %s "
+                "(raise DVC_RING_VMEM_MB to keep the kernel)",
+                reason,
+            )
 
     # -- eager ingest (xla lowering) --------------------------------------
 
@@ -542,7 +568,9 @@ class RingMeanFolder(MeshMeanFolder):
         shard = self.shard
         n_tiles = self.n_tiles
         lower = self._lower_cfg
-        if lower == "compiled" and 2 * nd * n_tiles * shard * 4 > _VMEM_CAP_BYTES:
+        gather_bytes = 2 * nd * n_tiles * shard * 4
+        if lower == "compiled" and gather_bytes > _VMEM_CAP_BYTES:
+            self._note_vmem_fallback("gather", gather_bytes)
             lower = "xla"
 
         if lower == "xla":

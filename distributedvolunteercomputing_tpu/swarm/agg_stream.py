@@ -1012,7 +1012,7 @@ class StreamingAggregator:
         with self._lock:
             return [self.slots[s] for s in sorted(self._sealed)]
 
-    def mass_report(self) -> dict:
+    def mass_report(self, shard_of: Optional[Dict[str, int]] = None) -> dict:
         """Balanced gradient-mass classification for this round (training-
         health layer, swarm/health.py): every armed slot lands in exactly
         one of included (sealed purely by its own stream) / recovered
@@ -1025,7 +1025,13 @@ class StreamingAggregator:
         included + recovered + excluded + aborted weight sums to the total
         armed weight by construction; the property test exercises the
         classification across the deadline / abort / hedge / fence
-        matrix."""
+        matrix.
+
+        ``shard_of`` (zone-sharded training) tags each peer's entry with
+        its shard domain so ``health.mass_by_shard`` can roll the buckets
+        up per shard — a shard-holder death then shows as mass moving to
+        recovered/excluded in ONE shard's bucket, not as a fleet-wide
+        dip. Peers absent from the map are left untagged."""
         with self._lock:
             per_peer: Dict[str, dict] = {}
             for slot, pid in enumerate(self.slots):
@@ -1037,6 +1043,8 @@ class StreamingAggregator:
                 else:
                     oc = "excluded"
                 per_peer[pid] = {"outcome": oc, "weight": w}
+                if shard_of is not None and pid in shard_of:
+                    per_peer[pid]["shard"] = int(shard_of[pid])
         return health_mod.mass_report_from_per_peer(per_peer)
 
     # -- tail-optimal hedged recovery surface --------------------------------

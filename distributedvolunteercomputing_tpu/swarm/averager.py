@@ -209,6 +209,7 @@ class AveragerBase:
         hedge: bool = True,
         tail_redundancy_frac: float = 0.0,
         controller=None,
+        shard_manager=None,
     ):
         if wire not in ("f32", "bf16", "q8", "topk", "powersgd", "sign"):
             raise ValueError(f"unknown wire dtype {wire!r}")
@@ -469,7 +470,13 @@ class AveragerBase:
         # runs before formation). None = every knob stays hand-set (the
         # --no-adapt contract).
         self.controller = controller
-        # Bandwidth evidence source for the controller's wire/cadence
+        # Zone-sharded training (swarm/sharding.py): when attached, this
+        # averager's tree is the volunteer's OWN shard slice and the
+        # rendezvous scopes groups to same-shard peers (the ``shards``
+        # map below), so cross-zone rounds move ~1/K of the tree. The
+        # manager itself stays off the round path — it only moves state
+        # when membership does.
+        self.shard_manager = shard_manager
         # gates: the transport's measured per-peer downlink EWMA by
         # default. Pluggable because the chaos link model shapes WALL
         # TIME but not measured arrival rates (the documented set_link
@@ -481,6 +488,12 @@ class AveragerBase:
                 wire=self.wire, schedule=group_schedule, max_group=max_group,
             )
             self.telemetry.registry.source("controller", controller.summary)
+        if shard_manager is not None:
+            self.telemetry.registry.source("sharding", shard_manager.summary)
+            if getattr(shard_manager, "telemetry", None) is None:
+                # shard_lost/shard_recovered/fence events land in this
+                # volunteer's flight recorder.
+                shard_manager.telemetry = self.telemetry
 
     def _surface_quality_flags(self, flagged: List[str]) -> None:
         """Carry this vantage's flagged-peer list in the next heartbeat
@@ -617,7 +630,23 @@ class AveragerBase:
             pid: str(peers.get(pid, {}).get("zone") or "") for pid in ids
         }
         zones.setdefault(self.peer_id, self.zone)
-        asg = self.group_schedule.assign(ids, self.peer_id, zones=zones)
+        # Shard advertisements (zone-sharded training): peers carrying a
+        # "shard" field in their record group only with same-shard peers,
+        # and the shard rides in the group id — the round key, and hence
+        # the epoch hash and fencing tokens, become shard-scoped. Peers
+        # without the advertisement schedule exactly as before.
+        shards: Dict[str, int] = {}
+        for pid in ids:
+            s = (peers.get(pid) or {}).get("shard")
+            if isinstance(s, int) and not isinstance(s, bool):
+                shards[pid] = s
+        if self.shard_manager is not None and self.peer_id not in shards:
+            p = self.shard_manager.primary_shard()
+            if p is not None:
+                shards[self.peer_id] = int(p)
+        asg = self.group_schedule.assign(
+            ids, self.peer_id, zones=zones, shards=shards or None
+        )
         if asg is None:
             return self.round_key
         self._last_group = asg
@@ -792,6 +821,8 @@ class AveragerBase:
             out["n_groups_view"] = asg.n_groups
             out["n_peers_view"] = asg.n_peers
             out["level"] = asg.level
+            if asg.shard is not None:
+                out["shard"] = asg.shard
         out.update(self._group_totals)
         out["distinct_groups"] = self._groups_seen
         if self._level_totals:
@@ -3142,8 +3173,18 @@ class SyncAverager(AveragerBase):
             # contract even while the rest of telemetry stays on.
             mass = quality = None
             if health_on:
+                # Shard-scoped rounds tag every slot with the group's shard
+                # domain so health.mass_by_shard can roll the buckets up
+                # per shard — a shard-holder death then reads as one
+                # shard's committed fraction dipping, not a fleet-wide dip.
+                asg_m = self._last_group
+                shard_of = (
+                    {p: asg_m.shard for p in st.expected}
+                    if asg_m is not None and asg_m.shard is not None
+                    else None
+                )
                 mass = (
-                    st.stream.mass_report()
+                    st.stream.mass_report(shard_of)
                     if st.stream is not None
                     else health_mod.mass_from_outcomes(
                         st.expected, {p: float(good[p][0]) for p in good}

@@ -187,6 +187,14 @@ class ChaosTransport(Transport):
     # link delivers nothing), then the link delay, then rates/schedules.
     # Tests/campaigns must ``clear_links()`` in teardown.
     _links: Dict[frozenset, Tuple[float, Optional[float], Optional[dict]]] = {}
+    # Process-wide STAR-isolated peer addresses (isolate/restore): every
+    # link touching one of these is cut, in both directions, wherever a
+    # ChaosTransport runs either endpoint. The shard-kill primitive — a
+    # holder "dies" to the whole zone with one call while its process
+    # stays inspectable. Class-level like _partitions; tests/campaigns
+    # must ``restore_all()`` in teardown. Checked inside _partitioned, so
+    # it composes everywhere partitions do.
+    _isolated: Set[Addr] = set()
 
     # Known heavy-tailed jitter shapes for set_link(jitter=...).
     _JITTER_DISTS = ("pareto", "lognormal")
@@ -269,9 +277,38 @@ class ChaosTransport(Transport):
             ChaosTransport._partitions.discard(self._pair(peer_a, peer_b))
 
     def _partitioned(self, addr: Addr) -> bool:
+        me = (str(self.addr[0]), int(self.addr[1]))
+        a = (str(addr[0]), int(addr[1]))
+        if me in ChaosTransport._isolated or a in ChaosTransport._isolated:
+            return True
         if not ChaosTransport._partitions:
             return False
         return self._pair(self.addr, addr) in ChaosTransport._partitions
+
+    # -- star isolation (shard-holder kill at the network level) ------------
+
+    def isolate(self, peer=None) -> None:
+        """Cut EVERY link touching one peer address (self when None) — the
+        network half of a shard-holder SIGKILL: the process lives (its
+        state is inspectable by the test) but the zone sees a silent
+        death, must re-shard around it, and its own late serves fail
+        exactly like a dead socket's would. A star partition, not N
+        ``partition`` calls: joins/leaves during the isolation window are
+        covered too."""
+        addr = self.addr if peer is None else peer
+        ChaosTransport._isolated.add((str(addr[0]), int(addr[1])))
+        log.debug("chaos: isolated %s", tuple(addr))
+
+    def restore(self, peer=None) -> None:
+        """Lift one peer's star isolation (self when None); with
+        ``peer=...`` absent AND no self addr, scenario teardown clears
+        via ``restore_all``."""
+        addr = self.addr if peer is None else peer
+        ChaosTransport._isolated.discard((str(addr[0]), int(addr[1])))
+
+    @staticmethod
+    def restore_all() -> None:
+        ChaosTransport._isolated.clear()
 
     # -- per-pair link model ------------------------------------------------
 

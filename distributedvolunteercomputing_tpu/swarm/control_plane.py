@@ -53,6 +53,7 @@ it could already lie in ``coord.status``, which steers no tensor).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -177,6 +178,24 @@ class ControlPlaneReplica:
         self._replica_view: Dict[str, dict] = {}
         self._rollup_view: Dict[str, dict] = {}
         self._views_t = 0.0
+        # Peers-snapshot delta state (batched exchange replies ship
+        # changes-since-version instead of the full map every beat — at
+        # fleet scale the full map is the dominant control-plane byte
+        # stream). _pv is this replica's monotone snapshot version;
+        # _psig holds per-peer SIGNIFICANCE signatures (the beat
+        # timestamp and jittery measured floats excluded — else every
+        # record "changes" every beat and deltas degenerate to fulls);
+        # _plog is the (version, pid) change log a delta is computed
+        # from, trimmed whole-version-batches at a time so _plog_floor
+        # (the oldest version a delta can be served FROM) is exact.
+        # Versions are PER-REPLICA: clients echo (rid, version) and a
+        # failover lands on a replica whose rid mismatch forces one
+        # full-replace — stale-version fallback, by construction.
+        self._pv = 0
+        self._psig: Dict[str, str] = {}
+        self._plog: List[Tuple[int, str]] = []
+        self._plog_floor = 0
+        self._psig_t = 0.0
         self._rendezvous_cache: Dict[str, Tuple[float, dict]] = {}
         # shard -> generation this replica owns it at (fence for writes).
         self._shard_gens: Dict[int, int] = {}
@@ -209,6 +228,7 @@ class ControlPlaneReplica:
             "rendezvous_served": 0, "rendezvous_lookups": 0,
             "rollup_writes": 0, "rollups_fenced": 0, "shards_acquired": 0,
             "shards_released": 0, "mem_flushed": 0,
+            "peers_delta_replies": 0, "peers_full_replies": 0,
         }
         transport.register("coord.report", self._rpc_report)
         transport.register("coord.status", self._rpc_status)
@@ -691,12 +711,40 @@ class ControlPlaneReplica:
         # Our own record rides every reply (carries retiring=True during
         # the drain, which is how clients re-resolve "immediately").
         replicas[self.rid] = self._self_record()
-        return {
+        merged = self._merged_peers()
+        self._version_peers(merged)
+        reply: Dict[str, object] = {
             "ok": True,
             "rid": self.rid,
-            "peers": self._merged_peers(),
             "replicas": replicas,
-        }, b""
+            "peers_ver": self._pv,
+        }
+        cv = args.get("peers_ver")
+        if (
+            isinstance(cv, int)
+            and not isinstance(cv, bool)
+            and args.get("peers_rid") == self.rid
+            and self._plog_floor <= cv <= self._pv
+        ):
+            # Delta reply: the records whose significance changed since
+            # the client's version (None = departed/tombstoned), plus the
+            # compact liveness sidecar — every live peer's beat timestamp
+            # — so the client's failure detector keeps observing beats it
+            # no longer receives full records for.
+            changed = {p for v, p in self._plog if v > cv}
+            reply["peers_delta"] = {p: merged.get(p) for p in changed}
+            reply["beats"] = {
+                p: r["t"] for p, r in merged.items()
+                if isinstance(r, dict) and isinstance(r.get("t"), (int, float))
+            }
+            self.counters["peers_delta_replies"] += 1
+        else:
+            # Full replace: first contact, a failover from another
+            # replica's version stream, or a client staler than the
+            # change log covers.
+            reply["peers"] = merged
+            self.counters["peers_full_replies"] += 1
+        return reply, b""
 
     def _merged_peers(self) -> Dict[str, object]:
         """Peers snapshot served to batched clients: the cached DHT view
@@ -708,6 +756,75 @@ class ControlPlaneReplica:
             if exp > now:
                 out[pid] = rec
         return out
+
+    # -- peers-snapshot deltas ---------------------------------------------
+
+    # Change-log length bound; at ~one changed record per churn event this
+    # covers minutes of heavy churn before a client falls back to a full.
+    MAX_PLOG = 4096
+
+    @staticmethod
+    def _peers_sig(rec: object) -> str:
+        """Significance signature of one membership record: what a delta
+        considers "changed". The per-beat timestamp is EXCLUDED (it moves
+        every beat by design — liveness rides the compact ``beats``
+        sidecar instead) and floats are quantized to 2 significant digits
+        (measured bandwidth EWMAs jitter every beat; a 1% wiggle is not a
+        membership change)."""
+        if not isinstance(rec, dict):
+            return "~"
+        parts = []
+        for k in sorted(rec):
+            if k == "t":
+                continue
+            v = rec[k]
+            if isinstance(v, float):
+                v = float(f"{v:.2g}")
+            parts.append(f"{k}={v!r}")
+        return hashlib.blake2b(
+            "|".join(parts).encode(), digest_size=8
+        ).hexdigest()
+
+    def _version_peers(self, merged: Dict[str, object]) -> None:
+        """Advance the snapshot version from a significance diff of the
+        serving view. Record MUTATIONS are amortized once per interval
+        like the view refresh itself (they already lag a delta by up to
+        one interval through the view cache; same staleness class as
+        every other serving-view read) — but a changed KEY SET (join,
+        departure, expiry) bypasses the throttle: the live record store
+        grows mid-interval as clients exchange, and a delta reply
+        claiming the current version while the significance table
+        predates a join would silently starve those clients of the new
+        peer until the next interval tick. The diff runs over the MERGED
+        view, so expiries and tombstones version exactly like fresh
+        records do."""
+        now = time.monotonic()
+        if (
+            self._psig
+            and now - self._psig_t < self.interval
+            and self._psig.keys() == merged.keys()
+        ):
+            return
+        self._psig_t = now
+        changed = set()
+        for pid, rec in merged.items():
+            s = self._peers_sig(rec)
+            if self._psig.get(pid) != s:
+                self._psig[pid] = s
+                changed.add(pid)
+        for pid in [p for p in self._psig if p not in merged]:
+            del self._psig[pid]
+            changed.add(pid)
+        if not changed:
+            return
+        self._pv += 1
+        self._plog.extend((self._pv, pid) for pid in sorted(changed))
+        if len(self._plog) > self.MAX_PLOG:
+            # Trim whole version batches: a partially-dropped version
+            # would serve an INCOMPLETE delta as if it were complete.
+            vcut = self._plog[len(self._plog) - self.MAX_PLOG][0]
+            self._plog = [(v, p) for v, p in self._plog if v > vcut]
+        self._plog_floor = (self._plog[0][0] - 1) if self._plog else self._pv
 
     async def _rpc_rendezvous(self, args: dict, payload: bytes):
         """Matchmaking rendezvous read through the replicated control
@@ -1037,7 +1154,17 @@ class ControlPlaneClient:
         self.counters: Dict[str, int] = {
             "calls_ok": 0, "calls_failed": 0, "failovers": 0,
             "refreshes": 0, "fallbacks": 0,
+            "peers_full_replies": 0, "peers_delta_replies": 0,
         }
+        # Peers-snapshot delta state: the cached full map delta replies
+        # patch, and the (rid, version) echo that entitles this client to
+        # deltas from that replica's change log. A failover to a replica
+        # with a different rid mismatches the echo server-side and forces
+        # one full-replace — the stale-version fallback needs no client
+        # logic at all.
+        self._peers_cache: Dict[str, object] = {}
+        self._peers_ver: Optional[int] = None
+        self._peers_rid: Optional[str] = None
         # RPC attempts the most recent _call made (1 on the happy path,
         # +1 per failover try): the per-beat message accounting reads this
         # instead of a transport-global counter delta, which would bill
@@ -1181,16 +1308,19 @@ class ControlPlaneClient:
         """The batched per-interval control RPC (see ControlPlaneReplica).
         Returns the reply (peers snapshot + replica set, already adopted
         into this client's view) or None when no replica answered."""
-        ret = await self._call(
-            shard_of(self.peer_id), "cp.exchange",
-            {
-                "peer": self.peer_id,
-                "record": record,
-                "ttl": float(ttl),
-                "report": report,
-                "join": bool(join),
-            },
-        )
+        args: Dict[str, object] = {
+            "peer": self.peer_id,
+            "record": record,
+            "ttl": float(ttl),
+            "report": report,
+            "join": bool(join),
+        }
+        if self._peers_ver is not None and self._peers_rid is not None:
+            # Entitles us to a changes-since-version reply instead of the
+            # full peers map (see merge_peers_reply).
+            args["peers_ver"] = self._peers_ver
+            args["peers_rid"] = self._peers_rid
+        ret = await self._call(shard_of(self.peer_id), "cp.exchange", args)
         if ret is not None:
             recs = {
                 rid: rec
@@ -1209,6 +1339,65 @@ class ControlPlaneClient:
                     if rid not in recs:
                         self._unconfirmed.add(rid)
         return ret
+
+    def merge_peers_reply(self, ret: Optional[dict]) -> Dict[str, object]:
+        """Resolve an exchange reply into the FULL peers snapshot the
+        membership layer adopts, whichever shape the reply took:
+
+        - a full reply (``peers``) replaces the local cache outright —
+          also the legacy shape, so mixed-version replicas keep working;
+        - a delta reply (``peers_delta``) patches the cache (None values
+          evict) and folds the ``beats`` sidecar's timestamps into the
+          cached records, so the caller's failure detector keeps seeing
+          every peer's beat even though only changed records shipped.
+
+        Tombstones are delivered to the caller exactly once (they ride
+        the returned map this call, then leave the cache), matching the
+        one-shot departure semantics of the full map. The version echo
+        for the NEXT exchange is adopted here too."""
+        if not isinstance(ret, dict):
+            return {}
+        delta = ret.get("peers_delta")
+        if not isinstance(delta, dict):
+            snap = dict(ret.get("peers") or {})
+            self.counters["peers_full_replies"] += 1
+            self._peers_cache = {
+                p: r for p, r in snap.items() if r is not None
+            }
+        else:
+            self.counters["peers_delta_replies"] += 1
+            for pid, rec in delta.items():
+                if rec is None:
+                    self._peers_cache.pop(pid, None)
+                else:
+                    self._peers_cache[pid] = rec
+            beats = ret.get("beats")
+            if isinstance(beats, dict):
+                for pid, t in beats.items():
+                    rec = self._peers_cache.get(pid)
+                    if (
+                        isinstance(rec, dict)
+                        and isinstance(t, (int, float))
+                        and rec.get("t") != t
+                    ):
+                        # Copy-on-write: the cached record may still be
+                        # referenced by a snapshot handed out earlier.
+                        rec = dict(rec)
+                        rec["t"] = t
+                        self._peers_cache[pid] = rec
+            snap = dict(self._peers_cache)
+            for pid, rec in delta.items():
+                if rec is None:
+                    snap[pid] = None
+        ver = ret.get("peers_ver")
+        if isinstance(ver, int) and not isinstance(ver, bool):
+            self._peers_ver = ver
+            self._peers_rid = str(ret.get("rid") or "") or None
+        else:
+            # Legacy replica: no version stream to subscribe to.
+            self._peers_ver = None
+            self._peers_rid = None
+        return snap
 
     async def status(self, fresh: bool = False) -> Optional[dict]:
         await self.refresh()

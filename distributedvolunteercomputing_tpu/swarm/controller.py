@@ -342,6 +342,44 @@ class SwarmController:
         except Exception as e:  # noqa: BLE001 — the controller must never fail a round
             log.debug("controller observe_round failed: %s", errstr(e))
 
+    def observe_shard_health(
+        self, level: Optional[str] = None, *, ok: bool,
+    ) -> None:
+        """Shard-domain health (zone-sharded training, swarm/sharding.py)
+        as a regime input: a shard manager reporting degraded/recovering
+        feeds the SAME failure EWMA + evidence gates a failed round does
+        — for the level the loss actually sits on ("intra": the zone's
+        gather/scatter plane) — so a degraded shard zone widens that
+        level's deadlines and floors its hedge budget through the
+        existing regime→policy folding, with no new knob. A healthy beat
+        feeds 0 and walks the gates back toward calm, exactly like a
+        committed round."""
+        if not self.enabled:
+            return
+        try:
+            rec = self._level(level)
+            bad = 0.0 if ok else 1.0
+            rec["fail_ewma"] += self.FAIL_ALPHA * (bad - rec["fail_ewma"])
+            churn = rec["churn"].observe(rec["fail_ewma"])
+            degr = rec["degraded"].observe(rec["fail_ewma"])
+            new_regime = "degraded" if degr else ("churn" if churn else "calm")
+            if new_regime != rec["regime"]:
+                self._stage(
+                    "regime", level or "flat", rec["regime"], new_regime,
+                    reason=(
+                        "shard-domain health fed failure EWMA %.2f across "
+                        "the %s band"
+                        % (rec["fail_ewma"],
+                           "fire" if new_regime != "calm" else "clear")
+                    ),
+                    evidence={
+                        "fail_ewma": round(rec["fail_ewma"], 4),
+                        "source": "shard_health",
+                    },
+                )
+        except Exception as e:  # noqa: BLE001 — the controller must never fail a beat
+            log.debug("controller observe_shard_health failed: %s", errstr(e))
+
     def observe_dispersion(self, level: Optional[str], rel: float) -> None:
         """One cross-round relative contribution dispersion (the leader's
         per-peer distance evidence, sqrt(mean d2)/|agg|): the local

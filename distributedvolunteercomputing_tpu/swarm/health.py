@@ -265,6 +265,23 @@ def mass_report_from_per_peer(per_peer: Dict[str, dict]) -> dict:
     }
 
 
+def mass_by_shard(report: dict) -> Dict[str, dict]:
+    """Roll a balanced mass report up per shard domain (zone-sharded
+    training): per_peer entries carrying a ``shard`` tag bucket under
+    ``"s<k>"``, untagged entries under ``"~"``. Each sub-report is itself
+    balanced (same rounding rule as the parent), so a shard-holder death
+    reads as ONE shard's committed fraction dipping while the others hold
+    at 1.0 — the signal the ``shard_zone_degraded`` doctor rule and the
+    campaign verdict consume. An unsharded round returns a single ``"~"``
+    bucket equal to the parent report."""
+    groups: Dict[str, Dict[str, dict]] = {}
+    for pid, rec in (report.get("per_peer") or {}).items():
+        s = rec.get("shard")
+        tag = f"s{int(s)}" if isinstance(s, int) and not isinstance(s, bool) else "~"
+        groups.setdefault(tag, {})[pid] = rec
+    return {tag: mass_report_from_per_peer(pp) for tag, pp in sorted(groups.items())}
+
+
 class HealthMonitor:
     """Per-volunteer training-health state: quality, mass, sketch, codec.
 
@@ -461,6 +478,19 @@ class HealthMonitor:
                 self._last_mass = {
                     k: report[k] for k in report if k != "per_peer"
                 }
+                # Per-shard rollup (zone-sharded training): only when some
+                # slot carries a shard tag — unsharded rounds add nothing.
+                if any(
+                    "shard" in (rec or {})
+                    for rec in (report.get("per_peer") or {}).values()
+                ):
+                    self._last_mass["by_shard"] = {
+                        tag: {
+                            "armed_weight": sub["armed_weight"],
+                            "mass_committed_frac": sub["mass_committed_frac"],
+                        }
+                        for tag, sub in mass_by_shard(report).items()
+                    }
                 for pid, rec in (report.get("per_peer") or {}).items():
                     if rec.get("outcome") in ("excluded", "aborted"):
                         if pid not in self._lost_mass and len(

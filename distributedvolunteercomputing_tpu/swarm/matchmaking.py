@@ -62,6 +62,13 @@ class GroupAssignment:
     level: str = "flat"
     # Zone an "intra" assignment is scoped to ("" otherwise).
     zone: str = ""
+    # Shard domain this assignment is scoped to (zone-sharded training,
+    # swarm/sharding.py): None = unsharded. When set, every member of the
+    # group holds the SAME shard, the ``.s<k>`` segment rides in the
+    # group_id — so the round key, epoch hash, and fencing tokens are
+    # shard-scoped by construction and two shards' gradients can never
+    # rendezvous into one round.
+    shard: Optional[int] = None
 
 
 class GroupSchedule:
@@ -229,6 +236,7 @@ class GroupSchedule:
         peer_id: str,
         rot: Optional[int] = None,
         zones: Optional[Dict[str, str]] = None,
+        shards: Optional[Dict[str, int]] = None,
     ) -> Optional[GroupAssignment]:
         """This peer's assignment for rotation ``rot`` (current window when
         None), or None when the live swarm is too small to split — the
@@ -242,12 +250,35 @@ class GroupSchedule:
         peer's zone — an assignment with fewer than ``min_size`` members
         (a lone peer in its zone) is returned as-is so the caller can
         skip the round CHEAPLY (it is deterministic that nobody else will
-        rendezvous under that key) instead of burning a join timeout."""
+        rendezvous under that key) instead of burning a join timeout.
+
+        ``shards`` maps peer_id -> advertised primary shard (zone-sharded
+        training). A sharded peer's view is restricted to SAME-shard
+        peers before any level logic runs, and the shard rides in the
+        group id (``r<rot>.s<k>...``): cross/flat rotations then average
+        only the peer's own shard across zones (the ~1/K wire saving),
+        and an intra rotation degenerates to a singleton skip (inside a
+        zone each shard has one holder; the intra links carry
+        gather/scatter, not averaging). Sharded and unsharded peers never
+        share a group — mixed fleets split along the advertisement, and
+        the shard-scoped key + epoch hash make cross-shard mixing
+        structurally impossible rather than merely unlikely. Because a
+        shard-scoped view can be far below ``target_size``, an undersized
+        sharded group is returned as-is (cheap-skip contract above)
+        instead of falling back to the shard-blind constant key."""
         ids = set(member_ids)
         ids.add(peer_id)
         rot = self.rotation() if rot is None else int(rot)
+        sk: Optional[int] = None
+        if shards:
+            if peer_id in shards:
+                sk = int(shards[peer_id])
+                ids = {pid for pid in ids if shards.get(pid) == sk}
+            else:
+                ids = {pid for pid in ids if pid not in shards}
         zmap = {pid: str((zones or {}).get(pid) or "") for pid in ids}
         level = self.level_of(rot, zmap)
+        stag = "" if sk is None else f"s{sk}."
         if level == "intra":
             zone = zmap[peer_id]
             zone_ids = {pid for pid, z in zmap.items() if z == zone}
@@ -257,25 +288,34 @@ class GroupSchedule:
             for home, grp in self._arcs(zone_ids, rot, g, self.min_size):
                 if peer_id in grp:
                     return GroupAssignment(
-                        rot=rot, group_id=f"r{rot}.z{ztag}.g{home}",
+                        rot=rot, group_id=f"r{rot}.{stag}z{ztag}.g{home}",
                         n_groups=g, n_peers=n, members=tuple(sorted(grp)),
-                        level="intra", zone=zone,
+                        level="intra", zone=zone, shard=sk,
                     )
             # Singleton zone: _arcs yields one group of one; still scoped.
             return GroupAssignment(
-                rot=rot, group_id=f"r{rot}.z{ztag}.g0", n_groups=1,
+                rot=rot, group_id=f"r{rot}.{stag}z{ztag}.g0", n_groups=1,
                 n_peers=n, members=(peer_id,), level="intra", zone=zone,
+                shard=sk,
             )
         n = len(ids)
         g = self.n_groups(n, self.target_size, self.min_size)
-        if g <= 1:
-            return None
         gtag = "x" if level == "cross" else "g"
+        if g <= 1:
+            if sk is None:
+                return None
+            # Shard-scoped views are small by design: one same-shard group
+            # under the shard-scoped key (never the shard-blind fallback).
+            return GroupAssignment(
+                rot=rot, group_id=f"r{rot}.{stag}{gtag}0", n_groups=1,
+                n_peers=n, members=tuple(sorted(ids)), level=level, shard=sk,
+            )
         for home, grp in self._arcs(ids, rot, g, self.min_size):
             if peer_id in grp:
                 return GroupAssignment(
-                    rot=rot, group_id=f"r{rot}.{gtag}{home}", n_groups=g,
+                    rot=rot, group_id=f"r{rot}.{stag}{gtag}{home}", n_groups=g,
                     n_peers=n, members=tuple(sorted(grp)), level=level,
+                    shard=sk,
                 )
         return None  # unreachable: peer_id is in ids
 
@@ -323,6 +363,7 @@ class GroupSchedule:
         min_size: int = 2,
         zones: Optional[Dict[str, str]] = None,
         cross_zone_every_k: int = 0,
+        shards: Optional[Dict[str, int]] = None,
     ) -> List[List[str]]:
         """The full partition one view computes for rotation ``rot``
         (groups in arc order, members sorted by id). Tests, the chaos
@@ -330,7 +371,31 @@ class GroupSchedule:
         with whom; the swarm itself never needs the global view. With
         ``zones`` + ``cross_zone_every_k`` the partition is the
         hierarchical one: per-zone arcs on intra rotations (zones in
-        sorted order), the zone-blind flat grid on cross rotations."""
+        sorted order), the zone-blind flat grid on cross rotations. With
+        ``shards`` the partition runs per shard domain (shards in sorted
+        order, unsharded peers last), mirroring ``assign``'s view
+        restriction."""
+        if shards:
+            out: List[List[str]] = []
+            ids_all = sorted(set(member_ids))
+            buckets = sorted({int(s) for p, s in shards.items() if p in set(ids_all)})
+            for sk in buckets:
+                sub = [p for p in ids_all if shards.get(p) == sk]
+                out.extend(
+                    cls.partition(
+                        sub, rot, target_size, min_size, zones,
+                        cross_zone_every_k,
+                    )
+                )
+            rest = [p for p in ids_all if p not in shards]
+            if rest:
+                out.extend(
+                    cls.partition(
+                        rest, rot, target_size, min_size, zones,
+                        cross_zone_every_k,
+                    )
+                )
+            return out
         ids = sorted(set(member_ids))
         zmap = {pid: str((zones or {}).get(pid) or "") for pid in ids}
         k = int(cross_zone_every_k)

@@ -157,7 +157,7 @@ class SwarmMembership:
                         report=self._build_report(),
                     )
                     if ret is not None:
-                        self._adopt_records(dict(ret.get("peers") or {}))
+                        self._adopt_records(self._reply_peers(cp, ret))
             except Exception as e:  # noqa: BLE001 — join exchange is best-effort
                 log.debug("join exchange failed: %s", errstr(e))
         if self._heartbeat_task is None:
@@ -181,6 +181,17 @@ class SwarmMembership:
             except Exception:
                 pass
         log.info("peer %s left swarm", self.peer_id)
+
+    @staticmethod
+    def _reply_peers(cp, ret: dict) -> dict:
+        """The peers snapshot out of an exchange reply: resolved through
+        the client's delta cache when it has one (replies may carry
+        changes-since-version instead of the full map), with the legacy
+        full-map shape as the fallback for older clients in tests."""
+        merge = getattr(cp, "merge_peers_reply", None)
+        if merge is not None:
+            return merge(ret)
+        return dict(ret.get("peers") or {})
 
     def _build_report(self) -> Optional[dict]:
         if self.report_source is None:
@@ -231,7 +242,7 @@ class SwarmMembership:
                                 report=self._build_report(),
                             )
                     if ret is not None:
-                        self._adopt_records(dict(ret.get("peers") or {}))
+                        self._adopt_records(self._reply_peers(cp, ret))
                         batched = True
             except Exception as e:  # noqa: BLE001 — exchange is an accelerator
                 log.debug("batched beat failed: %s", errstr(e))

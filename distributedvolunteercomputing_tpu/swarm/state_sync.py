@@ -119,6 +119,11 @@ class StateSyncService:
         self.wire = wire
         self._provider: Optional[StateProvider] = None
         self._sessions: Dict[str, _Session] = {}
+        # Extra fields merged into every announce record (zone-sharded
+        # volunteers ride their shard assignment here, so a rejoiner can
+        # tell full-tree providers from shard-holders before dialing one
+        # that only serves 1/K of what it needs).
+        self.extra_announce: Optional[Callable[[], dict]] = None
         transport.register("state.fetch", self._rpc_fetch)
 
     @property
@@ -135,9 +140,15 @@ class StateSyncService:
         if self._provider is None:
             return
         step, _ = self._provider()
+        rec = {"addr": list(self.transport.addr), "step": int(step)}
+        if self.extra_announce is not None:
+            try:
+                rec.update(self.extra_announce() or {})
+            except Exception as e:  # noqa: BLE001 — announce must not die on a gauge
+                log.debug("extra_announce failed: %s", errstr(e))
         await self.dht.store(
             self.key,
-            {"addr": list(self.transport.addr), "step": int(step)},
+            rec,
             subkey=self.peer_id,
             ttl=self.announce_ttl,
         )

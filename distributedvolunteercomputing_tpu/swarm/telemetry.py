@@ -106,6 +106,15 @@ KIND_SEVERITY: Dict[str, str] = {
     "policy_changed": "info",
     "alert_raised": "page",
     "alert_cleared": "info",
+    # Zone-sharded training (swarm/sharding.py): a holder departing with
+    # its shard starts a recovery clock (warn until the ladder closes it);
+    # a fence rejection is the protocol WORKING (a stale serve/pull was
+    # refused) but worth a look in bulk; an exhausted ladder means a
+    # shard's state is gone from the zone — page.
+    "shard_lost": "warn",
+    "shard_recovered": "info",
+    "shard_fence_rejected": "warn",
+    "shard_recovery_failed": "page",
 }
 
 # The ambient trace id: set by Tracer.trace_scope around a round on the

@@ -115,6 +115,13 @@ class VolunteerConfig:
     # 0 = flat single-level grid. Degrades to flat automatically while
     # fewer than two zones are advertised (mixed-version swarms).
     cross_zone_every_k: int = 0
+    # Zone-sharded training (swarm/sharding.py): partition the averaged
+    # parameter tree into K zone-local shards — this volunteer holds its
+    # HRW-assigned shard(s), advertises its primary shard so cross-zone
+    # rotations rendezvous same-shard holders (~1/K wire bytes/round),
+    # and runs the fenced re-shard + hedged-recovery autopilot on zone
+    # churn. 0 = unsharded (full replica).
+    zone_shards: int = 0
     batch_size: int = 32  # samples per optimizer step (across accum microbatches)
     # Scan up to N steps inside one compiled call between cadence points
     # (host-loop amortization; params mode, no mesh). 1 = off.
@@ -306,6 +313,28 @@ class VolunteerConfig:
                 "schedules the multi-group grid; single-group swarms have "
                 "no grid to layer)"
             )
+        if self.zone_shards < 0:
+            raise ValueError(
+                f"zone_shards must be >= 0 (0 = unsharded), got "
+                f"{self.zone_shards}"
+            )
+        if self.zone_shards:
+            # Fail at config time (the method/wire validation policy): the
+            # shard domain IS the zone, and the shard-scoped rendezvous
+            # lives in the group schedule — without either the flag would
+            # silently train a full replica.
+            if not self.zone:
+                raise ValueError(
+                    "--zone-shards requires --zone (the zone is the shard "
+                    "domain: shards are held within a zone and replicated "
+                    "across zones)"
+                )
+            if self.averaging != "none" and not self.group_size:
+                raise ValueError(
+                    "--zone-shards with averaging requires --group-size "
+                    "(same-shard holders rendezvous through the shard-"
+                    "scoped group schedule)"
+                )
         if self.group_size:
             # Fail at config time (the method/wire validation policy): the
             # schedule only makes sense for round-structured gather-style
@@ -514,6 +543,7 @@ class Volunteer:
         self.resilience_policy = None
         self.controller = None
         self.averager = None
+        self.shard_manager = None  # ShardManager when zone_shards
         self.state_sync: Optional[StateSyncService] = None
         self.trainer: Optional[Trainer] = None
         self._stop = threading.Event()
@@ -929,6 +959,52 @@ class Volunteer:
                 # each other's unpublished window (GossipAverager.publish).
                 _, snap = self.trainer.host_snapshot()
                 self.averager.publish(bundle.avg_select(snap))
+        if self.cfg.zone_shards:
+            # Zone-sharded training autopilot: this volunteer holds its
+            # HRW shard(s) of the averaged subtree, advertises its primary
+            # shard (the shard-scoped rendezvous reads it like a zone),
+            # seeds the held shards from the post-state-sync params, and
+            # runs the maintenance beat — churn triggers a fenced re-shard
+            # + hedged recovery with no operator in the loop.
+            import numpy as np
+
+            from distributedvolunteercomputing_tpu.swarm.sharding import (
+                ShardManager,
+                shard_slice,
+            )
+
+            _, snap = self.trainer.host_snapshot()
+            leaves = jax.tree_util.tree_leaves(bundle.avg_select(snap))
+            flat = np.concatenate(
+                [np.asarray(a, np.float32).ravel() for a in leaves]
+            ) if leaves else np.zeros(0, np.float32)
+            self.shard_manager = ShardManager(
+                self.transport, self.dht, self.membership, self.cfg.peer_id,
+                n_elems=flat.size, k=self.cfg.zone_shards,
+                namespace=f"{self.cfg.model}/{self.cfg.average_what}",
+                zone=self.cfg.zone,
+                telemetry=self.telemetry,
+                resilience=self.resilience_policy,
+                controller=self.controller,
+            )
+            sm = self.shard_manager
+            await sm.reshard(recover=False)
+            for s in sm.owned():
+                sm.store.put(s, shard_slice(flat, sm.ranges, s).copy())
+            await sm.announce()
+            if self.averager is not None:
+                self.averager.shard_manager = sm
+                self.telemetry.registry.source("sharding", sm.summary)
+            sm.start_maintenance(
+                interval_s=max(self.cfg.heartbeat_ttl / 3.0, 2.0)
+            )
+            log.info(
+                "zone-sharded: k=%d zone=%s own=%s (%d/%d elems, gen %d)",
+                sm.k, sm.zone, sm.owned(),
+                sum(hi - lo for lo, hi in
+                    (sm.ranges[s] for s in sm.owned())),
+                sm.n_elems, sm.map.gen,
+            )
         if self.telemetry.watchdog.enabled:
             # Watchdog probes over the surfaces built above: commit-rate,
             # mass-fraction, per-peer bandwidth EWMAs, control-plane beat
@@ -1072,6 +1148,13 @@ class Volunteer:
             # up per group swarm-wide instead of silently averaging
             # across groups.
             report["groups"] = self.averager.group_stats()
+        sm = self.shard_manager or getattr(self.averager, "shard_manager", None)
+        if sm is not None:
+            # Zone-sharded training gauges (map generation, owned/missing
+            # shards, recovery latency window): the watchdog's
+            # shard_recovery_latency SLO reads this section off the
+            # merged fleet view — absent entirely on unsharded swarms.
+            report["sharding"] = sm.summary()
         failover_stats = getattr(self.averager, "failover_stats", None)
         if failover_stats is not None:
             fo = failover_stats()
@@ -1179,6 +1262,20 @@ class Volunteer:
             # rpcs/connects expose the pooling win directly: pre-pool these
             # were equal (one dial per RPC); pooled, connects stays at
             # ~one-per-peer while rpcs keeps counting.
+            if self.shard_manager is not None:
+                # Zone-sharding outcome gauges on the done line: the e2e
+                # kill matrix asserts recovery happened WITHOUT an epoch
+                # restart from exactly these.
+                sm = self.shard_manager
+                self.summary["shard_gen"] = float(
+                    sm.map.gen if sm.map is not None else -1
+                )
+                self.summary["shard_reshardings"] = float(sm.resharding_count)
+                self.summary["shard_recoveries"] = float(sm.recoveries)
+                self.summary["shard_recoveries_failed"] = float(
+                    sm.recoveries_failed
+                )
+                self.summary["shard_missing"] = float(len(sm.missing()))
             self.summary["wan_bytes_sent"] = self.transport.bytes_sent
             self.summary["wan_bytes_received"] = self.transport.bytes_received
             self.summary["wan_rpcs"] = self.transport.rpcs_sent
@@ -1189,6 +1286,11 @@ class Volunteer:
             report_task.cancel()
             if self.clocksync is not None:
                 self.clocksync.stop()
+            if self.shard_manager is not None:
+                try:
+                    await self.shard_manager.stop()
+                except Exception:
+                    pass
             try:
                 await self.membership.leave()
             except Exception:

@@ -840,6 +840,15 @@ DEFAULT_SLOS: Tuple[SLO, ...] = (
         direction="max", target=0.95,
         description="the freshest volunteer report stays younger than bound",
     ),
+    SLO(
+        # Zone-sharded training: how long a departed holder's shard stays
+        # unrecovered. The metric is the recent-window MAX across the
+        # fleet's ``sharding`` report sections (None when no recovery ran
+        # recently — no tick, so unsharded swarms never burn this).
+        "shard_recovery_latency", metric="shard_recovery_latency_s",
+        bound=15.0, direction="max",
+        description="recent shard recoveries complete within bound",
+    ),
 )
 
 # Minimum ticks in the slow window before a burn verdict counts: two
@@ -1042,6 +1051,17 @@ class SwarmWatchdog:
             v = (health.get("mass") or {}).get("committed_frac_min")
             if isinstance(v, (int, float)):
                 ctx["mass_committed_frac"] = float(v)
+        # Shard-recovery latency: worst recent recovery across reporters
+        # carrying a ``sharding`` section (zone-sharded swarms only —
+        # absent everywhere leaves the metric None and the SLO untouched).
+        lat = [
+            (m.get("sharding") or {}).get("recent_recovery_latency_s")
+            for m in fresh
+            if isinstance(m.get("sharding"), dict)
+        ]
+        lat = [float(v) for v in lat if isinstance(v, (int, float))]
+        if lat:
+            ctx["shard_recovery_latency_s"] = max(lat)
         recvs = [
             m.get("recv_t") for m in fresh
             if isinstance(m.get("recv_t"), (int, float))

@@ -369,3 +369,92 @@ def maybe_restore(trainer, ckpt_dir: str) -> bool:
     trainer._take_snapshot(step)
     log.info("restored checkpoint step %d from %s", step, path)
     return True
+
+
+# -- sharded snapshots (zone-sharded training, swarm/sharding.py) ------------
+#
+# A sharded volunteer never HOLDS the full tree, so the full-TrainState save
+# above cannot run on it. Instead each holder snapshots its OWN shard slices
+# (one .npy per shard plus a json meta carrying the fenced map generation),
+# and a zone's worth of shard snapshots reassembles into the full flat
+# buffer for export/eval. Deliberately plain numpy files, not Orbax: a shard
+# is one contiguous f32 slice with no tree structure, and the recovery
+# ladder (not this file) is the availability story — these snapshots exist
+# so a COLD-started zone (every holder gone at once, the one case the
+# ladder cannot close) resumes from local disk instead of step 0.
+
+
+def save_shard_snapshot(ckpt_dir: str, store, smap, step: int) -> str:
+    """Write every OWNED shard slice + meta under ``ckpt_dir/shards/``.
+    Returns the snapshot directory. Meta pins (k, gen, zone members) so a
+    restore into a differently-cut world is refused loudly."""
+    import json
+
+    d = os.path.join(ckpt_dir, "shards", f"step_{int(step):010d}")
+    os.makedirs(d, exist_ok=True)
+    owned = []
+    for s in store.held():
+        arr = store.get(s, allow_replica=False)
+        if arr is None:
+            continue
+        np.save(os.path.join(d, f"shard_{s}.npy"), np.asarray(arr, np.float32))
+        owned.append(int(s))
+    meta = {
+        "step": int(step),
+        "k": int(smap.k),
+        "gen": int(smap.gen),
+        "domain": smap.domain,
+        "members": list(smap.members),
+        "owned": owned,
+    }
+    with open(os.path.join(d, "meta.json"), "w") as fh:
+        json.dump(meta, fh)
+    log.info("saved shard snapshot step %d (%d shard(s)) to %s", step, len(owned), d)
+    return d
+
+
+def load_shard_snapshot(snap_dir: str, k: int) -> dict:
+    """Load one holder's shard snapshot: {"meta": ..., "shards": {s: arr}}.
+    Refuses a snapshot cut for a different K — shard ranges depend only on
+    (n_elems, K), so a K mismatch means the slices are NOT the same tensor
+    regions and silently adopting them would scramble the model."""
+    import json
+
+    with open(os.path.join(snap_dir, "meta.json")) as fh:
+        meta = json.load(fh)
+    if int(meta.get("k", -1)) != int(k):
+        raise ValueError(
+            f"shard snapshot k={meta.get('k')} != configured k={k}: "
+            "refusing a differently-cut restore"
+        )
+    shards = {}
+    for s in meta.get("owned", []):
+        p = os.path.join(snap_dir, f"shard_{int(s)}.npy")
+        if os.path.exists(p):
+            shards[int(s)] = np.load(p)
+    return {"meta": meta, "shards": shards}
+
+
+def assemble_full(snap_dirs, n_elems: int, k: int) -> np.ndarray:
+    """Reassemble the full flat buffer from a zone's shard snapshots (one
+    directory per holder; later directories win ties). Raises if any shard
+    range is missing — a partial assembly is not a model."""
+    from distributedvolunteercomputing_tpu.swarm.sharding import shard_ranges
+
+    ranges = shard_ranges(int(n_elems), int(k))
+    buf = np.zeros(int(n_elems), np.float32)
+    got = set()
+    for d in snap_dirs:
+        snap = load_shard_snapshot(d, k)
+        for s, arr in snap["shards"].items():
+            lo, hi = ranges[s]
+            if arr.size != hi - lo:
+                raise ValueError(
+                    f"shard {s} snapshot has {arr.size} elems, range needs {hi - lo}"
+                )
+            buf[lo:hi] = arr
+            got.add(s)
+    missing = [s for s in range(k) if s not in got]
+    if missing:
+        raise ValueError(f"shard snapshot set is missing shard(s) {missing}")
+    return buf

@@ -119,6 +119,11 @@ from distributedvolunteercomputing_tpu.swarm.control_plane import (  # noqa: E40
     ControlPlaneReplica,
 )
 from distributedvolunteercomputing_tpu.swarm.matchmaking import GroupSchedule  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.sharding import (  # noqa: E402
+    ShardManager,
+    ShardMap,
+    shard_slice,
+)
 
 
 def tree_for(i: int, size: int = 2048):
@@ -845,6 +850,380 @@ async def multigroup_campaign(args):
             pass
         await boot_t.close()
     return out
+
+
+# -- swarm-sharded campaign (ISSUE 20 acceptance) ----------------------------
+
+SHARD_SOAK_NS = "soak/params"
+SHARD_SOAK_ELEMS = 4096
+SHARD_SOAK_ZONES = ("dc", "eu", "home")
+# Per-zone id prefixes: the first member of each pair is searched to own
+# shard 0 under the zone's HRW map, and dc's "a" prefix sorts before every
+# other id so the dc shard-0 holder LEADS the shard-0 trio in every round
+# (leader election falls back to smallest id absent bandwidth adverts).
+SHARD_SOAK_PREFIX = {"dc": ("a", "d"), "eu": ("e", "f"), "home": ("h", "i")}
+SHARD_KILL_PHASES = ("pre_arm", "mid_stream", "post_partial_commit")
+
+
+def _shard_soak_ids(zone, k=2):
+    """Deterministic suffix search: a member pair for ``zone`` whose HRW
+    map splits the two shards 1/1 with the first-prefix member on shard 0
+    (HRW gives no balance guarantee for 2 members — the campaign needs a
+    KNOWN victim/mate split, so it picks ids that hash into one)."""
+    pa, pb = SHARD_SOAK_PREFIX[zone]
+    domain = f"{zone}|{SHARD_SOAK_NS}"
+    for t in range(4000):
+        a, b = f"{pa}{t:03d}", f"{pb}{t:03d}"
+        m = ShardMap(members=(a, b), k=k, gen=0, domain=domain)
+        if m.shards_of(a) == [0] and m.shards_of(b) == [1]:
+            return a, b
+    raise AssertionError(f"no balanced pair for zone {zone}")
+
+
+def _shard_pinned_schedule(rot_cell, target=3):
+    return GroupSchedule(
+        target_size=target, rotation_s=1000.0, min_size=2,
+        cross_zone_every_k=1,  # every pinned rotation crosses zones
+        clock=lambda: rot_cell["rot"] * 1000.0 + 0.5,
+    )
+
+
+async def _make_shard_node(pid, zone, boot, rot_cell, gather_timeout):
+    t = Transport()
+    dht = DHTNode(t)
+    await dht.start(bootstrap=[boot] if boot else None)
+    fd = PhiAccrualDetector(bootstrap_s=2.0)
+    policy = ResiliencePolicy(
+        max_deadline_s=gather_timeout, min_deadline_s=1.0,
+        preexclude_misses=3, failure_detector=fd,
+    )
+    mem = SwarmMembership(
+        dht, pid, ttl=10.0, failure_detector=fd, extra_info={"zone": zone}
+    )
+    await mem.join()
+    sm = ShardManager(
+        t, dht, mem, pid,
+        n_elems=SHARD_SOAK_ELEMS, k=2,
+        namespace=SHARD_SOAK_NS, zone=zone, resilience=policy,
+    )
+    avg = SyncAverager(
+        t, dht, mem,
+        min_group=2, max_group=6,
+        join_timeout=8.0, gather_timeout=gather_timeout,
+        resilience=policy, failure_detector=fd,
+        group_schedule=_shard_pinned_schedule(rot_cell),
+        shard_manager=sm,
+    )
+    return {"pid": pid, "zone": zone, "t": t, "dht": dht, "mem": mem,
+            "avg": avg, "sm": sm, "fd": fd, "policy": policy}
+
+
+async def _timed_shard_average(v, value, r):
+    """Round payload = this volunteer's OWN shard slice of a full-tree
+    vector (the ~1/K wire contract: a sharded round never moves the whole
+    tree)."""
+    sm = v["sm"]
+    vec = np.full((SHARD_SOAK_ELEMS,), float(value), np.float32)
+    payload = {"w": shard_slice(vec, sm.ranges, sm.primary_shard())}
+    t0 = time.monotonic()
+    try:
+        res = await asyncio.wait_for(
+            v["avg"].average(payload, round_no=r), timeout=90.0
+        )
+    except BaseException as e:  # noqa: BLE001 — campaign records, never raises
+        return time.monotonic() - t0, e
+    return time.monotonic() - t0, res
+
+
+async def shard_campaign(args):
+    """Swarm-sharded arm (``--shard``): 3 zones x 2 shard-holders on the
+    zone-sharded schedule (one cross-zone trio per shard). Each kill
+    round, the shard-0 trio's LEADER (dc's shard-0 holder) dies at an
+    instrumented phase — cycling the pre_arm / mid_stream /
+    post_partial_commit matrix — after mutating its held shard so the
+    recovery check is bytes-for-bytes meaningful. The bar, per round:
+
+      - the shard-1 trio commits with ZERO failover activity (the loss
+        stays shard-local),
+      - the shard-0 survivors commit THROUGH the loss via the PR-4
+        failover under shard-scoped keys, with the recovery leader's
+        balanced mass report bucketing the dead holder as lost,
+      - the dc mate re-shards (fenced gen+1) and recovers the victim's
+        LATEST shard bytes from its runner-up replica — the no-epoch-
+        restart property: post-mutation state survives, nobody falls
+        back to the epoch-0 seed — with the recovery latency recorded.
+
+    Artifact: experiments/results/chaos_shard.json."""
+    gather_timeout = 8.0
+    rot_cell = {"rot": 0}
+    boot_t = Transport()
+    boot_dht = DHTNode(boot_t)
+    await boot_dht.start(bootstrap=None)
+    base = np.arange(SHARD_SOAK_ELEMS, dtype=np.float32)
+    vols = []
+    out = {"seed": args.seed, "kill_rounds": args.shard_rounds,
+           "zones": list(SHARD_SOAK_ZONES), "k": 2,
+           "tree_elems": SHARD_SOAK_ELEMS, "per_round": []}
+    try:
+        by_zone = {}
+        for zone in SHARD_SOAK_ZONES:
+            pa, pb = _shard_soak_ids(zone)
+            by_zone[zone] = (pa, pb)
+            for pid in (pa, pb):
+                vols.append(await _make_shard_node(
+                    pid, zone, boot_t.addr, rot_cell, gather_timeout,
+                ))
+        pid_of = {v["pid"]: v for v in vols}
+        victim = pid_of[by_zone["dc"][0]]
+        mate = pid_of[by_zone["dc"][1]]
+        survivors = [pid_of[by_zone[z][0]] for z in ("eu", "home")]
+        s1_trio = [pid_of[by_zone[z][1]] for z in SHARD_SOAK_ZONES]
+
+        # Synchronized first shard adoption: every node sees its full
+        # zone pair, so the two zone-mates compute the SAME gen-0 map
+        # (spawning order must not skew generations within a zone).
+        for v in vols:
+            await v["mem"].alive_peers()
+        await asyncio.gather(*(v["sm"].reshard(recover=False) for v in vols))
+        for v in vols:
+            for s in v["sm"].owned():
+                v["sm"].store.put(s, shard_slice(base, v["sm"].ranges, s).copy())
+            await v["sm"].announce()
+        # Runner-up replicas via the real fenced fetch path, and a
+        # membership re-announce so the shard adverts propagate before
+        # the first rotation partitions on them.
+        await asyncio.gather(*(v["sm"].refresh_replicas() for v in vols))
+        for v in vols:
+            await v["mem"].join()
+        for v in vols:
+            # The shard adverts postdate the priming snapshot above — drop
+            # it so the first rotation partitions on fresh records.
+            v["mem"].invalidate_snapshot()
+            await v["mem"].alive_peers()
+        lo0, hi0 = victim["sm"].ranges[0]
+
+        # Healthy warmup: both shard trios commit on the pinned schedule.
+        rot = 1
+        for r in range(2):
+            rot_cell["rot"] = rot
+            results = await asyncio.gather(
+                *(_timed_shard_average(v, i, r) for i, v in enumerate(vols))
+            )
+            assert all(
+                res is not None and not isinstance(res, BaseException)
+                for _, res in results
+            ), f"healthy sharded warmup round {r} failed"
+            rot += 1
+        out["warmup_rounds"] = 2
+
+        for k in range(args.shard_rounds):
+            phase = SHARD_KILL_PHASES[k % len(SHARD_KILL_PHASES)]
+            rot_cell["rot"] = rot
+            # Mutate the doomed holder's shard and push the change to its
+            # runner-up replica (the commit-time refresh), so recovery
+            # has to produce THESE bytes — not the epoch-0 seed.
+            expect_s0 = base[lo0:hi0] + float(k + 1)
+            victim["sm"].store.put(0, expect_s0.copy())
+            await mate["sm"].refresh_replicas()
+            before = {
+                v["pid"]: (v["avg"].leaders_deposed, v["avg"].rounds_recovered,
+                           v["avg"].rounds_ok)
+                for v in vols
+            }
+            before_mass = {
+                v["pid"]: (v["avg"].health.mass_rounds
+                           if v["avg"].health is not None else 0)
+                for v in vols
+            }
+            _install_kill(victim, phase)
+            results = await asyncio.gather(
+                *(_timed_shard_average(v, 100 + i, 100 + k)
+                  for i, v in enumerate(vols))
+            )
+            by_pid = {v["pid"]: res for v, res in zip(vols, results)}
+            s1_ok = [
+                by_pid[v["pid"]][1] is not None
+                and not isinstance(by_pid[v["pid"]][1], BaseException)
+                for v in s1_trio
+            ]
+            s1_clean = all(
+                (v["avg"].leaders_deposed, v["avg"].rounds_recovered)
+                == before[v["pid"]][:2]
+                for v in s1_trio
+            )
+            surv_ok = [
+                by_pid[v["pid"]][1] is not None
+                and not isinstance(by_pid[v["pid"]][1], BaseException)
+                for v in survivors
+            ]
+            surv_recovered = sum(
+                v["avg"].rounds_recovered > before[v["pid"]][1]
+                for v in survivors
+            )
+            # The recovery leader's balanced mass report: every armed slot
+            # in exactly one bucket (the sums close), the dead leader's
+            # weight in a LOST bucket, and the shard rollup tagged.
+            mass_balanced = lost_bucketed = False
+            shard_tags = []
+            for v in survivors:
+                h = v["avg"].health
+                if h is None or h.mass_rounds <= before_mass[v["pid"]]:
+                    continue
+                m = h._last_mass or {}
+                total = (
+                    m.get("included_weight", 0.0)
+                    + m.get("recovered_weight", 0.0)
+                    + m.get("excluded_weight", 0.0)
+                    + m.get("aborted_weight", 0.0)
+                )
+                mass_balanced = (
+                    abs(total - m.get("armed_weight", -1.0)) <= 2e-6
+                )
+                # Informational: a deposed leader never armed a slot in
+                # its deposer's aggregation, so recovery rounds usually
+                # have NO lost bucket (the mid-stream-abort bucketing is
+                # the aggregation-level property test's job) — what this
+                # path guarantees is a balanced, shard-tagged report.
+                lost_bucketed = (
+                    m.get("excluded_slots", 0) + m.get("aborted_slots", 0)
+                ) >= 1
+                shard_tags = sorted((m.get("by_shard") or {}).keys())
+                break
+            # Fenced re-shard + recovery on the zone mate: the victim's
+            # shard must come back bytes-for-bytes at its LATEST state.
+            gen_before = mate["sm"].map.gen
+            rec_before = mate["sm"].recoveries
+            t0 = time.monotonic()
+            await mate["sm"].reshard(
+                members=[mate["pid"]], reason="sigkill"
+            )
+            recovery_s = time.monotonic() - t0
+            got = mate["sm"].store.get(0, allow_replica=False)
+            recovered_equal = got is not None and np.array_equal(
+                got, expect_s0
+            )
+            out["per_round"].append({
+                "round": k,
+                "rot": rot,
+                "phase": phase,
+                "victim": victim["pid"],
+                "s1_all_committed": all(s1_ok),
+                "s1_failover_clean": s1_clean,
+                "s0_survivors_committed": all(surv_ok),
+                "s0_survivors_recovered": surv_recovered,
+                "mass_balanced": mass_balanced,
+                "lost_mass_bucketed": lost_bucketed,
+                "mass_shard_tags": shard_tags,
+                "reshard_gen": mate["sm"].map.gen,
+                "reshard_gen_bumped": mate["sm"].map.gen > gen_before,
+                "shard_recoveries": mate["sm"].recoveries - rec_before,
+                "shard_recovery_s": round(recovery_s, 4),
+                "shard_recovered_equal": recovered_equal,
+                "shard_missing_after": len(mate["sm"].missing()),
+                "survivors_rounds_ok_grew": all(
+                    v["avg"].rounds_ok > before[v["pid"]][2]
+                    for v in survivors
+                ),
+            })
+            # Revive the victim for the next kill round (campaign-only
+            # scaffolding, like _revive_mg's deposition-strike bypass): a
+            # real rebooted holder re-syncs map + bytes through its
+            # maintenance autopilot; the campaign re-adopts the zone's
+            # live map directly so every round measures the SAME fenced
+            # kill, not a cold rejoin.
+            await _revive_mg(victim, vols)
+            arr = mate["sm"].store.get(0)
+            await mate["sm"].reshard(
+                members=[victim["pid"], mate["pid"]], reason="revive"
+            )
+            victim["sm"].map = mate["sm"].map
+            victim["sm"].advertise()
+            if arr is not None:
+                victim["sm"].store.put(0, arr.copy())
+            await victim["sm"].announce()
+            await victim["mem"].join()
+            for v in vols:
+                v["mem"].invalidate_snapshot()
+                await v["mem"].alive_peers()
+            await asyncio.sleep(0.3)
+            rot += 1
+
+        recs = out["per_round"]
+        out["verdict_inputs"] = {
+            "rounds": len(recs),
+            "committed_through_loss_rounds": sum(
+                r["s0_survivors_committed"] and r["s0_survivors_recovered"] > 0
+                for r in recs
+            ),
+            "shard_local_rounds": sum(
+                r["s1_all_committed"] and r["s1_failover_clean"] for r in recs
+            ),
+            "shard_recovered_rounds": sum(
+                r["shard_recovered_equal"]
+                and r["reshard_gen_bumped"]
+                and r["shard_missing_after"] == 0
+                for r in recs
+            ),
+            "mass_balanced_rounds": sum(
+                bool(r["mass_balanced"] and r["mass_shard_tags"])
+                for r in recs
+            ),
+            "no_epoch_restart_rounds": sum(
+                r["shard_recovered_equal"] and r["survivors_rounds_ok_grew"]
+                for r in recs
+            ),
+            "recovery_latency_s": {
+                "max": max(r["shard_recovery_s"] for r in recs),
+                "mean": round(
+                    statistics.mean(r["shard_recovery_s"] for r in recs), 4
+                ),
+            },
+        }
+        out["flight_recorders"] = _flight_dumps(vols)
+    finally:
+        for v in vols:
+            try:
+                await v["mem"].leave()
+            except Exception:
+                pass
+            try:
+                await v["dht"].stop()
+            except Exception:
+                pass
+            try:
+                await v["t"].close()
+            except Exception:
+                pass
+        try:
+            await boot_dht.stop()
+        except Exception:
+            pass
+        await boot_t.close()
+    return out
+
+
+def shard_verdict(camp: dict) -> dict:
+    vi = camp["verdict_inputs"]
+    n = vi["rounds"]
+    return {
+        # Every kill round's shard-0 survivors committed via failover.
+        "pass_rounds_commit_through_loss": (
+            vi["committed_through_loss_rounds"] == n
+        ),
+        # Every round: fenced gen bump + the LATEST shard bytes back on
+        # the zone mate with nothing missing.
+        "pass_shard_recovered": vi["shard_recovered_rounds"] == n,
+        # Every round's recovery leader shipped a balanced mass report
+        # (buckets close on armed weight) with the per-shard rollup.
+        "pass_mass_balanced": vi["mass_balanced_rounds"] == n,
+        # Recovery preserved post-mutation state and the survivors' round
+        # counters kept growing — nobody restarted the epoch.
+        "pass_no_epoch_restart": vi["no_epoch_restart_rounds"] == n,
+        # The kill stays shard-local: the other shard's trio never saw it.
+        "pass_shard_local": vi["shard_local_rounds"] == n,
+        "rounds": n,
+        "recovery_latency_s": vi["recovery_latency_s"],
+    }
 
 
 # -- control-plane campaign (ISSUE 9 acceptance) ----------------------------
@@ -3171,6 +3550,20 @@ def main():
                          "burst mid-campaign")
     ap.add_argument("--multigroup-rounds", type=int, default=6,
                     help="kill rounds in the multigroup arm")
+    ap.add_argument("--shard", action="store_true",
+                    help="run the swarm-sharded arm instead (ISSUE 20): "
+                         "3 zones x 2 shard-holders on the zone-sharded "
+                         "schedule; each kill round the shard-0 trio's "
+                         "leader dies at a cycled phase (pre_arm / "
+                         "mid_stream / post_partial_commit) after "
+                         "mutating its shard — the other shard's trio "
+                         "must commit untouched, the survivors must "
+                         "commit through the loss with balanced mass, "
+                         "and the zone mate must re-shard (fenced gen+1) "
+                         "and recover the LATEST shard bytes from its "
+                         "replica without an epoch restart")
+    ap.add_argument("--shard-rounds", type=int, default=6,
+                    help="kill rounds in the shard arm")
     ap.add_argument("--controlplane", action="store_true",
                     help="run the control-plane arm instead: volunteers "
                          "batch-heartbeating through 3 elected coordinator "
@@ -3251,6 +3644,7 @@ def main():
             "chaos_failover.json" if args.failover
             else "chaos_mesh_degrade.json" if args.mesh_degrade
             else "chaos_multigroup.json" if args.multigroup
+            else "chaos_shard.json" if args.shard
             else "chaos_controlplane.json" if args.controlplane
             else "chaos_health.json" if args.health
             else "chaos_watchdog.json" if args.watchdog
@@ -3265,12 +3659,24 @@ def main():
         args.failover_rounds = 5
         args.mesh_degrade_rounds = 4
         args.multigroup_rounds = 3
+        args.shard_rounds = 3
         args.controlplane_rounds = 2
         args.health_rounds = 8
         args.watchdog_rounds = 6
         args.tail_rounds = 6
         args.adaptive_window_s = 25.0
         args.no_train = True
+
+    if args.shard:
+        result = {"shard_campaign": asyncio.run(shard_campaign(args))}
+        result["verdict"] = shard_verdict(result["shard_campaign"])
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[done] artifact -> {args.out}")
+        print(json.dumps(result["verdict"], indent=2))
+        ok = all(v for k, v in result["verdict"].items() if k.startswith("pass_"))
+        sys.exit(0 if ok else 1)
 
     if args.adaptive:
         result = {"adaptive_campaign": asyncio.run(adaptive_campaign(args))}

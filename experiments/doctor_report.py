@@ -348,7 +348,55 @@ def _rule_policy_flap(bundle: dict) -> Optional[dict]:
     }
 
 
+def _rule_shard_zone_degraded(bundle: dict) -> Optional[dict]:
+    """Shard-holder loss explaining its own downstream symptoms: the
+    ``shard_lost`` flight events are the root evidence, and the shard
+    recovery-latency SLO burn / mass-fraction dips they cause are folded
+    in as corroboration rather than surfaced as independent hypotheses.
+    Same shape as ``policy_flap``: when a zone demonstrably lost shard
+    holders, chasing the latency or mass symptoms separately wastes the
+    operator's time, so this ranks ABOVE the symptom rules. Recoveries
+    that completed (``shard_recovered``) temper the score — a zone that
+    re-shards and refetches within budget is the system working."""
+    lost = _events_of(bundle, "shard_lost")
+    if not lost:
+        return None
+    recovered = _events_of(bundle, "shard_recovered")
+    failed = _events_of(bundle, "shard_recovery_failed")
+    slo = _alerts_of(bundle, "slo_burn", key_prefix="shard_recovery_latency")
+    mass = _alerts_of(bundle, "mass_frac_drop")
+    by_peer = Counter(str(e.get("holder") or e.get("peer") or "?") for e in lost)
+    peers = [p for p, _ in by_peer.most_common(3) if p != "?"]
+    symptoms = len(slo) + len(mass)
+    score = (
+        0.7 * _sat(len(lost), 1)
+        + 0.4 * _sat(symptoms, 1)
+        + 0.3 * _sat(len(failed), 1)
+        - 0.2 * _sat(len(recovered), max(len(lost), 1))
+    )
+    chain = (
+        f"shard holder loss ({len(lost)} shard_lost) -> fenced re-shard + "
+        f"hedged refetch ({len(recovered)} recovered, {len(failed)} failed) "
+        f"-> recovery-latency burn / mass dip ({symptoms} symptom alerts)"
+    )
+    return {
+        "cause": "shard_zone_degraded",
+        "score": round(max(min(score, 1.0), 0.0), 4),
+        "peers": peers,
+        "chain": chain,
+        "evidence": {
+            "shard_lost_events": len(lost),
+            "shard_recovered_events": len(recovered),
+            "shard_recovery_failed_events": len(failed),
+            "shard_recovery_latency_alerts": len(slo),
+            "mass_frac_drop_alerts": len(mass),
+            "losses_by_holder": dict(by_peer),
+        },
+    }
+
+
 RULES = (
+    _rule_shard_zone_degraded,
     _rule_policy_flap,
     _rule_leader_crash_storm,
     _rule_straggler,

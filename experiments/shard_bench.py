@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""Shard bench: cross-zone wire bytes per committed round, replicated vs
+zone-sharded, at K in {1, 2, 4}.
+
+The sharded swarm's claim (ROADMAP / ISSUE 20 tentpole): partition the
+parameter tree into K zone-local shards — one holder per shard per zone —
+and a cross-zone rotation averages only YOUR OWN shard with the peer
+zones' holders of the same shard. Every volunteer's WAN bill per
+committed round is then ~1/K of the replicated swarm's, because the
+payload it pushes and pulls is its 1/K slice instead of the full tree.
+
+Arms (one per K; K=1 IS the replicated baseline — no shard tags, full
+tree on the wire):
+
+  K=1  — replicated: every volunteer averages the full tree cross-zone.
+  K=2  — two shards per zone: each volunteer moves its half.
+  K=4  — four shards per zone: each volunteer moves its quarter.
+
+Every config runs 2 zones x K volunteers with a pinned-rotation schedule
+where EVERY rotation is a cross-zone one (cross_zone_every_k=1) — the
+worst case for the WAN, which is exactly where sharding pays. Cross-zone
+bytes are measured from the transport's per-peer counters joined against
+the membership zone map (Averager.zone_traffic) — the same live
+accounting coord.status rolls up, not a model — and normalized per
+committed volunteer-round so configs with different swarm sizes compare
+fairly.
+
+The two-zone WAN is modeled with ChaosTransport.set_link (latency +
+serialization bandwidth on every cross-zone edge), so round wall time
+also reflects the thinner payloads.
+
+Acceptance (asserted loudly by tests/test_sharding.py's bench smoke):
+bytes/commit must fall >= 1.5x from K=1 to K=2 and again from K=2 to
+K=4 — i.e. ~linearly in K.
+
+Artifact: experiments/results/shard_bench.json (committed).
+
+Usage:
+    python experiments/shard_bench.py            # full campaign
+    python experiments/shard_bench.py --quick    # smaller tree, 2 rounds
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.chaos import ChaosTransport  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.matchmaking import GroupSchedule  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership  # noqa: E402
+from distributedvolunteercomputing_tpu.swarm.sharding import shard_ranges  # noqa: E402
+
+TREE_ELEMS = 131_072         # 512 KiB f32 full tree
+ROUNDS = 4
+ZONES = ("dc", "home")
+# Cross-zone WAN edge (~64 Mbit/s, 30 ms); intra-zone is localhost.
+INTER_ZONE_LAT_S = 0.03
+INTER_ZONE_BW_BPS = 8e6
+
+
+async def _teardown(nodes):
+    for nd in nodes:
+        try:
+            await nd["mem"].leave()
+        except Exception:
+            pass
+        try:
+            await nd["dht"].stop()
+        except Exception:
+            pass
+        try:
+            await nd["t"].close()
+        except Exception:
+            pass
+    ChaosTransport._partitions.clear()
+    ChaosTransport._links.clear()
+
+
+def _xz_sent(nodes):
+    return sum(
+        nd["avg"].zone_traffic()["cross_zone_bytes_sent"] for nd in nodes
+    )
+
+
+async def run_config(
+    k: int,
+    *,
+    tree_elems: int = TREE_ELEMS,
+    rounds: int = ROUNDS,
+    links: bool = False,
+    inter_lat: float = INTER_ZONE_LAT_S,
+    inter_bw: float = INTER_ZONE_BW_BPS,
+) -> dict:
+    """One K cell, in-process: 2 zones x K volunteers. K=1 replicates the
+    full tree; K>1 tags each volunteer with its shard so cross rotations
+    rendezvous same-shard holders across zones, each averaging only its
+    ``shard_ranges(tree_elems, k)`` slice. Reports cross-zone bytes per
+    committed volunteer-round (the per-volunteer WAN bill)."""
+    assert k >= 1
+    rot_cell = {"rot": 0}
+    ranges = shard_ranges(tree_elems, k)
+    nodes = []
+    boot = None
+    try:
+        for zi, zone in enumerate(ZONES):
+            for s in range(k):
+                t = ChaosTransport()
+                dht = DHTNode(t, maintenance_interval=120.0)
+                await dht.start(bootstrap=[boot] if boot else None)
+                boot = boot or t.addr
+                extra = {"zone": zone}
+                if k > 1:
+                    extra["shard"] = s
+                pid = f"k{k}z{zi}s{s}"
+                mem = SwarmMembership(dht, pid, ttl=30.0, extra_info=extra)
+                await mem.join()
+                avg = SyncAverager(
+                    t, dht, mem,
+                    min_group=2, max_group=6,
+                    join_timeout=6.0, gather_timeout=10.0,
+                    group_schedule=GroupSchedule(
+                        target_size=2, rotation_s=1000.0, min_size=2,
+                        cross_zone_every_k=1,  # every rotation crosses
+                        clock=lambda: rot_cell["rot"] * 1000.0 + 0.5,
+                    ),
+                )
+                nodes.append({
+                    "pid": pid, "zone": zone, "shard": s if k > 1 else None,
+                    "t": t, "dht": dht, "mem": mem, "avg": avg,
+                })
+        if links:
+            for i, a in enumerate(nodes):
+                for b in nodes[i + 1:]:
+                    if a["zone"] != b["zone"]:
+                        a["t"].set_link(
+                            a["t"].addr, b["t"].addr, inter_lat, inter_bw
+                        )
+        for nd in nodes:
+            await nd["mem"].alive_peers()  # prime snapshots + zone maps
+        xz0 = _xz_sent(nodes)
+        dts, committed = [], 0
+        t_start = time.monotonic()
+
+        def payload(nd):
+            # Replicated: the full tree. Sharded: your slice only — the
+            # whole point of the exercise.
+            if nd["shard"] is None:
+                elems = tree_elems
+            else:
+                lo, hi = ranges[nd["shard"]]
+                elems = hi - lo
+            return {"w": np.full((elems,), 1.0, np.float32)}
+
+        async def one(nd, r):
+            t0 = time.monotonic()
+            try:
+                res = await asyncio.wait_for(
+                    nd["avg"].average(payload(nd), round_no=r), timeout=40.0
+                )
+            except Exception:
+                res = None
+            return time.monotonic() - t0, res
+
+        for r in range(1, rounds + 1):
+            rot_cell["rot"] = r
+            results = await asyncio.gather(*(one(nd, r) for nd in nodes))
+            for dt, res in results:
+                dts.append(dt)
+                if res is not None:
+                    committed += 1
+        wall = time.monotonic() - t_start
+        xz_bytes = _xz_sent(nodes) - xz0
+        shard_ids = sorted(
+            {
+                nd["avg"].group_stats().get("group_id", "")
+                for nd in nodes
+                if nd["shard"] is not None
+            }
+        )
+    finally:
+        await _teardown(nodes)
+    dts.sort()
+    node_rounds = rounds * len(nodes)
+    return {
+        "k": k, "zones": len(ZONES), "volunteers": len(ZONES) * k,
+        "tree_elems": tree_elems, "tree_bytes": tree_elems * 4,
+        "slice_bytes": (ranges[0][1] - ranges[0][0]) * 4,
+        "rounds": rounds, "links_modeled": links,
+        "node_rounds": node_rounds,
+        "committed_node_rounds": committed,
+        "commit_frac": round(committed / max(node_rounds, 1), 4),
+        "round_s_median": round(statistics.median(dts), 4) if dts else None,
+        "campaign_wall_s": round(wall, 2),
+        "cross_zone_bytes": xz_bytes,
+        "xz_bytes_per_commit": round(xz_bytes / max(committed, 1), 1),
+        "sharded_group_ids": shard_ids,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tree-elems", type=int, default=TREE_ELEMS)
+    ap.add_argument("--rounds", type=int, default=ROUNDS)
+    ap.add_argument("--links", action="store_true",
+                    help="model the thin cross-zone WAN edges")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "experiments", "results", "shard_bench.json"))
+    args = ap.parse_args()
+    if args.quick:
+        args.tree_elems, args.rounds = 32_768, 2
+
+    cells = {}
+    for k in (1, 2, 4):
+        print(f"[cell] k={k}", flush=True)
+        cells[str(k)] = asyncio.run(run_config(
+            k, tree_elems=args.tree_elems, rounds=args.rounds,
+            links=args.links,
+        ))
+        c = cells[str(k)]
+        print(f"[cell] -> commit_frac {c['commit_frac']}, "
+              f"xz B/commit {c['xz_bytes_per_commit']}, "
+              f"round median {c['round_s_median']}s", flush=True)
+
+    b1 = cells["1"]["xz_bytes_per_commit"]
+    b2 = cells["2"]["xz_bytes_per_commit"]
+    b4 = cells["4"]["xz_bytes_per_commit"]
+    verdict = {
+        "xz_bytes_per_commit_k1": b1,
+        "xz_bytes_per_commit_k2": b2,
+        "xz_bytes_per_commit_k4": b4,
+        "ratio_k1_over_k2": round(b1 / max(b2, 1.0), 2),
+        "ratio_k2_over_k4": round(b2 / max(b4, 1.0), 2),
+        "ratio_k1_over_k4": round(b1 / max(b4, 1.0), 2),
+        # Acceptance: ~linear in K — each doubling of K must keep paying
+        # >= 1.5x on the per-volunteer cross-zone wire bill.
+        "pass_k2_beats_replicated": b1 / max(b2, 1.0) >= 1.5,
+        "pass_k4_beats_k2": b2 / max(b4, 1.0) >= 1.5,
+        "pass_all_commit": all(
+            c["commit_frac"] >= 0.7 for c in cells.values()
+        ),
+    }
+    verdict["pass"] = bool(
+        verdict["pass_k2_beats_replicated"]
+        and verdict["pass_k4_beats_k2"]
+        and verdict["pass_all_commit"]
+    )
+    result = {
+        "inter_zone_lat_s": INTER_ZONE_LAT_S if args.links else None,
+        "inter_zone_bw_bps": INTER_ZONE_BW_BPS if args.links else None,
+        "host_cores": os.cpu_count(),
+        "cells": cells,
+        "verdict": verdict,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"[done] artifact -> {args.out}")
+    print(json.dumps(verdict, indent=2))
+    sys.exit(0 if verdict["pass"] else 1)
+
+
+if __name__ == "__main__":
+    main()

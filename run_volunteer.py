@@ -136,6 +136,16 @@ def main() -> None:
                          "rounds per level, Moshpit-style). 0 = flat "
                          "single-level grid; degrades to flat while fewer "
                          "than two zones are advertised")
+    ap.add_argument("--zone-shards", type=int, default=0,
+                    help="zone-sharded training: partition the averaged "
+                         "parameter tree into K zone-local shards — this "
+                         "volunteer holds its HRW-assigned shard(s), "
+                         "advertises its primary shard so cross-zone "
+                         "rotations average only same-shard holders "
+                         "(~1/K wire bytes per round), and re-shards with "
+                         "generation fencing + hedged recovery on zone "
+                         "churn. Requires --zone; with averaging, also "
+                         "--group-size. 0 = unsharded (full replica)")
     ap.add_argument("--method", default="trimmed_mean",
                     help="byzantine estimator: trimmed_mean|median|krum|"
                          "geometric_median|bulyan|centered_clip")
@@ -346,6 +356,7 @@ def main() -> None:
         group_rotation_s=args.group_rotation_s,
         zone=args.zone,
         cross_zone_every_k=args.cross_zone_every_k,
+        zone_shards=args.zone_shards,
         method=args.method,
         method_kw=method_kw or None,
         batch_size=args.batch_size,

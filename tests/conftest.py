@@ -140,6 +140,16 @@ def pytest_configure(config):
         "watchdog overhead smoke) — in the default lane, and selectable "
         "on their own with -m watchdog",
     )
+    config.addinivalue_line(
+        "markers",
+        "sharding: zone-sharded training tests (HRW shard map stability "
+        "under churn, generation fencing both ends, fenced re-shard + "
+        "hedged shard recovery, kill-at-phase matrix on shard holders, "
+        "per-shard mass-balance property test, shard-scoped matchmaking, "
+        "control-plane snapshot deltas, OOM-sized model across a sharded "
+        "zone, bytes-vs-K bench smoke) — in the default lane, and "
+        "selectable on their own with -m sharding",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,1424 @@
+"""Zone-sharded training tests (ISSUE 20): churn-tolerant zone sharding
+with fenced re-shard recovery.
+
+Layers:
+
+1. ``shard_ranges`` / ``ShardMap`` math — schema-stable cuts (a pure
+   function of (n_elems, K)), HRW holder assignment with the
+   minimal-disruption property, domain decorrelation.
+2. ``ShardStore`` bookkeeping — own/replica roles, promotion, the
+   ``peak_bytes`` high-water the memory acceptance test rides on.
+3. Generation fencing, both ends — a stale requester is rejected by the
+   serving side, a lying reply is rejected by the pulling side, and a
+   map that moves mid-pull discards the bytes (the adopter fence). The
+   cross-zone rung crosses generation SEQUENCES and is fenced by the
+   adopter check alone.
+4. Fenced re-shard + hedged recovery — kill a holder, survivors re-shard
+   and recover through the replica/prev-holder/cross-zone ladder with
+   flight events and recovery latency on the record.
+5. Shard-scoped matchmaking — same-shard grouping, ``.s<k>.`` group ids,
+   sharded/unsharded view isolation, per-shard partition.
+6. Per-shard mass accounting — the balance property through a mid-round
+   holder loss, rolled up per shard bucket.
+7. The memory acceptance test — a flat model bigger than any single
+   holder's asserted budget trains across a zone of K sharded holders,
+   with the measured high-water a ~1/K sliver of the full replica, and a
+   mid-training SIGKILL recovered without restarting the epoch.
+8. In-process kill-at-phase on a sharded swarm (leader-phase hooks), the
+   bytes-vs-K bench smoke (loud), control-plane snapshot deltas, the
+   ``shard_zone_degraded`` doctor rule, the ``shard_recovery_latency``
+   SLO, the controller regime feed, and the ring-lowering gauge.
+
+The subprocess SIGKILL matrix lives in tests/test_sharding_e2e.py (slow
+lane); the churn campaign artifact is experiments/chaos_soak.py --shard.
+"""
+
+import asyncio
+import statistics
+import time as _time
+
+import numpy as np
+import pytest
+
+from distributedvolunteercomputing_tpu.swarm import health as H
+from distributedvolunteercomputing_tpu.swarm import telemetry as T
+from distributedvolunteercomputing_tpu.swarm.agg_stream import (
+    StreamingAggregator,
+    TilePool,
+)
+from distributedvolunteercomputing_tpu.swarm.averager import SyncAverager
+from distributedvolunteercomputing_tpu.swarm.dht import DHTNode
+from distributedvolunteercomputing_tpu.swarm.matchmaking import GroupSchedule
+from distributedvolunteercomputing_tpu.swarm.membership import SwarmMembership
+from distributedvolunteercomputing_tpu.swarm.sharding import (
+    ShardManager,
+    ShardMap,
+    ShardStore,
+    shard_ranges,
+    shard_slice,
+)
+from distributedvolunteercomputing_tpu.swarm.transport import RPCError, Transport
+
+pytestmark = pytest.mark.sharding
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+class FastHedge:
+    """Resilience stub: a tight hedge soft-deadline so the recovery
+    ladder's second rung joins fast in tests."""
+
+    def hedge_params(self, level):
+        return (0.05, 2)
+
+
+# -- 1. ranges + map ---------------------------------------------------------
+
+
+class TestShardRanges:
+    def test_cover_and_balance(self):
+        for n, k in ((10, 3), (7, 7), (0, 2), (100, 1), (5, 8)):
+            r = shard_ranges(n, k)
+            assert len(r) == k
+            assert r[0][0] == 0 and r[-1][1] == n
+            sizes = [hi - lo for lo, hi in r]
+            assert all(r[i][1] == r[i + 1][0] for i in range(k - 1))
+            assert max(sizes) - min(sizes) <= 1
+
+    def test_pure_function_of_n_and_k(self):
+        # The schema-stability rule: membership never enters the cut.
+        assert shard_ranges(1000, 4) == shard_ranges(1000, 4)
+
+    def test_slice_views(self):
+        buf = np.arange(10, dtype=np.float32)
+        r = shard_ranges(10, 3)
+        np.testing.assert_array_equal(shard_slice(buf, r, 1), buf[4:7])
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            shard_ranges(10, 0)
+        with pytest.raises(ValueError):
+            shard_ranges(-1, 2)
+
+
+class TestShardMap:
+    def test_hrw_minimal_disruption(self):
+        """A departed member's shards move; everyone else's stay put —
+        the property that keeps churn from becoming a zone-wide state
+        migration."""
+        members = tuple(f"m{i}" for i in range(6))
+        k = 32
+        before = ShardMap(members=members, k=k, gen=0, domain="z|")
+        after = ShardMap(
+            members=tuple(m for m in members if m != "m2"), k=k, gen=1,
+            domain="z|",
+        )
+        for s in range(k):
+            h0, h1 = before.holder_of(s), after.holder_of(s)
+            if h0 != "m2":
+                assert h1 == h0, (s, h0, h1)
+            else:
+                assert h1 in after.members
+
+    def test_deterministic_and_replica_distinct(self):
+        m = ShardMap(members=("a", "b", "c"), k=8, gen=3, domain="d|ns")
+        m2 = ShardMap(members=("c", "a", "b"), k=8, gen=3, domain="d|ns")
+        for s in range(8):
+            assert m.ranking(s) == m2.ranking(s)
+            assert m.holder_of(s) != m.replica_of(s)
+        assert m.replica_of(0) is not None
+        solo = ShardMap(members=("a",), k=4, gen=0)
+        assert solo.replica_of(0) is None
+
+    def test_every_shard_owned_and_primary(self):
+        m = ShardMap(members=("a", "b", "c"), k=6, gen=0, domain="z|")
+        owned = [m.shards_of(p) for p in m.members]
+        assert sorted(s for o in owned for s in o) == list(range(6))
+        for p in m.members:
+            ps = m.primary_shard_of(p)
+            if m.shards_of(p):
+                assert ps == m.shards_of(p)[0]
+            else:
+                assert ps is None
+
+    def test_domains_decorrelate(self):
+        """Two zones sharding the same model must not compute correlated
+        rankings (else both zones' shard-s holders churn together)."""
+        a = ShardMap(members=("a", "b", "c", "d"), k=32, gen=0, domain="dc|m")
+        b = ShardMap(members=("a", "b", "c", "d"), k=32, gen=0, domain="home|m")
+        assert any(a.holder_of(s) != b.holder_of(s) for s in range(32))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ShardMap(members=("a",), k=0, gen=0)
+        with pytest.raises(ValueError):
+            ShardMap(members=("a",), k=1, gen=-1)
+
+
+class TestShardStore:
+    def test_roles_promotion_and_high_water(self):
+        st = ShardStore()
+        a = np.ones(100, np.float32)
+        st.put(0, a, replica=True)
+        assert st.held() == [] and st.replicas() == [0]
+        assert st.get(0, allow_replica=False) is None
+        assert st.get(0) is not None
+        assert st.promote(0)
+        assert st.held() == [0] and st.replicas() == []
+        assert not st.promote(0)  # nothing left to promote
+        st.put(1, a)
+        peak = st.peak_bytes
+        assert peak == st.bytes() == 2 * a.nbytes
+        st.drop(1)
+        assert st.bytes() == a.nbytes
+        assert st.peak_bytes == peak  # high-water never falls
+        # An own put replaces the replica copy instead of double-holding.
+        st.put(2, a, replica=True)
+        st.put(2, a)
+        assert st.replicas() == [] and st.held() == [0, 2]
+
+
+# -- helpers for live-manager tests ------------------------------------------
+
+
+async def spawn_node(pid, zone, *, boot=None, k=2, n_elems=64, ns=""):
+    t = Transport()
+    dht = DHTNode(t)
+    await dht.start(bootstrap=[boot] if boot else None)
+    mem = SwarmMembership(dht, pid, ttl=10.0, extra_info={"zone": zone})
+    await mem.join()
+    mgr = ShardManager(
+        t, dht, mem, pid, n_elems=n_elems, k=k, namespace=ns, zone=zone,
+        telemetry=T.Telemetry(peer_id=pid), resilience=FastHedge(),
+    )
+    return {"t": t, "dht": dht, "mem": mem, "mgr": mgr, "pid": pid}
+
+
+async def teardown_nodes(nodes):
+    for n in nodes:
+        try:
+            await n["dht"].stop()
+        except Exception:
+            pass
+        try:
+            await n["t"].close()
+        except Exception:
+            pass
+
+
+async def prime(nodes):
+    for n in nodes:
+        await n["mem"].alive_peers()
+
+
+def seed_owned(nodes, target):
+    """Give every manager the shards it owns, cut from ``target``."""
+    for n in nodes:
+        m = n["mgr"]
+        for s in m.owned():
+            m.store.put(s, shard_slice(target, m.ranges, s).copy())
+
+
+def events_of(mgr, kind):
+    return mgr.telemetry.recorder.dump(kinds=[kind])
+
+
+# -- 3. fencing --------------------------------------------------------------
+
+
+class TestFencing:
+    def test_stale_requester_rejected_and_recorded(self):
+        async def main():
+            a = await spawn_node("fa", "dc", k=2, n_elems=64)
+            b = await spawn_node("fb", "dc", boot=a["t"].addr, k=2, n_elems=64)
+            nodes = [a, b]
+            try:
+                await prime(nodes)
+                members = ["fa", "fb"]
+                for n in nodes:
+                    await n["mgr"].reshard(members=members, recover=False)
+                target = np.arange(64, dtype=np.float32)
+                seed_owned(nodes, target)
+                holder = a if a["mgr"].owned() else b
+                other = b if holder is a else a
+                s = holder["mgr"].owned()[0]
+                # Correct generation: bytes move.
+                arr = await other["mgr"]._fetch_from(
+                    holder["t"].addr, s, holder["mgr"].map.gen
+                )
+                np.testing.assert_array_equal(
+                    arr, shard_slice(target, holder["mgr"].ranges, s)
+                )
+                # Stale generation: rejected loudly, with the flight event.
+                with pytest.raises(RPCError, match="fencing mismatch"):
+                    await other["mgr"]._fetch_from(holder["t"].addr, s, 99)
+                assert holder["mgr"].fence_rejections == 1
+                evs = events_of(holder["mgr"], "shard_fence_rejected")
+                assert evs and evs[0]["got_gen"] == 99
+                assert evs[0]["sev"] == "warn"
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main())
+
+    def test_lying_reply_rejected_by_puller(self):
+        async def main():
+            a = await spawn_node("la", "dc", k=1, n_elems=16)
+            b = await spawn_node("lb", "dc", boot=a["t"].addr, k=1, n_elems=16)
+            nodes = [a, b]
+            try:
+                await prime(nodes)
+                for n in nodes:
+                    await n["mgr"].reshard(members=["la", "lb"], recover=False)
+                target = np.ones(16, np.float32)
+                seed_owned(nodes, target)
+                holder = a if a["mgr"].owned() else b
+                other = b if holder is a else a
+                orig = holder["mgr"]._rpc_fetch
+
+                async def lying(args, payload):
+                    ret, data = await orig(args, payload)
+                    ret["gen"] = 41  # a deposed holder's stale serve
+                    return ret, data
+
+                holder["t"].register("shard.fetch", lying)
+                with pytest.raises(RPCError, match="fencing mismatch in reply"):
+                    await other["mgr"]._fetch_from(
+                        holder["t"].addr, 0, holder["mgr"].map.gen
+                    )
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main())
+
+    def test_map_moved_mid_pull_discards_bytes(self):
+        """The adopter fence: a reshard landing between the fetch dispatch
+        and the adoption discards the pulled bytes instead of mixing an
+        old map's state into the new one."""
+
+        async def main():
+            a = await spawn_node("ma", "dc", k=1, n_elems=16)
+            b = await spawn_node("mb", "dc", boot=a["t"].addr, k=1, n_elems=16)
+            nodes = [a, b]
+            try:
+                await prime(nodes)
+                for n in nodes:
+                    await n["mgr"].reshard(members=["ma", "mb"], recover=False)
+                target = np.full(16, 3.0, np.float32)
+                seed_owned(nodes, target)
+                holder = a if a["mgr"].owned() else b
+                other = b if holder is a else a
+                om = other["mgr"]
+                # Force `other` to own the shard so the ladder runs, then
+                # move its map mid-pull.
+                om._prev_holders = {0: holder["pid"]}
+                real_fetch = om._fetch_from
+
+                async def racing_fetch(addr, shard, gen, **kw):
+                    arr = await real_fetch(addr, shard, gen, **kw)
+                    # Churn lands while the pull is in flight.
+                    object.__setattr__(om.map, "gen", gen)  # keep frozen type
+                    om.map = ShardMap(
+                        members=(om.peer_id,), k=1, gen=gen + 1,
+                        domain=om.domain,
+                    )
+                    return arr
+
+                om._fetch_from = racing_fetch
+                ok = await om._recover_shard(0)
+                assert not ok, "bytes adopted across a mid-pull reshard"
+                assert om.store.get(0) is None
+                evs = events_of(om, "shard_fence_rejected")
+                assert evs, "adopter-side rejection must leave a flight event"
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main())
+
+
+# -- 4. re-shard + hedged recovery -------------------------------------------
+
+
+class TestReshardRecovery:
+    def test_kill_one_holder_recovers_without_epoch_restart(self):
+        """Three holders, k=3, replicas refreshed (the commit-time rung),
+        then one holder is killed abruptly. The survivors re-shard at
+        generation+1 and close every missing shard through the ladder —
+        with shard_lost/shard_recovered flight events, a recorded
+        recovery latency, and balanced state (every shard byte-identical
+        to the original)."""
+
+        async def main():
+            a = await spawn_node("ra", "dc", k=3, n_elems=99)
+            boot = a["t"].addr
+            b = await spawn_node("rb", "dc", boot=boot, k=3, n_elems=99)
+            c = await spawn_node("rc", "dc", boot=boot, k=3, n_elems=99)
+            nodes = [a, b, c]
+            try:
+                await prime(nodes)
+                members = ["ra", "rb", "rc"]
+                for n in nodes:
+                    await n["mgr"].reshard(members=members, recover=False)
+                target = np.arange(99, dtype=np.float32)
+                seed_owned(nodes, target)
+                for n in nodes:
+                    await n["mgr"].refresh_replicas()
+                # Abrupt death (protocol-level kill -9): no leave.
+                victim = next(n for n in nodes if n["mgr"].owned())
+                survivors = [n for n in nodes if n is not victim]
+                lost_shards = victim["mgr"].owned()
+                await victim["dht"].stop()
+                await victim["t"].close()
+                left = [n["pid"] for n in survivors]
+                outs = await asyncio.gather(
+                    *(
+                        n["mgr"].reshard(members=left, reason="sigkill")
+                        for n in survivors
+                    )
+                )
+                assert all(o["changed"] and o["gen"] == 1 for o in outs)
+                # Every shard is held somewhere, byte-identical.
+                for s in range(3):
+                    holders = [
+                        n for n in survivors
+                        if s in n["mgr"].owned()
+                    ]
+                    assert len(holders) == 1, (s, [n["pid"] for n in holders])
+                    got = holders[0]["mgr"].store.get(s, allow_replica=False)
+                    assert got is not None, f"shard {s} unrecovered"
+                    np.testing.assert_array_equal(
+                        got, shard_slice(target, holders[0]["mgr"].ranges, s)
+                    )
+                # Events + latency on the record, health back to ok.
+                lost_evs = [
+                    e for n in survivors
+                    for e in events_of(n["mgr"], "shard_lost")
+                ]
+                assert {e["shard"] for e in lost_evs} == set(lost_shards)
+                assert all(e["holder"] == victim["pid"] for e in lost_evs)
+                rec_evs = [
+                    e for n in survivors
+                    for e in events_of(n["mgr"], "shard_recovered")
+                ]
+                assert rec_evs
+                assert all(e["dt_s"] >= 0.0 for e in rec_evs)
+                assert all(
+                    e["src"] in ("local_replica", "zone_replica", "prev_holder")
+                    for e in rec_evs
+                )
+                for n in survivors:
+                    sm = n["mgr"].summary()
+                    assert sm["health"] == "ok"
+                    assert sm["missing"] == []
+                    assert sm["gen"] == 1
+                    if n["mgr"].recoveries:
+                        assert sm["recent_recovery_latency_s"] is not None
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main(), timeout=180)
+
+    def test_reshard_idempotent_on_unchanged_members(self):
+        async def main():
+            a = await spawn_node("ia", "dc", k=2, n_elems=8)
+            try:
+                r1 = await a["mgr"].reshard(members=["ia"], recover=False)
+                r2 = await a["mgr"].reshard(members=["ia"], recover=False)
+                assert r1["changed"] and not r2["changed"]
+                assert a["mgr"].map.gen == 0
+                assert a["mgr"].resharding_count == 1
+            finally:
+                await teardown_nodes([a])
+
+        run(main())
+
+    def test_cross_zone_rung_crosses_generation_sequences(self):
+        """A zone that lost EVERY local copy recovers from another zone's
+        holders via the DHT shard announce — even though the two zones'
+        generation counters disagree (they are independent sequences;
+        the adopter fence is the guard on this rung)."""
+
+        async def main():
+            b1 = await spawn_node("zb1", "home", k=2, n_elems=40)
+            boot = b1["t"].addr
+            b2 = await spawn_node("zb2", "home", boot=boot, k=2, n_elems=40)
+            a = await spawn_node("za", "dc", boot=boot, k=2, n_elems=40)
+            nodes = [b1, b2, a]
+            try:
+                await prime(nodes)
+                # Zone "home" walks its generation ahead of zone "dc"'s.
+                for n in (b1, b2):
+                    await n["mgr"].reshard(members=["zb1"], recover=False)
+                    await n["mgr"].reshard(
+                        members=["zb1", "zb2"], recover=False
+                    )
+                target = np.linspace(0.0, 1.0, 40).astype(np.float32)
+                seed_owned([b1, b2], target)
+                for n in (b1, b2):
+                    await n["mgr"].announce()
+                # Zone "dc": one member, no local copies, gen 0 != home's 1.
+                await a["mgr"].reshard(members=["za"], recover=False)
+                assert a["mgr"].map.gen != b1["mgr"].map.gen
+                recovered = await a["mgr"].ensure_shards()
+                assert sorted(recovered) == [0, 1]
+                full = np.concatenate(
+                    [a["mgr"].store.get(s) for s in (0, 1)]
+                )
+                np.testing.assert_array_equal(full, target)
+                srcs = {
+                    e["src"] for e in events_of(a["mgr"], "shard_recovered")
+                }
+                assert srcs == {"cross_zone"}
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main(), timeout=180)
+
+    def test_recovery_failed_pages_when_ladder_empty(self):
+        async def main():
+            a = await spawn_node("pa", "dc", k=1, n_elems=8)
+            try:
+                await a["mgr"].reshard(members=["pa"], recover=False)
+                recovered = await a["mgr"].ensure_shards()
+                assert recovered == []
+                assert a["mgr"].recoveries_failed == 1
+                evs = events_of(a["mgr"], "shard_recovery_failed")
+                assert evs and evs[0]["sev"] == "page"
+                assert a["mgr"].health() == "degraded"
+            finally:
+                await teardown_nodes([a])
+
+        run(main())
+
+    def test_mid_resharding_kill_in_process(self):
+        """The fourth kill-at-phase column: a holder dying INSIDE its own
+        re-shard (after adopting the new map, before dropping old copies)
+        leaves the old copies for the survivors' ladders — the drop runs
+        after the phase point by design."""
+
+        async def main():
+            a = await spawn_node("ka", "dc", k=2, n_elems=32)
+            b = await spawn_node("kb", "dc", boot=a["t"].addr, k=2, n_elems=32)
+            c = await spawn_node("kc", "dc", boot=a["t"].addr, k=2, n_elems=32)
+            nodes = [a, b, c]
+            try:
+                await prime(nodes)
+                members = ["ka", "kb", "kc"]
+                for n in nodes:
+                    await n["mgr"].reshard(members=members, recover=False)
+                target = np.arange(32, dtype=np.float32)
+                seed_owned(nodes, target)
+                for n in nodes:
+                    await n["mgr"].refresh_replicas()
+                victim = next(n for n in nodes if n["mgr"].owned())
+                survivors = [n for n in nodes if n is not victim]
+
+                async def die():
+                    # In-process stand-in for SIGKILL at this phase.
+                    await victim["dht"].stop()
+                    await victim["t"].close()
+                    raise RuntimeError("chaos: died mid_resharding")
+
+                victim["mgr"]._phase_hooks["mid_resharding"] = die
+                with pytest.raises(RuntimeError):
+                    await victim["mgr"].reshard(
+                        members=members + ["ghost"], recover=False
+                    )
+                left = [n["pid"] for n in survivors]
+                await asyncio.gather(
+                    *(
+                        n["mgr"].reshard(members=left, reason="sigkill")
+                        for n in survivors
+                    )
+                )
+                for s in range(2):
+                    held = [
+                        n["mgr"].store.get(s, allow_replica=False)
+                        for n in survivors
+                        if s in n["mgr"].owned()
+                    ]
+                    assert len(held) == 1 and held[0] is not None, s
+                    np.testing.assert_array_equal(
+                        held[0],
+                        shard_slice(target, survivors[0]["mgr"].ranges, s),
+                    )
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main(), timeout=180)
+
+
+# -- 5. shard-scoped matchmaking ---------------------------------------------
+
+
+class TestShardScopedSchedule:
+    def test_same_shard_grouping_and_id_segment(self):
+        ids = [f"p{z}{s}" for z in "abc" for s in "01"]
+        zones = {pid: f"z{pid[1]}" for pid in ids}
+        shards = {pid: int(pid[2]) for pid in ids}
+        sched = GroupSchedule(target_size=3, cross_zone_every_k=1)
+        for pid in ids:
+            asg = sched.assign(ids, pid, rot=4, zones=zones, shards=shards)
+            assert asg is not None
+            assert asg.shard == shards[pid]
+            assert f".s{shards[pid]}." in f".{asg.group_id}."
+            assert all(shards[m] == shards[pid] for m in asg.members)
+            assert len(asg.members) == 3  # one holder per zone
+        # Distinct shards -> distinct keyspaces by construction.
+        a0 = sched.assign(ids, "pa0", rot=4, zones=zones, shards=shards)
+        a1 = sched.assign(ids, "pa1", rot=4, zones=zones, shards=shards)
+        assert a0.group_id != a1.group_id
+
+    def test_sharded_and_unsharded_views_are_disjoint(self):
+        ids = ["s0a", "s0b", "u0", "u1", "u2"]
+        shards = {"s0a": 0, "s0b": 0}
+        sched = GroupSchedule(target_size=4)
+        asg = sched.assign(ids, "s0a", rot=2, shards=shards)
+        assert set(asg.members) == {"s0a", "s0b"}
+        # The unsharded caller sees only unsharded peers; its undersized
+        # view keeps the LEGACY contract (None -> constant rendezvous
+        # key, which sharded peers never use — so no mixing either way).
+        asg_u = sched.assign(ids, "u0", rot=2, shards=shards)
+        assert asg_u is None
+        big = [f"u{i}" for i in range(8)] + ["s0a", "s0b"]
+        asg_u = sched.assign(big, "u0", rot=2, shards=shards)
+        assert asg_u is not None and asg_u.shard is None
+        assert not set(asg_u.members) & set(shards)
+
+    def test_undersized_sharded_group_returned_not_fallback(self):
+        """A lone shard holder must get a members=(self,) shard-scoped
+        assignment, never the shard-blind constant key (which would
+        rendezvous two different shards' gradients into one round)."""
+        ids = ["a", "b", "c"]
+        sched = GroupSchedule(target_size=4)
+        asg = sched.assign(ids, "a", rot=1, shards={"a": 1})
+        assert asg is not None and asg.members == ("a",)
+        assert asg.shard == 1 and ".s1." in f".{asg.group_id}."
+
+    def test_partition_runs_per_shard_domain(self):
+        ids = [f"p{i}" for i in range(9)]
+        shards = {ids[i]: i % 2 for i in range(6)}  # p6..p8 unsharded
+        groups = GroupSchedule.partition(ids, 2, 3, shards=shards)
+        flat = [p for g in groups for p in g]
+        assert sorted(flat) == sorted(ids)
+        for g in groups:
+            tags = {shards.get(p, "~") for p in g}
+            assert len(tags) == 1, g
+
+
+# -- 6. per-shard mass accounting --------------------------------------------
+
+
+def _balanced(rep):
+    assert (
+        rep["included_weight"] + rep["recovered_weight"]
+        + rep["excluded_weight"] + rep["aborted_weight"]
+        == pytest.approx(rep["armed_weight"], abs=1e-6)
+    )
+    assert (
+        rep["included_slots"] + rep["recovered_slots"]
+        + rep["excluded_slots"] + rep["aborted_slots"]
+        == rep["armed_slots"]
+    )
+
+
+class TestMassByShard:
+    N_ELEMS, CB = 230, 64 * 4
+
+    def test_mid_round_holder_loss_stays_balanced_per_bucket(self):
+        """The property test of ISSUE 20's satellite: included + recovered
+        + excluded + aborted mass stays balanced through a mid-round shard
+        loss — globally AND inside each shard bucket, with the dip
+        confined to the dead holder's bucket."""
+        peers = ["s0a", "s0b", "s1a", "s1b"]
+        shard_of = {"s0a": 0, "s0b": 0, "s1a": 1, "s1b": 1}
+        rng = np.random.default_rng(7)
+        bufs = rng.standard_normal((4, self.N_ELEMS)).astype(np.float32)
+
+        async def main():
+            agg = StreamingAggregator(
+                self.N_ELEMS, peers, "mean", "f32", self.CB,
+                kw_fn=lambda n: {}, pool=TilePool(),
+            )
+            for i, p in enumerate(peers):
+                if p == "s0b":
+                    # The shard-0 holder dies mid-stream: half delivered,
+                    # connection drops.
+                    data = bufs[i].tobytes()
+                    sink = agg.make_sink(p, 2.0, len(data))
+                    sink(0, len(data), data[: 2 * self.CB])
+                    sink.close(False)
+                else:
+                    data = bufs[i].tobytes()
+                    sink = agg.make_sink(p, 1.0, len(data))
+                    for off in range(0, len(data), self.CB):
+                        sink(off, len(data), data[off : off + self.CB])
+                    sink.close(True)
+            await agg.finalize([p for p in peers if p != "s0b"])
+            return agg.mass_report(shard_of)
+
+        rep = run(main())
+        _balanced(rep)
+        assert rep["per_peer"]["s0b"]["outcome"] == "aborted"
+        assert rep["per_peer"]["s0b"]["shard"] == 0
+        by = H.mass_by_shard(rep)
+        assert set(by) == {"s0", "s1"}
+        for sub in by.values():
+            _balanced(sub)
+        assert by["s1"]["mass_committed_frac"] == 1.0
+        assert by["s0"]["mass_committed_frac"] == pytest.approx(1.0 / 3.0)
+        assert sum(b["armed_weight"] for b in by.values()) == pytest.approx(
+            rep["armed_weight"]
+        )
+
+    def test_untagged_round_rolls_into_tilde_bucket(self):
+        rep = H.mass_from_outcomes(["a", "b"], {"a": 1.0, "b": 1.0})
+        by = H.mass_by_shard(rep)
+        assert list(by) == ["~"]
+        assert by["~"]["armed_weight"] == rep["armed_weight"]
+
+    def test_health_monitor_summary_carries_by_shard(self):
+        tele = T.Telemetry(peer_id="hm")
+        tele.health.configure("m")
+        rep = H.mass_report_from_per_peer({
+            "a": {"outcome": "included", "weight": 1.0, "shard": 0},
+            "b": {"outcome": "excluded", "weight": 1.0, "shard": 1},
+        })
+        tele.health.note_round_mass(rep)
+        last = tele.health.summary()["mass"]["last"]
+        assert last["by_shard"]["s0"]["mass_committed_frac"] == 1.0
+        assert last["by_shard"]["s1"]["mass_committed_frac"] == 0.0
+
+
+# -- 7. memory acceptance: train across a zone of K sharded holders ----------
+
+
+def _balanced_ids(zone, k, n_ids=None, want_replicas=True):
+    """Deterministically search peer-id suffixes for a (members, map)
+    where every member holds exactly one shard and replica load spreads
+    to at most one per member — the balanced HSDP layout the memory
+    claim is stated against. HRW is a hash: the right ids exist, and the
+    search is cheap and reproducible."""
+    n_ids = n_ids or k
+    for trial in range(4000):
+        members = tuple(f"v{trial}_{i}" for i in range(n_ids))
+        m = ShardMap(members=members, k=k, gen=0, domain=f"{zone}|")
+        if any(len(m.shards_of(p)) != 1 for p in members):
+            continue
+        if want_replicas and any(
+            len(m.replica_shards_of(p)) > 1 for p in members
+        ):
+            continue
+        return list(members)
+    raise AssertionError("no balanced id set found")
+
+
+class TestShardedTrainingMemory:
+    def test_model_too_big_for_one_holder_trains_across_zone(self):
+        """THE acceptance test: a flat parameter buffer K times bigger
+        than any single holder's measured budget trains to convergence
+        across a zone of K=4 sharded holders, the per-holder memory
+        high-water (own shard + at most one replica) stays a ~2/K sliver
+        of the full replica, and a mid-training holder SIGKILL is
+        recovered by a fenced re-shard WITHOUT restarting the epoch —
+        the loss keeps falling from where it was."""
+        n_elems = 120_000
+        k = 4
+        full_bytes = n_elems * 4
+        ids = _balanced_ids("dc", k)
+
+        async def main():
+            nodes = []
+            boot = None
+            for pid in ids:
+                n = await spawn_node(pid, "dc", boot=boot, k=k, n_elems=n_elems)
+                boot = boot or n["t"].addr
+                nodes.append(n)
+            try:
+                await prime(nodes)
+                for n in nodes:
+                    await n["mgr"].reshard(members=ids, recover=False)
+                # init params: zeros; target c: the optimum to fit.
+                rng = np.random.default_rng(0)
+                c = rng.standard_normal(n_elems).astype(np.float32)
+                for n in nodes:
+                    m = n["mgr"]
+                    for s in m.owned():
+                        lo, hi = m.ranges[s]
+                        m.store.put(s, np.zeros(hi - lo, np.float32))
+
+                def loss():
+                    tot = 0.0
+                    for s in range(k):
+                        holder = next(
+                            n for n in nodes if s in n["mgr"].owned()
+                        )
+                        x = holder["mgr"].store.get(s, allow_replica=False)
+                        lo, hi = holder["mgr"].ranges[s]
+                        tot += float(np.sum((x - c[lo:hi]) ** 2))
+                    return 0.5 * tot
+
+                def step(lr=0.5):
+                    # Quadratic loss decomposes per element: each holder
+                    # steps its OWN shard slice; nothing else ever
+                    # materializes the full buffer.
+                    for n in nodes:
+                        m = n["mgr"]
+                        for s in m.owned():
+                            lo, hi = m.ranges[s]
+                            x = m.store.get(s, allow_replica=False)
+                            m.store.put(s, x - lr * (x - c[lo:hi]))
+
+                l0 = loss()
+                for _ in range(4):
+                    step()
+                # Commit-time replica refresh (what makes rung 1 land).
+                for n in nodes:
+                    await n["mgr"].refresh_replicas()
+                l_mid = loss()
+                assert l_mid < l0 / 10.0
+                # Memory high-water: own shard + at most one replica —
+                # a ~2/K sliver, strictly under any full replica.
+                for n in nodes:
+                    peak = n["mgr"].store.peak_bytes
+                    assert peak <= 0.55 * full_bytes, (n["pid"], peak)
+                    assert peak >= full_bytes // k  # it does hold its cut
+                # Mid-training kill: no epoch restart — the survivors
+                # re-shard, recover the dead holder's slice from the
+                # replica, and the loss CONTINUES falling from l_mid.
+                victim = nodes[0]
+                await victim["dht"].stop()
+                await victim["t"].close()
+                survivors = nodes[1:]
+                left = [n["pid"] for n in survivors]
+                await asyncio.gather(
+                    *(
+                        n["mgr"].reshard(members=left, reason="sigkill")
+                        for n in survivors
+                    )
+                )
+                nodes[:] = survivors
+                for s in range(k):
+                    assert any(
+                        s in n["mgr"].owned()
+                        and n["mgr"].store.get(s, allow_replica=False)
+                        is not None
+                        for n in nodes
+                    ), f"shard {s} unrecovered after kill"
+                l_rec = loss()
+                assert l_rec <= l_mid * 1.001, "recovery lost progress"
+                for _ in range(4):
+                    step()
+                assert loss() < l_rec / 10.0, "training stalled after kill"
+                # Even through recovery nobody materialized a full replica.
+                for n in nodes:
+                    assert n["mgr"].store.peak_bytes < full_bytes
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main(), timeout=240)
+
+
+# -- 8. sharded swarm rounds: kill-at-phase + bytes-vs-K ---------------------
+
+
+def pinned_schedule(rot_cell, target, min_size=2):
+    return GroupSchedule(
+        target_size=target, rotation_s=1000.0, min_size=min_size,
+        cross_zone_every_k=1,  # every rotation crosses zones
+        clock=lambda: rot_cell["rot"] * 1000.0 + 0.5,
+    )
+
+
+async def spawn_sharded(zone_shards, rot_cell, *, target=3, **avg_kw):
+    """Volunteers advertising (zone, shard): ``zone_shards`` maps zone ->
+    list of shard tags (None = unsharded). Returns [(t, dht, mem, avg,
+    zone, shard)]."""
+    vols = []
+    boot = None
+    kw = {"join_timeout": 6.0, "gather_timeout": 8.0, "min_group": 2,
+          "max_group": 3 * target, **avg_kw}
+    i = 0
+    for zone, shard_tags in zone_shards.items():
+        for s in shard_tags:
+            t = Transport()
+            dht = DHTNode(t)
+            await dht.start(bootstrap=[boot] if boot else None)
+            boot = boot or t.addr
+            extra = {"zone": zone}
+            if s is not None:
+                extra["shard"] = int(s)
+            mem = SwarmMembership(dht, f"vol{i}", ttl=10.0, extra_info=extra)
+            await mem.join()
+            avg = SyncAverager(
+                t, dht, mem,
+                group_schedule=pinned_schedule(rot_cell, target), **kw
+            )
+            vols.append((t, dht, mem, avg, zone, s))
+            i += 1
+    for v in vols:
+        await v[2].alive_peers()
+    return vols
+
+
+async def teardown_vols(vols):
+    for t, dht, mem, _, _, _ in vols:
+        try:
+            await mem.leave()
+        except Exception:
+            pass
+        try:
+            await dht.stop()
+        except Exception:
+            pass
+        await t.close()
+
+
+def tree(v, elems=64):
+    return {"w": np.full((elems,), v, np.float32)}
+
+
+class TestShardedRounds:
+    def test_cross_round_averages_only_same_shard(self):
+        """3 zones x 2 shards: a cross rotation forms one trio per shard,
+        each commits ITS shard's mean under a ``.s<k>.`` group id, and
+        the two shards' rounds never mix."""
+        rot_cell = {"rot": 0}
+
+        async def main():
+            vols = await spawn_sharded(
+                {"za": [0, 1], "zb": [0, 1], "zc": [0, 1]}, rot_cell
+            )
+            try:
+                rot_cell["rot"] = 1
+                results = await asyncio.gather(
+                    *(
+                        v[3].average(tree(float(i)), round_no=1)
+                        for i, v in enumerate(vols)
+                    )
+                )
+                shard_vals = {}
+                for i, v in enumerate(vols):
+                    shard_vals.setdefault(v[5], []).append(float(i))
+                for i, (v, res) in enumerate(zip(vols, results)):
+                    assert res is not None, f"vol{i} skipped"
+                    np.testing.assert_allclose(
+                        res["w"], statistics.mean(shard_vals[v[5]]), rtol=1e-5
+                    )
+                    gs = v[3].group_stats()
+                    assert gs["shard"] == v[5]
+                    assert f".s{v[5]}." in f".{gs['group_id']}."
+            finally:
+                await teardown_vols(vols)
+
+        run(main(), timeout=180)
+
+    @pytest.mark.chaos
+    @pytest.mark.failover
+    @pytest.mark.parametrize("phase", ["pre_arm", "mid_stream"])
+    def test_shard_holder_kill_commits_round_and_stays_shard_local(
+        self, phase
+    ):
+        """Kill the shard-0 trio's leader at an instrumented phase: the
+        shard-1 trio must commit its exact mean with ZERO failover
+        activity (loss stays shard-local), while shard-0's survivors
+        recover via the PR-4 machinery under the shard-scoped keys and
+        commit through the loss. The remaining phases (subprocess
+        SIGKILL) run in tests/test_sharding_e2e.py."""
+        rot_cell = {"rot": 0}
+
+        async def main():
+            vols = await spawn_sharded(
+                {"za": [0, 1], "zb": [0, 1], "zc": [0, 1]}, rot_cell
+            )
+            try:
+                rot_cell["rot"] = 1
+                by_pid = {f"vol{i}": v for i, v in enumerate(vols)}
+                s0 = [f"vol{i}" for i, v in enumerate(vols) if v[5] == 0]
+                s1 = [f"vol{i}" for i, v in enumerate(vols) if v[5] == 1]
+                victim_pid = min(s0)  # smallest id leads (no bw adv)
+                victim = by_pid[victim_pid]
+
+                async def die():
+                    await victim[0].close()
+                    raise RuntimeError("chaos: shard-holder killed")
+
+                victim[3]._phase_hooks[phase] = die
+
+                async def one(i, v):
+                    try:
+                        return await v[3].average(
+                            tree(float(i)), round_no=2
+                        )
+                    except Exception:
+                        return None
+
+                results = await asyncio.gather(
+                    *(one(i, v) for i, v in enumerate(vols))
+                )
+                res_of = {f"vol{i}": r for i, r in enumerate(results)}
+                s1_mean = statistics.mean(float(p[3:]) for p in s1)
+                for p in s1:
+                    assert res_of[p] is not None, f"{p} failed to commit"
+                    np.testing.assert_allclose(
+                        res_of[p]["w"], s1_mean, rtol=1e-5
+                    )
+                    assert by_pid[p][3].leaders_deposed == 0
+                    assert by_pid[p][3].rounds_recovered == 0
+                survivors = [p for p in s0 if p != victim_pid]
+                assert any(
+                    by_pid[p][3].rounds_recovered >= 1 for p in survivors
+                ), "shard-0 survivors did not recover"
+                surv_mean = statistics.mean(float(q[3:]) for q in survivors)
+                committed = [p for p in survivors if res_of[p] is not None]
+                assert committed, "no shard-0 survivor committed"
+                for p in committed:
+                    np.testing.assert_allclose(
+                        res_of[p]["w"], surv_mean, rtol=1e-5
+                    )
+            finally:
+                await teardown_vols(vols)
+
+        run(main(), timeout=180)
+
+
+class TestShardBenchSmoke:
+    def test_sharded_beats_replicated_on_cross_zone_bytes(self):
+        """THE bytes-vs-K smoke (fails loudly if sharding stops paying
+        for itself): same model, 2 zones, K in {1, 2, 4} — per-volunteer
+        cross-zone bytes per committed round must fall ~linearly in K,
+        and by >= 1.5x from replicated (K=1) to K=2, and again to K=4.
+        The banked artifact is experiments/results/shard_bench.json."""
+        from experiments.shard_bench import run_config
+
+        by_k = {}
+        for k in (1, 2, 4):
+            by_k[k] = run(
+                run_config(k, tree_elems=32768, rounds=3), timeout=300
+            )
+        for k, res in by_k.items():
+            assert res["commit_frac"] >= 0.7, (k, res)
+        b1 = by_k[1]["xz_bytes_per_commit"]
+        b2 = by_k[2]["xz_bytes_per_commit"]
+        b4 = by_k[4]["xz_bytes_per_commit"]
+        assert b1 / max(b2, 1.0) >= 1.5, by_k
+        assert b2 / max(b4, 1.0) >= 1.5, by_k
+
+
+# -- control-plane snapshot deltas (satellite 1) -----------------------------
+
+
+class TestSnapshotDeltas:
+    def _force_version(self, rep):
+        rep._psig_t = -1e9  # bypass the per-interval amortization
+
+    def test_second_exchange_is_a_delta(self):
+        from distributedvolunteercomputing_tpu.swarm.control_plane import (
+            ControlPlaneClient,
+            ControlPlaneReplica,
+        )
+
+        async def main():
+            t0 = Transport()
+            d0 = DHTNode(t0)
+            await d0.start()
+            rep = ControlPlaneReplica(t0, d0, rid="r0", interval=60.0)
+            await rep.start()
+            t1 = Transport()
+            d1 = DHTNode(t1)
+            await d1.start(bootstrap=[t0.addr])
+            cp = ControlPlaneClient(t1, d1, "va")
+            try:
+                await cp.refresh(force=True)
+                rec = {"addr": list(t1.addr), "t": 1.0, "zone": "dc"}
+                ret = await cp.exchange(rec, ttl=30.0)
+                snap1 = cp.merge_peers_reply(ret)
+                assert "peers" in ret and "peers_delta" not in ret
+                assert cp.counters["peers_full_replies"] == 1
+                assert "va" in snap1
+                # Nothing significant changed: the next exchange ships a
+                # delta, and it is EMPTY (the beat timestamp moving is
+                # not a membership change).
+                self._force_version(rep)
+                ret2 = await cp.exchange(dict(rec, t=2.0), ttl=30.0)
+                snap2 = cp.merge_peers_reply(ret2)
+                assert isinstance(ret2.get("peers_delta"), dict)
+                assert ret2["peers_delta"] == {}
+                assert cp.counters["peers_delta_replies"] == 1
+                assert set(snap2) == set(snap1)
+                # The beats sidecar still feeds the failure detector.
+                assert snap2["va"]["t"] == pytest.approx(2.0)
+                # A significant change ships exactly the changed record.
+                self._force_version(rep)
+                ret3 = await cp.exchange(
+                    dict(rec, t=3.0, zone="home"), ttl=30.0
+                )
+                snap3 = cp.merge_peers_reply(ret3)
+                delta = ret3.get("peers_delta")
+                assert isinstance(delta, dict) and list(delta) == ["va"]
+                assert snap3["va"]["zone"] == "home"
+            finally:
+                await d1.stop()
+                await t1.close()
+                await d0.stop()
+                await t0.close()
+
+        run(main())
+
+    def test_departure_tombstone_delivered_exactly_once(self):
+        from distributedvolunteercomputing_tpu.swarm.control_plane import (
+            ControlPlaneClient,
+            ControlPlaneReplica,
+        )
+
+        async def main():
+            t0 = Transport()
+            d0 = DHTNode(t0)
+            await d0.start()
+            rep = ControlPlaneReplica(t0, d0, rid="r0", interval=60.0)
+            await rep.start()
+            t1 = Transport()
+            d1 = DHTNode(t1)
+            await d1.start(bootstrap=[t0.addr])
+            cp = ControlPlaneClient(t1, d1, "vb")
+            try:
+                await cp.refresh(force=True)
+                rec = {"addr": list(t1.addr), "t": 1.0}
+                # Another peer exists, then departs (record expires from
+                # the replica's merged view).
+                other = {"addr": ["h", 9], "t": 1.0}
+                await rep._rpc_exchange(
+                    {"peer": "ghost", "record": other, "ttl": 0.05}, b""
+                )
+                ret = await cp.exchange(rec, ttl=30.0)
+                snap = cp.merge_peers_reply(ret)
+                assert "ghost" in snap
+                await asyncio.sleep(0.1)  # ghost's heartbeat lease expires
+                # The serving view drops a departed peer at its interval
+                # refresh; force that (and the version diff) now.
+                rep._peers_view.pop("ghost", None)
+                self._force_version(rep)
+                ret2 = await cp.exchange(dict(rec, t=2.0), ttl=30.0)
+                snap2 = cp.merge_peers_reply(ret2)
+                delta = ret2.get("peers_delta")
+                assert isinstance(delta, dict) and delta.get("ghost", 1) is None
+                # Tombstone visible THIS merge (the membership layer's
+                # one-shot departure semantics), gone from the cache after.
+                assert "ghost" in snap2 and snap2["ghost"] is None
+                self._force_version(rep)
+                ret3 = await cp.exchange(dict(rec, t=3.0), ttl=30.0)
+                snap3 = cp.merge_peers_reply(ret3)
+                assert "ghost" not in snap3
+            finally:
+                await d1.stop()
+                await t1.close()
+                await d0.stop()
+                await t0.close()
+
+        run(main())
+
+    def test_rid_mismatch_and_stale_version_force_full(self):
+        from distributedvolunteercomputing_tpu.swarm.control_plane import (
+            ControlPlaneClient,
+            ControlPlaneReplica,
+        )
+
+        async def main():
+            t0 = Transport()
+            d0 = DHTNode(t0)
+            await d0.start()
+            rep = ControlPlaneReplica(t0, d0, rid="r0", interval=60.0)
+            await rep.start()
+            t1 = Transport()
+            d1 = DHTNode(t1)
+            await d1.start(bootstrap=[t0.addr])
+            cp = ControlPlaneClient(t1, d1, "vc")
+            try:
+                await cp.refresh(force=True)
+                rec = {"addr": list(t1.addr), "t": 1.0}
+                cp.merge_peers_reply(await cp.exchange(rec, ttl=30.0))
+                # Failover echo: the version came from ANOTHER replica's
+                # sequence -> the server must fall back to a full.
+                cp._peers_rid = "other-replica"
+                ret = await cp.exchange(dict(rec, t=2.0), ttl=30.0)
+                assert "peers" in ret and "peers_delta" not in ret
+                cp.merge_peers_reply(ret)
+                assert cp._peers_rid == "r0"  # re-adopted this replica
+                # A client staler than the change log covers: same.
+                cp._peers_ver = -100
+                ret2 = await cp.exchange(dict(rec, t=3.0), ttl=30.0)
+                assert "peers" in ret2 and "peers_delta" not in ret2
+                # Legacy replica (no versioning fields): client degrades
+                # to full-replace semantics with no version echo.
+                assert cp.merge_peers_reply({"peers": {"x": {"t": 1.0}}}) == {
+                    "x": {"t": 1.0}
+                }
+                assert cp._peers_ver is None and cp._peers_rid is None
+            finally:
+                await d1.stop()
+                await t1.close()
+                await d0.stop()
+                await t0.close()
+
+        run(main())
+
+    def test_membership_adopts_via_merge_and_legacy_fallback(self):
+        class DeltaCP:
+            def merge_peers_reply(self, ret):
+                return {"a": {"t": 1.0}}
+
+        class LegacyCP:
+            pass
+
+        assert SwarmMembership._reply_peers(DeltaCP(), {"peers": {}}) == {
+            "a": {"t": 1.0}
+        }
+        assert SwarmMembership._reply_peers(
+            LegacyCP(), {"peers": {"b": {"t": 2.0}}}
+        ) == {"b": {"t": 2.0}}
+
+    def test_significance_signature_ignores_beat_and_jitter(self):
+        from distributedvolunteercomputing_tpu.swarm.control_plane import (
+            ControlPlaneReplica as R,
+        )
+
+        base = {"addr": ["h", 1], "t": 100.0, "bw": 104.2}
+        assert R._peers_sig(base) == R._peers_sig(dict(base, t=200.0))
+        # 1% bandwidth wiggle: same 2-sig-digit quantum, no version bump.
+        assert R._peers_sig(base) == R._peers_sig(dict(base, bw=104.9))
+        # A real change IS significant.
+        assert R._peers_sig(base) != R._peers_sig(dict(base, bw=250.0))
+        assert R._peers_sig(base) != R._peers_sig(dict(base, zone="dc"))
+        assert R._peers_sig(None) == "~"
+
+
+# -- doctor rule + SLO + controller + telemetry ------------------------------
+
+
+class TestShardObservability:
+    def test_flight_severities_documented(self):
+        assert T.KIND_SEVERITY["shard_lost"] == "warn"
+        assert T.KIND_SEVERITY["shard_recovered"] == "info"
+        assert T.KIND_SEVERITY["shard_fence_rejected"] == "warn"
+        assert T.KIND_SEVERITY["shard_recovery_failed"] == "page"
+
+    def test_doctor_ranks_shard_zone_degraded_above_symptoms(self):
+        from experiments.doctor_report import diagnose
+
+        bundle = {
+            "alerts": [
+                {"kind": "slo_burn", "key": "shard_recovery_latency",
+                 "severity": "page"},
+                {"kind": "mass_frac_drop", "key": "mass", "severity": "warn"},
+            ],
+            "flight": {
+                "vol0": [
+                    {"kind": "shard_lost", "shard": 1, "holder": "vol2",
+                     "gen": 3},
+                    {"kind": "shard_recovery_failed", "shard": 1, "gen": 3},
+                ],
+            },
+        }
+        hyps = diagnose(bundle)
+        assert hyps and hyps[0]["cause"] == "shard_zone_degraded"
+        assert "vol2" in hyps[0]["peers"]
+        assert "fenced re-shard" in hyps[0]["chain"]
+        ev = hyps[0]["evidence"]
+        assert ev["shard_lost_events"] == 1
+        assert ev["shard_recovery_latency_alerts"] == 1
+        assert ev["losses_by_holder"] == {"vol2": 1}
+
+    def test_doctor_quiet_without_losses_and_tempered_by_recovery(self):
+        from experiments.doctor_report import diagnose
+
+        assert diagnose({"alerts": [], "flight": {}}) == []
+        # Losses all recovered promptly, no symptoms: the system working.
+        healthy = {
+            "alerts": [],
+            "flight": {
+                "vol0": [
+                    {"kind": "shard_lost", "shard": 0, "holder": "x", "gen": 1},
+                    {"kind": "shard_recovered", "shard": 0, "gen": 1,
+                     "src": "zone_replica", "dt_s": 0.2},
+                ],
+            },
+        }
+        sick = {
+            "alerts": [
+                {"kind": "slo_burn", "key": "shard_recovery_latency"},
+            ],
+            "flight": {
+                "vol0": [
+                    {"kind": "shard_lost", "shard": 0, "holder": "x", "gen": 1},
+                    {"kind": "shard_recovery_failed", "shard": 0, "gen": 1},
+                ],
+            },
+        }
+        h_ok = diagnose(healthy)
+        h_bad = diagnose(sick)
+        assert h_bad and h_bad[0]["cause"] == "shard_zone_degraded"
+        if h_ok:  # may drop below reporting entirely
+            assert h_ok[0]["score"] < h_bad[0]["score"]
+
+    def test_watchdog_shard_recovery_latency_slo(self):
+        from distributedvolunteercomputing_tpu.swarm import watchdog as W
+
+        sw = W.SwarmWatchdog()
+        now = 1000.0
+        # Unsharded (no sharding section): the SLO never ticks or burns.
+        for _ in range(30):
+            sw.evaluate([{"peer": "p", "recv_t": now}], now=now)
+            now += 5.0
+        firing = {a["key"] for a in sw.alerts_status([], now)["firing"]}
+        assert "shard_recovery_latency" not in firing
+        # Recoveries blowing the bound: the SLO burns.
+        for _ in range(30):
+            sw.evaluate(
+                [{
+                    "peer": "p", "recv_t": now,
+                    "sharding": {"recent_recovery_latency_s": 40.0},
+                }],
+                now=now,
+            )
+            now += 5.0
+        firing = {
+            (a["kind"], a["key"])
+            for a in sw.alerts_status([], now)["firing"]
+        }
+        assert ("slo_burn", "shard_recovery_latency") in firing
+
+    def test_controller_regime_feeds_on_shard_health(self):
+        from distributedvolunteercomputing_tpu.swarm import controller as C
+        from distributedvolunteercomputing_tpu.swarm.resilience import (
+            ResiliencePolicy,
+        )
+
+        c = C.SwarmController(
+            policy=ResiliencePolicy(max_deadline_s=10.0),
+            telemetry=T.Telemetry(peer_id="c0"),
+        )
+        assert c.regime("intra") == "calm"
+        for _ in range(30):
+            c.observe_shard_health(level="intra", ok=False)
+            c.advance()
+        assert c.regime("intra") != "calm"
+        for _ in range(80):
+            c.observe_shard_health(level="intra", ok=True)
+            c.advance()
+        assert c.regime("intra") == "calm"
+
+    def test_manager_summary_feeds_telemetry_source(self):
+        """Attaching a shard manager to an averager registers the
+        ``sharding`` report section (what the watchdog + campaign read)."""
+
+        async def main():
+            n = await spawn_node("ts", "dc", k=2, n_elems=16)
+            try:
+                await n["mgr"].reshard(members=["ts"], recover=False)
+                avg = SyncAverager(
+                    n["t"], n["dht"], n["mem"], shard_manager=n["mgr"],
+                )
+                scrape = avg.telemetry.registry.scrape()["metrics"]
+                assert scrape["sharding.k"]["values"][0]["value"] == 2.0
+                assert "sharding.gen" in scrape
+            finally:
+                await teardown_nodes([n])
+
+        run(main())
+
+
+# -- ring-lowering gauge (satellite 6) ---------------------------------------
+
+
+class TestRingLoweringGauge:
+    def test_vmem_fallback_surfaces_in_stats(self):
+        from distributedvolunteercomputing_tpu.ops import mesh_collective as MC
+        from distributedvolunteercomputing_tpu.ops.mesh_codec import MeshCodec
+
+        codec = MeshCodec(backend="host")
+        st = codec.stats()
+        assert st["ring_lower"] is None
+        assert st["ring_vmem_fallbacks"] == 0
+        # A folder configured for the compiled kernel whose working set
+        # blows the VMEM estimate: the re-lowering must not be silent.
+        f = MC.RingMeanFolder.__new__(MC.RingMeanFolder)
+        f.codec = codec
+        f._lower_cfg = "compiled"
+        f.n_tiles, f.shard, f.tile_elems = 4096, 4096, 32768
+        assert f._lower_for(per_dev=64) == "xla"
+        st = codec.stats()
+        assert st["ring_lower_effective"] == "xla"
+        assert st["ring_vmem_fallbacks"] == 1
+        assert "VMEM cap" in st["ring_lower_fallback"]
+        # Within budget: the kernel stays, and the gauge says so.
+        f.n_tiles, f.shard, f.tile_elems = 2, 128, 256
+        assert f._lower_for(per_dev=2) == "compiled"
+        assert codec.stats()["ring_lower_effective"] == "compiled"
+        assert codec.stats()["ring_vmem_fallbacks"] == 1  # history kept
+
+    def test_warning_fires_once_per_codec(self, caplog):
+        import logging
+
+        from distributedvolunteercomputing_tpu.ops import mesh_collective as MC
+        from distributedvolunteercomputing_tpu.ops.mesh_codec import MeshCodec
+
+        codec = MeshCodec(backend="host")
+        f = MC.RingMeanFolder.__new__(MC.RingMeanFolder)
+        f.codec = codec
+        f._lower_cfg = "compiled"
+        f.n_tiles, f.shard, f.tile_elems = 4096, 4096, 32768
+        with caplog.at_level(logging.WARNING):
+            f._lower_for(per_dev=64)
+            f._lower_for(per_dev=64)
+        warns = [
+            r for r in caplog.records
+            if "fell back compiled->xla" in r.getMessage()
+        ]
+        assert len(warns) == 1
+        assert codec.ring_vmem_fallbacks == 2
+
+
+# -- sharded checkpoints -----------------------------------------------------
+
+
+class TestShardSnapshots:
+    def test_save_load_assemble_roundtrip(self, tmp_path):
+        from distributedvolunteercomputing_tpu.training.checkpoint import (
+            assemble_full,
+            load_shard_snapshot,
+            save_shard_snapshot,
+        )
+
+        n_elems, k = 50, 3
+        target = np.arange(n_elems, dtype=np.float32)
+        ranges = shard_ranges(n_elems, k)
+        smaps = {}
+        dirs = []
+        members = ("ca", "cb", "cc")
+        m = ShardMap(members=members, k=k, gen=2, domain="dc|m")
+        for pid in members:
+            store = ShardStore()
+            for s in m.shards_of(pid):
+                store.put(s, shard_slice(target, ranges, s).copy())
+            d = save_shard_snapshot(str(tmp_path / pid), store, m, step=7)
+            dirs.append(d)
+            smaps[pid] = store
+        loaded = load_shard_snapshot(dirs[0], k)
+        assert loaded["meta"]["step"] == 7 and loaded["meta"]["gen"] == 2
+        full = assemble_full(dirs, n_elems, k)
+        np.testing.assert_array_equal(full, target)
+
+    def test_k_mismatch_refused(self, tmp_path):
+        from distributedvolunteercomputing_tpu.training.checkpoint import (
+            load_shard_snapshot,
+            save_shard_snapshot,
+        )
+
+        store = ShardStore()
+        m = ShardMap(members=("x",), k=2, gen=0)
+        store.put(0, np.zeros(5, np.float32))
+        d = save_shard_snapshot(str(tmp_path / "x"), store, m, step=1)
+        with pytest.raises(ValueError, match="differently-cut"):
+            load_shard_snapshot(d, 4)
