@@ -20,14 +20,21 @@ Three deliberate design rules keep churn survivable:
   member's shards move, everyone else's stay put — a modulo assignment
   would reshuffle nearly every shard on every membership change and turn
   each churn event into a zone-wide state migration.
-- **Every map version is generation-fenced** exactly like leader failover
-  (PR 4): re-sharding bumps a monotone generation, every ``shard.fetch``
-  carries the requester's (domain, generation), and both ends reject a
+- **Every shard move is membership-fenced** exactly like leader failover
+  (PR 4), except the fence token is a CONTENT digest of the map (domain,
+  K, sorted member set) rather than a counter: every ``shard.fetch``
+  carries the requester's (domain, fence), and both ends reject a
   same-domain mismatch — so a deposed holder's late serve (or a stale
   puller's adoption) can never mix an old map's bytes into a newer one.
-  Generations are per-zone sequences, so the cross-zone rung is instead
-  guarded by the ADOPTER-side fence: the puller's own map must be
-  unchanged through the pull, or the bytes are discarded.
+  Two peers agree on the fence iff they adopted the SAME membership,
+  even when their local ``gen`` counters disagree (a late joiner or
+  restarted volunteer starts at gen 0 while incumbents are at gen N; a
+  slow peer collapses two quick churn events into one reshard) — the
+  generation is a purely local version number kept for logs and flight
+  events, never compared across peers. The cross-zone rung (an
+  independent domain) is instead guarded by the ADOPTER-side fence: the
+  puller's own map must be unchanged through the pull, or the bytes are
+  discarded.
 
 Recovery ladder on holder loss (PR 13's hedged-fetch shape):
 
@@ -35,7 +42,12 @@ Recovery ladder on holder loss (PR 13's hedged-fetch shape):
    freshest copy, one intra-zone hop);
 2. the zone REPLICA (the HRW runner-up keeps a copy refreshed at commits;
    a SIGKILLed holder's shard is served from here);
-3. any CROSS-zone holder of the same shard (discovered via the DHT shard
+3. any SAME-zone peer announcing the shard — including a demoted
+   ex-holder still LINGERING the bytes: a holder demoted below
+   runner-up at a reshard keeps its copy for a grace window instead of
+   dropping it immediately, so a joiner-heavy churn event cannot strand
+   the zone's only copy before the new holder has pulled it;
+4. any CROSS-zone holder of the same shard (discovered via the DHT shard
    announce — the other zones replicate the full tree collectively).
 
 Candidates are raced hedged: the first is dialed immediately, the next
@@ -119,6 +131,21 @@ class ShardMap:
             raise ValueError(f"k must be >= 1, got {self.k}")
         if self.gen < 0:
             raise ValueError(f"gen must be >= 0, got {self.gen}")
+
+    @property
+    def fence(self) -> str:
+        """The fencing token: a content digest of (domain, K, members).
+        Two peers compute the same fence iff they adopted the same
+        membership — unlike ``gen``, which is a purely local counter
+        that skews across peers who observed a different number of
+        churn events (a late joiner starts at 0, an incumbent is at N);
+        comparing gens across peers would wedge in-zone recovery
+        forever on such skew."""
+        h = hashlib.blake2b(
+            f"{self.domain}|k{self.k}|{'|'.join(self.members)}".encode(),
+            digest_size=8,
+        )
+        return h.hexdigest()
 
     @staticmethod
     def _rank(domain: str, shard: int, pid: str) -> int:
@@ -241,6 +268,16 @@ class ShardManager:
     # recovery ladder's analog of the averaging round budget).
     FETCH_BUDGET_S = 6.0
     ANNOUNCE_TTL = 30.0
+    # Grace window a demoted ex-holder keeps (lingers) its old copy for
+    # after a reshard, so the new holder — possibly a joiner with no
+    # prior map — can still pull the zone's only copy instead of
+    # falling back to a cold checkpoint restore.
+    DEMOTED_LINGER_S = 60.0
+    # Consecutive maintain() beats a changed membership snapshot must
+    # persist before it triggers a fenced reshard: a peer whose beat is
+    # merely delayed past the snapshot max-age must not cost the zone a
+    # gen bump, shard_lost events, and a round of recovery pulls.
+    RESHARD_DEBOUNCE_BEATS = 2
     # Recent-window for the SLO metric riding the report beat: a recovery
     # slower than the bound must burn for a while, not forever.
     RECENT_WINDOW_S = 120.0
@@ -296,6 +333,16 @@ class ShardManager:
         # shard -> holder under the PREVIOUS map: the recovery ladder's
         # first rung (a graceful leaver still serves for a grace period).
         self._prev_holders: Dict[int, str] = {}
+        # shard -> (bytes, expiry): copies this peer was demoted out of
+        # at a reshard, lingering until the new holder has pulled them.
+        self._demoted: Dict[int, Tuple[np.ndarray, float]] = {}
+        # maintain()'s reshard debounce: the candidate member list, how
+        # many consecutive beats it has been observed unchanged, and how
+        # many consecutive beats the map has disagreed with the snapshot
+        # at all (the backstop against a flapping view never settling).
+        self._pending_members: Optional[List[str]] = None
+        self._pending_beats = 0
+        self._stale_beats = 0
         self._phase_hooks: Dict[str, Callable[[], Any]] = {}
         self._maint_task: Optional[asyncio.Task] = None
         self._announced_t = float("-inf")
@@ -346,6 +393,12 @@ class ShardManager:
             extra.pop("shard", None)
         else:
             extra["shard"] = int(p)
+
+    def _prune_demoted(self, now: Optional[float] = None) -> None:
+        """Expire lingering demoted copies whose grace window closed."""
+        now = self.clock() if now is None else now
+        for s in [s for s, (_, exp) in self._demoted.items() if exp <= now]:
+            del self._demoted[s]
 
     # -- chaos instrumentation ---------------------------------------------
 
@@ -400,44 +453,66 @@ class ShardManager:
     # -- serving (fenced) ---------------------------------------------------
 
     async def _rpc_fetch(self, args: dict, payload: bytes):
-        """Fenced shard serve. The requester names the generation it is
-        recovering INTO; any mismatch is rejected on this side (and the
-        reply generation is re-validated on the puller side), so bytes can
-        only ever move between two peers that agree on the map version —
-        the leader-failover fencing rule, applied to state.
+        """Fenced shard serve. The requester names the map it is
+        recovering INTO via the content fence (domain + K + member set);
+        any same-domain mismatch is rejected on this side (and the reply
+        fence is re-validated on the puller side), so bytes can only
+        ever move between two peers that adopted the SAME membership —
+        the leader-failover fencing rule, applied to state. Generations
+        are deliberately NOT compared across peers: they are local
+        counters and skew under uneven churn observation (a late joiner
+        is at gen 0 while an incumbent is at gen N). A legacy request
+        naming no fence falls back to strict generation equality.
 
-        The fence is DOMAIN-scoped: generations are per-zone sequences,
-        so a cross-zone rung pull (different ``domain``) is served at
-        whatever this zone currently holds — the ranges are schema-stable
-        by construction, and the puller's adopter-side fence (map
-        unchanged through the pull) is what guards that path. A request
-        naming OUR domain, or a legacy request naming none, is held to
-        strict generation equality."""
+        The fence is DOMAIN-scoped: a cross-zone rung pull (different
+        ``domain``) is served at whatever this zone currently holds —
+        the ranges are schema-stable by construction, and the puller's
+        adopter-side fence (map unchanged through the pull) is what
+        guards that path.
+
+        A shard no longer assigned here may still be served from the
+        lingering demoted copy (grace window after a reshard): that is
+        exactly the path a joiner-promoted holder pulls through."""
         if self.map is None:
             raise RPCError("no shard map yet")
         shard = int(args["shard"])
         gen = int(args.get("gen", -1))
         dom = args.get("domain")
-        if (dom is None or dom == self.domain) and gen != self.map.gen:
-            self.fence_rejections += 1
-            self._record(
-                "shard_fence_rejected",
-                shard=shard,
-                got_gen=gen,
-                have_gen=self.map.gen,
-                requester=str(args.get("peer", "?")),
+        req_fence = args.get("fence")
+        if dom is None or dom == self.domain:
+            stale = (
+                req_fence != self.map.fence
+                if req_fence is not None
+                else gen != self.map.gen
             )
-            raise RPCError(
-                f"shard fencing mismatch: requester gen {gen} vs map gen "
-                f"{self.map.gen}"
-            )
+            if stale:
+                self.fence_rejections += 1
+                self._record(
+                    "shard_fence_rejected",
+                    shard=shard,
+                    got_gen=gen,
+                    have_gen=self.map.gen,
+                    got_fence=req_fence,
+                    have_fence=self.map.fence,
+                    requester=str(args.get("peer", "?")),
+                )
+                raise RPCError(
+                    f"shard fencing mismatch: requester fence {req_fence}"
+                    f"/gen {gen} vs map fence {self.map.fence}"
+                    f"/gen {self.map.gen}"
+                )
         arr = self.store.get(shard)
+        if arr is None:
+            ent = self._demoted.get(shard)
+            if ent is not None and ent[1] > self.clock():
+                arr = ent[0]
         if arr is None:
             raise RPCError(f"shard {shard} not held here")
         return (
             {
                 "shard": shard,
                 "gen": self.map.gen,
+                "fence": self.map.fence,
                 "total": int(arr.nbytes),
                 "wire": "f32",
             },
@@ -447,9 +522,12 @@ class ShardManager:
     # -- discovery ----------------------------------------------------------
 
     async def announce(self) -> None:
-        """Publish (addr, zone, gen, shards) under the shard key — the
-        cross-zone rung's candidate source. Call on the heartbeat cadence
-        (the volunteer's announce loop); TTL'd like peer records."""
+        """Publish (addr, zone, gen, shards, lingering) under the shard
+        key — the announce-rung candidate source, both same-zone
+        (demoted lingering copies included, so a joiner-promoted holder
+        can find the ex-holder's grace copy) and cross-zone. Call on the
+        heartbeat cadence (the volunteer's announce loop); TTL'd like
+        peer records."""
         if self.map is None:
             return
         await self.dht.store(
@@ -459,29 +537,42 @@ class ShardManager:
                 "zone": self.zone,
                 "gen": self.map.gen,
                 "shards": self.owned(),
+                "lingering": sorted(self._demoted),
             },
             subkey=self.peer_id,
             ttl=self.ANNOUNCE_TTL,
         )
 
-    async def _cross_zone_candidates(self, shard: int) -> List[Tuple[str, Addr]]:
+    async def _announced_candidates(
+        self, shard: int
+    ) -> Tuple[List[Tuple[str, Addr]], List[Tuple[str, Addr]]]:
+        """(same_zone, cross_zone) peers announcing ``shard`` — owned or
+        lingering. The same-zone list is the ladder rung that reaches a
+        demoted ex-holder a joiner has no previous map to name; the
+        cross-zone list is the last rung."""
         try:
             records = await self.dht.get(self.announce_key)
         except Exception as e:  # noqa: BLE001 — discovery hiccup: rung is empty
             log.debug("shard announce lookup failed: %s", errstr(e))
-            return []
-        out: List[Tuple[str, Addr]] = []
+            return [], []
+        same: List[Tuple[str, Addr]] = []
+        cross: List[Tuple[str, Addr]] = []
         for pid, rec in (records or {}).items():
             if pid == self.peer_id or not isinstance(rec, dict):
                 continue
-            if str(rec.get("zone") or "") == self.zone:
-                continue  # intra-zone rungs already ran
-            if shard not in (rec.get("shards") or []):
+            if shard not in (rec.get("shards") or []) and shard not in (
+                rec.get("lingering") or []
+            ):
                 continue
             addr = rec.get("addr")
-            if isinstance(addr, (list, tuple)) and len(addr) == 2:
-                out.append((pid, (str(addr[0]), int(addr[1]))))
-        return out
+            if not (isinstance(addr, (list, tuple)) and len(addr) == 2):
+                continue
+            dst = (str(addr[0]), int(addr[1]))
+            if str(rec.get("zone") or "") == self.zone:
+                same.append((pid, dst))
+            else:
+                cross.append((pid, dst))
+        return same, cross
 
     # -- re-shard (fenced handoff) ------------------------------------------
 
@@ -538,20 +629,34 @@ class ShardManager:
             # (and a DVC_CHAOS_SHARD_DIE_PHASE subprocess must die at a
             # real re-shard, not at its own startup).
             await self._phase("mid_resharding")
-        # Drop shards neither owned nor replicated under the new map —
+        # Demote shards neither owned nor replicated under the new map —
         # AFTER the phase point, so a mid-resharding kill leaves the old
-        # copies for the survivors' ladders.
+        # copies for the survivors' ladders. Demoted bytes are NOT
+        # dropped: they linger for a grace window so the new holder
+        # (possibly a joiner with no copy anywhere in the zone yet) can
+        # still pull them through the fenced fetch path — dropping at
+        # reshard would strand the zone's only copy whenever a holder is
+        # demoted below runner-up by joiners.
+        now = self.clock()
+        self._prune_demoted(now)
         owned = set(new.shards_of(self.peer_id))
         repl = set(new.replica_shards_of(self.peer_id))
         for s in self.store.held():
             if s not in owned:
+                arr = self.store.get(s, allow_replica=False)
                 if s in repl:
-                    arr = self.store.get(s, allow_replica=False)
                     if arr is not None:
                         self.store.put(s, arr, replica=True)
+                elif arr is not None:
+                    self._demoted[s] = (arr, now + self.DEMOTED_LINGER_S)
                 self.store.drop(s)
         for s in self.store.replicas():
             if s not in repl and s not in owned:
+                arr = self.store.get(s)
+                if arr is not None:
+                    self._demoted.setdefault(
+                        s, (arr, now + self.DEMOTED_LINGER_S)
+                    )
                 self.store.drop(s, replica=True)
         self.feed_controller()
         summary = {"gen": new.gen, "changed": True, "lost": lost}
@@ -594,8 +699,35 @@ class ShardManager:
         drive."""
         out: Dict[str, Any] = {"resharded": False, "recovered": [],
                                "replicas": []}
+        self._prune_demoted()
         members = sorted(set(await self._zone_members()) | {self.peer_id})
-        if self.map is None or list(self.map.members) != members:
+        reshard_now = self.map is None
+        if self.map is not None and list(self.map.members) != members:
+            # Debounce: membership snapshots flap at heartbeat
+            # resolution (a merely-delayed beat looks like a departure
+            # for one beat, then un-looks like one). Require the changed
+            # member set to persist across consecutive beats before
+            # paying for a fenced reshard — gen churn both moves shard
+            # bytes for peers that never died and re-fences in-flight
+            # pulls.
+            self._stale_beats += 1
+            if members == self._pending_members:
+                self._pending_beats += 1
+            else:
+                self._pending_members, self._pending_beats = members, 1
+            # The backstop (2x the debounce) covers a view flapping
+            # BETWEEN values every beat: the candidate never stabilizes,
+            # but the map must not stay stale forever.
+            reshard_now = (
+                self._pending_beats >= self.RESHARD_DEBOUNCE_BEATS
+                or self._stale_beats >= 2 * self.RESHARD_DEBOUNCE_BEATS
+            )
+        elif self.map is not None:
+            self._pending_members, self._pending_beats = None, 0
+            self._stale_beats = 0
+        if reshard_now:
+            self._pending_members, self._pending_beats = None, 0
+            self._stale_beats = 0
             res = await self.reshard(members=members)
             out["resharded"] = bool(res.get("changed"))
             out["recovered"] = res.get("recovered", [])
@@ -643,21 +775,44 @@ class ShardManager:
         missing = self.missing()
         if not missing or self.map is None:
             return []
+        # return_exceptions: one shard's unexpected failure (an
+        # exception type the hedge loop doesn't anticipate) must not
+        # cancel every sibling shard's in-flight recovery and abort the
+        # whole beat.
         results = await asyncio.gather(
-            *(self._recover_shard(s) for s in missing)
+            *(self._recover_shard(s) for s in missing),
+            return_exceptions=True,
         )
         self.feed_controller()
-        return [s for s, ok in zip(missing, results) if ok]
+        got: List[int] = []
+        for s, res in zip(missing, results):
+            if isinstance(res, BaseException):
+                log.warning(
+                    "shard %d recovery raised unexpectedly: %s",
+                    s, errstr(res),
+                )
+            elif res:
+                got.append(s)
+        return got
 
     async def _recover_shard(self, shard: int) -> bool:
         assert self.map is not None
         gen = self.map.gen
+        fence = self.map.fence
         t0 = self.clock()
         self._recovering.add(shard)
         try:
             # Rung 0, zero RPCs: we were the shard's replica — promote.
             if self.store.promote(shard):
                 self._note_recovered(shard, gen, "local_replica", t0)
+                return True
+            # Rung 0.5, still zero RPCs: we held this shard before a
+            # demotion and the lingering copy has not expired (the
+            # A->B->A membership wobble on a single-zone swarm).
+            ent = self._demoted.pop(shard, None)
+            if ent is not None and ent[1] > self.clock():
+                self.store.put(shard, ent[0])
+                self._note_recovered(shard, gen, "lingering_local", t0)
                 return True
             cands: List[Tuple[str, str]] = []
             prev = self._prev_holders.get(shard)
@@ -672,9 +827,14 @@ class ShardManager:
                 addr = rec.get("addr")
                 if isinstance(addr, (list, tuple)) and len(addr) == 2:
                     targets.append((src, pid, (str(addr[0]), int(addr[1]))))
-            for pid, addr in await self._cross_zone_candidates(shard):
+            same, cross = await self._announced_candidates(shard)
+            seen = {pid for _, pid, _ in targets}
+            for pid, addr in same:
+                if pid not in seen:
+                    targets.append(("zone_announce", pid, addr))
+            for pid, addr in cross:
                 targets.append(("cross_zone", pid, addr))
-            arr, src = await self._hedged_fetch(shard, gen, targets)
+            arr, src = await self._hedged_fetch(shard, gen, fence, targets)
             if arr is None:
                 self.recoveries_failed += 1
                 self._record(
@@ -688,15 +848,17 @@ class ShardManager:
                     shard, gen, len(targets),
                 )
                 return False
-            if self.map is None or self.map.gen != gen:
+            if self.map is None or self.map.fence != fence:
                 # The map moved under us mid-pull (another churn event):
-                # adopting would mix generations — the fencing rule's
+                # adopting would mix memberships — the fencing rule's
                 # adopter half. The NEXT reshard's ladder runs fresh.
                 self._record(
                     "shard_fence_rejected",
                     shard=shard,
                     got_gen=gen,
                     have_gen=self.map.gen if self.map else -1,
+                    got_fence=fence,
+                    have_fence=self.map.fence if self.map else None,
                     requester=self.peer_id,
                 )
                 return False
@@ -720,7 +882,11 @@ class ShardManager:
         )
 
     async def _hedged_fetch(
-        self, shard: int, gen: int, targets: List[Tuple[str, str, Addr]]
+        self,
+        shard: int,
+        gen: int,
+        fence: Optional[str],
+        targets: List[Tuple[str, str, Addr]],
     ) -> Tuple[Optional[np.ndarray], str]:
         """Race the ladder: first target dialed immediately, the next
         joins after the hedge soft deadline, first success wins (losers
@@ -745,7 +911,7 @@ class ShardManager:
                     idx += 1
                     t = asyncio.create_task(
                         self._fetch_from(
-                            addr, shard, gen,
+                            addr, shard, gen, fence=fence,
                             cross_domain=(src == "cross_zone"),
                         )
                     )
@@ -763,7 +929,7 @@ class ShardManager:
                     src = pending.pop(t)
                     try:
                         arr = t.result()
-                    except (RPCError, OSError, asyncio.TimeoutError, ValueError) as e:
+                    except Exception as e:  # noqa: BLE001 — any one rung's failure just advances the ladder
                         log.debug(
                             "shard %d fetch via %s failed: %s",
                             shard, src, errstr(e),
@@ -777,31 +943,50 @@ class ShardManager:
                 t.cancel()
 
     async def _fetch_from(
-        self, addr: Addr, shard: int, gen: int, *, cross_domain: bool = False
+        self,
+        addr: Addr,
+        shard: int,
+        gen: int,
+        *,
+        fence: Optional[str] = None,
+        cross_domain: bool = False,
     ) -> np.ndarray:
+        args = {
+            "shard": shard,
+            "gen": gen,
+            "peer": self.peer_id,
+            "domain": self.domain,
+        }
+        if fence is not None:
+            args["fence"] = fence
         ret, payload = await self.transport.call(
             addr,
             "shard.fetch",
-            {
-                "shard": shard,
-                "gen": gen,
-                "peer": self.peer_id,
-                "domain": self.domain,
-            },
+            args,
             timeout=self.FETCH_TIMEOUT,
             connect_timeout=self.CONNECT_TIMEOUT,
             # Bulk transfer: keep it out of the failure detector's
             # control-plane latency EWMA (state_sync's rule).
             record_latency=False,
         )
-        # A cross-domain serve reports the SERVING zone's generation — an
+        # A cross-domain serve reports the SERVING zone's map — an
         # independent sequence, so equality is meaningless there; the
         # adopter-side fence in _recover_shard (our map unchanged through
-        # the pull) is the guard on that rung.
-        if not cross_domain and int(ret.get("gen", -1)) != gen:
-            raise RPCError(
-                f"shard fencing mismatch in reply: gen {ret.get('gen')} != {gen}"
-            )
+        # the pull) is the guard on that rung. Same-domain replies are
+        # held to the content fence when we named one (a deposed
+        # holder's stale serve reports a stale fence), and to gen
+        # equality on the legacy gen-only path.
+        if not cross_domain:
+            if fence is not None:
+                if ret.get("fence") != fence:
+                    raise RPCError(
+                        "shard fencing mismatch in reply: fence "
+                        f"{ret.get('fence')} != {fence}"
+                    )
+            elif int(ret.get("gen", -1)) != gen:
+                raise RPCError(
+                    f"shard fencing mismatch in reply: gen {ret.get('gen')} != {gen}"
+                )
         lo, hi = self.ranges[shard]
         arr = np.frombuffer(bytes(payload), np.float32)
         if arr.size != hi - lo:
@@ -839,7 +1024,8 @@ class ShardManager:
                 continue
             try:
                 arr = await self._fetch_from(
-                    (str(addr[0]), int(addr[1])), s, self.map.gen
+                    (str(addr[0]), int(addr[1])), s, self.map.gen,
+                    fence=self.map.fence,
                 )
             except (RPCError, OSError, asyncio.TimeoutError, ValueError) as e:
                 log.debug("replica refresh of shard %d failed: %s", s, errstr(e))
@@ -854,6 +1040,10 @@ class ShardManager:
         mid-stream (the leader folds this + replay instead of aborting
         the epoch; the mass accounting books the slot recovered)."""
         arr = self.store.get(shard, allow_replica=True)
+        if arr is None:
+            ent = self._demoted.get(shard)
+            if ent is not None and ent[1] > self.clock():
+                arr = ent[0]
         return None if arr is None else arr.copy()
 
     # -- report surface ------------------------------------------------------
@@ -876,10 +1066,12 @@ class ShardManager:
         return {
             "k": self.k,
             "gen": m.gen if m else None,
+            "fence": m.fence if m else None,
             "zone": self.zone,
             "members": len(m.members) if m else 0,
             "owned": self.owned(),
             "replica": self.store.replicas(),
+            "lingering": sorted(self._demoted),
             "missing": self.missing(),
             "health": self.health(),
             "bytes": self.store.bytes(),

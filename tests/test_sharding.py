@@ -293,6 +293,107 @@ class TestFencing:
 
         run(main())
 
+    def test_gen_skew_same_members_still_serves(self):
+        """THE fence-skew regression: each peer's gen is a purely local
+        counter, so a peer that walked to the same membership through a
+        different number of reshards (here: a late adopter that saw an
+        intermediate map) sits at a different gen than its zone-mate.
+        The fence is a content digest of the member set, so in-zone
+        fetches between the two MUST still flow — a counter-equality
+        fence would reject them forever and silently kill in-zone
+        recovery."""
+
+        async def main():
+            a = await spawn_node("ga", "dc", k=2, n_elems=64)
+            b = await spawn_node("gb", "dc", boot=a["t"].addr, k=2, n_elems=64)
+            nodes = [a, b]
+            try:
+                await prime(nodes)
+                # a adopts {ga,gb} in one hop (gen 0); b walks there via
+                # an intermediate solo map (gen 1): skewed counters,
+                # identical membership.
+                await a["mgr"].reshard(members=["ga", "gb"], recover=False)
+                await b["mgr"].reshard(members=["gb"], recover=False)
+                await b["mgr"].reshard(members=["ga", "gb"], recover=False)
+                assert a["mgr"].map.gen != b["mgr"].map.gen
+                assert a["mgr"].map.fence == b["mgr"].map.fence
+                target = np.arange(64, dtype=np.float32)
+                seed_owned(nodes, target)
+                holder = a if a["mgr"].owned() else b
+                other = b if holder is a else a
+                s = holder["mgr"].owned()[0]
+                arr = await other["mgr"]._fetch_from(
+                    holder["t"].addr, s, other["mgr"].map.gen,
+                    fence=other["mgr"].map.fence,
+                )
+                np.testing.assert_array_equal(
+                    arr, shard_slice(target, holder["mgr"].ranges, s)
+                )
+                assert holder["mgr"].fence_rejections == 0
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main())
+
+    def test_diverged_member_sets_rejected_even_with_equal_gens(self):
+        """The converse of the skew case: two peers whose counters
+        HAPPEN to collide (both at gen 0) but who adopted different
+        memberships must NOT exchange bytes — the content fence differs
+        exactly when the maps do."""
+
+        async def main():
+            a = await spawn_node("ha", "dc", k=2, n_elems=64)
+            b = await spawn_node("hb", "dc", boot=a["t"].addr, k=2, n_elems=64)
+            nodes = [a, b]
+            try:
+                await prime(nodes)
+                await a["mgr"].reshard(members=["ha", "hb"], recover=False)
+                await b["mgr"].reshard(members=["hb"], recover=False)
+                assert a["mgr"].map.gen == b["mgr"].map.gen == 0
+                assert a["mgr"].map.fence != b["mgr"].map.fence
+                a["mgr"].store.put(0, np.zeros(32, np.float32))
+                with pytest.raises(RPCError, match="fencing mismatch"):
+                    await b["mgr"]._fetch_from(
+                        a["t"].addr, 0, b["mgr"].map.gen,
+                        fence=b["mgr"].map.fence,
+                    )
+                assert a["mgr"].fence_rejections == 1
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main())
+
+    def test_lying_fence_reply_rejected_by_puller(self):
+        async def main():
+            a = await spawn_node("lfa", "dc", k=1, n_elems=16)
+            b = await spawn_node("lfb", "dc", boot=a["t"].addr, k=1, n_elems=16)
+            nodes = [a, b]
+            try:
+                await prime(nodes)
+                for n in nodes:
+                    await n["mgr"].reshard(members=["lfa", "lfb"], recover=False)
+                target = np.ones(16, np.float32)
+                seed_owned(nodes, target)
+                holder = a if a["mgr"].owned() else b
+                other = b if holder is a else a
+                orig = holder["mgr"]._rpc_fetch
+
+                async def lying(args, payload):
+                    ret, data = await orig(args, payload)
+                    ret["fence"] = "deadbeefdeadbeef"
+                    return ret, data
+
+                holder["t"].register("shard.fetch", lying)
+                with pytest.raises(RPCError, match="fencing mismatch in reply"):
+                    await other["mgr"]._fetch_from(
+                        holder["t"].addr, 0, other["mgr"].map.gen,
+                        fence=other["mgr"].map.fence,
+                    )
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main())
+
     def test_map_moved_mid_pull_discards_bytes(self):
         """The adopter fence: a reshard landing between the fetch dispatch
         and the adoption discards the pulled bytes instead of mixing an
@@ -548,6 +649,238 @@ class TestReshardRecovery:
                 await teardown_nodes(nodes)
 
         run(main(), timeout=180)
+
+
+def _demotion_ids():
+    """Ids where the {a,b} map makes ``a`` the single shard's holder,
+    and BOTH joiners c,d outrank ``a`` in the {a,b,c,d} map — so one
+    membership change demotes the incumbent below runner-up (HRW ranks
+    are per-pid, so a lone joiner can only ever displace the holder to
+    replica; it takes two to push it off the replica slot too)."""
+    for trial in range(20000):
+        a, b, c, d = (f"q{trial}{x}" for x in "abcd")
+        if ShardMap(
+            members=(a, b), k=1, gen=0, domain="dc|"
+        ).holder_of(0) != a:
+            continue
+        m4 = ShardMap(members=(a, b, c, d), k=1, gen=0, domain="dc|")
+        if set(m4.ranking(0)[:2]) == {c, d}:
+            return a, b, c, d
+    raise AssertionError("no demotion id quad found")
+
+
+class TestDemotionLinger:
+    def test_demoted_holder_lingers_for_joiner_promoted_holder(self):
+        """Review regression: two joiners outrank the incumbent holder,
+        so the new holder is a joiner with no copy and no previous map,
+        and the old holder is demoted below runner-up. The demoted
+        incumbent must LINGER its bytes through the reshard (not drop
+        them) and the joiner must reach them via the same-zone announce
+        rung — otherwise a pure membership change with no process death
+        loses the zone's only copy and forces a cold-checkpoint
+        restore. The incumbents' gens also skew from the joiners' (1 vs
+        0), so this only works because the fence is content-based."""
+        ia, ib, ic, id_ = _demotion_ids()
+
+        async def main():
+            a = await spawn_node(ia, "dc", k=1, n_elems=16)
+            b = await spawn_node(ib, "dc", boot=a["t"].addr, k=1, n_elems=16)
+            c = await spawn_node(ic, "dc", boot=a["t"].addr, k=1, n_elems=16)
+            d = await spawn_node(id_, "dc", boot=a["t"].addr, k=1, n_elems=16)
+            nodes = [a, b, c, d]
+            members = [ia, ib, ic, id_]
+            try:
+                await prime(nodes)
+                for n in (a, b):
+                    await n["mgr"].reshard(members=[ia, ib], recover=False)
+                assert a["mgr"].owned() == [0]
+                target = np.linspace(1.0, 2.0, 16).astype(np.float32)
+                a["mgr"].store.put(0, target.copy())
+                # The churn: c and d join, everyone adopts {a,b,c,d}.
+                for n in nodes:
+                    await n["mgr"].reshard(members=members, recover=False)
+                new_holder = next(
+                    n for n in nodes if n["mgr"].owned() == [0]
+                )
+                assert new_holder in (c, d)  # a joiner took the shard
+                assert new_holder["mgr"].map.gen != a["mgr"].map.gen
+                assert new_holder["mgr"].map.fence == a["mgr"].map.fence
+                # Demoted below runner-up: not held, not replica — but
+                # lingering, and announced as such.
+                assert a["mgr"].store.held() == []
+                assert a["mgr"].store.replicas() == []
+                assert a["mgr"].summary()["lingering"] == [0]
+                await a["mgr"].announce()
+                nm = new_holder["mgr"]
+                nm.store.drop(0)  # joiner truly has nothing
+                recovered = await nm.ensure_shards()
+                assert recovered == [0]
+                np.testing.assert_array_equal(
+                    nm.store.get(0, allow_replica=False), target
+                )
+                srcs = {e["src"] for e in events_of(nm, "shard_recovered")}
+                assert srcs == {"zone_announce"}
+            finally:
+                await teardown_nodes(nodes)
+
+        run(main(), timeout=180)
+
+    def test_lingering_copy_expires_after_grace_window(self):
+        async def main():
+            now = [1000.0]
+            a = await spawn_node("xga", "dc", k=1, n_elems=8)
+            try:
+                m = a["mgr"]
+                m.clock = lambda: now[0]
+                await m.reshard(members=["xga"], recover=False)
+                m._demoted[0] = (
+                    np.ones(8, np.float32),
+                    now[0] + m.DEMOTED_LINGER_S,
+                )
+                assert m.degraded_copy(0) is not None
+                now[0] += m.DEMOTED_LINGER_S + 1.0
+                assert m.degraded_copy(0) is None
+                m._prune_demoted()
+                assert m.summary()["lingering"] == []
+            finally:
+                await teardown_nodes([a])
+
+        run(main())
+
+    def test_regained_shard_adopted_from_lingering_copy(self):
+        """The A->B->A wobble on a single-zone swarm: a holder demoted
+        and re-promoted within the grace window re-adopts its own
+        lingering bytes with zero RPCs."""
+
+        async def main():
+            a = await spawn_node("wga", "dc", k=1, n_elems=8)
+            try:
+                m = a["mgr"]
+                await m.reshard(members=["wga", "wgb"], recover=False)
+                target = np.full(8, 5.0, np.float32)
+                m._demoted[0] = (target, m.clock() + m.DEMOTED_LINGER_S)
+                m.store.drop(0)
+                # Force ownership regardless of HRW by re-sharding solo:
+                # the shard comes home, and the lingering copy serves it.
+                await m.reshard(members=["wga"], recover=False)
+                assert m.owned() == [0]
+                recovered = await m.ensure_shards()
+                assert recovered == [0]
+                np.testing.assert_array_equal(
+                    m.store.get(0, allow_replica=False), target
+                )
+                srcs = {e["src"] for e in events_of(m, "shard_recovered")}
+                assert "lingering_local" in srcs
+            finally:
+                await teardown_nodes([a])
+
+        run(main())
+
+
+class TestRecoveryIsolation:
+    def test_unexpected_recovery_error_does_not_abort_siblings(self):
+        """Review regression: one shard's recovery raising an exception
+        type the ladder doesn't anticipate must not cancel the other
+        shards' in-flight recoveries or abort the maintenance beat."""
+
+        async def main():
+            a = await spawn_node("iso", "dc", k=2, n_elems=16)
+            try:
+                m = a["mgr"]
+                await m.reshard(members=["iso"], recover=False)
+                assert sorted(m.missing()) == [0, 1]
+                real = m._recover_shard
+
+                async def flaky(s):
+                    if s == 0:
+                        raise RuntimeError("boom: transport exploded")
+                    lo, hi = m.ranges[s]
+                    m.store.put(s, np.zeros(hi - lo, np.float32))
+                    return True
+
+                m._recover_shard = flaky
+                got = await m.ensure_shards()
+                assert got == [1]
+                m._recover_shard = real
+            finally:
+                await teardown_nodes([a])
+
+        run(main())
+
+
+class TestMaintainDebounce:
+    def test_transient_membership_flap_does_not_reshard(self):
+        """Review regression: a peer whose heartbeat is merely delayed
+        past the snapshot max-age window must not cost the zone a gen
+        bump + shard_lost + recovery pulls; only a membership change
+        that PERSISTS across consecutive beats reshards."""
+
+        async def main():
+            a = await spawn_node("dba", "dc", k=2, n_elems=16)
+            try:
+                m = a["mgr"]
+                view = [["dba", "dbb"]]
+
+                async def zm():
+                    return list(view[0])
+
+                m._zone_members = zm
+                # Initial adoption is immediate (no map to protect).
+                out = await m.maintain()
+                assert out["resharded"] and m.map.gen == 0
+                for s in m.owned():
+                    lo, hi = m.ranges[s]
+                    m.store.put(s, np.zeros(hi - lo, np.float32))
+                count0 = m.resharding_count
+                # One flapped beat: dbb's record aged past the snapshot
+                # window, then came back. No reshard, no gen churn.
+                view[0] = ["dba"]
+                out = await m.maintain()
+                assert not out["resharded"]
+                view[0] = ["dba", "dbb"]
+                out = await m.maintain()
+                assert not out["resharded"]
+                assert m.resharding_count == count0 and m.map.gen == 0
+                # A persistent change (two consecutive beats) reshards.
+                view[0] = ["dba"]
+                out = await m.maintain()
+                assert not out["resharded"]
+                out = await m.maintain()
+                assert out["resharded"]
+                assert m.map.members == ("dba",) and m.map.gen == 1
+            finally:
+                await teardown_nodes([a])
+
+        run(main())
+
+    def test_flapping_view_still_reshards_via_backstop(self):
+        """A view alternating between two member sets never stabilizes
+        the debounce candidate — the staleness backstop must still
+        re-shard rather than leave the map stale forever."""
+
+        async def main():
+            a = await spawn_node("dbf", "dc", k=2, n_elems=16)
+            try:
+                m = a["mgr"]
+                view = [["dbf", "dbg"]]
+
+                async def zm():
+                    return list(view[0])
+
+                m._zone_members = zm
+                await m.maintain()
+                assert m.map.gen == 0
+                flip = [["dbf"], ["dbf", "dbh"]]
+                resharded = False
+                for i in range(2 * m.RESHARD_DEBOUNCE_BEATS):
+                    view[0] = flip[i % 2]
+                    out = await m.maintain()
+                    resharded = resharded or out["resharded"]
+                assert resharded, "flapping view wedged the map stale"
+            finally:
+                await teardown_nodes([a])
+
+        run(main())
 
 
 # -- 5. shard-scoped matchmaking ---------------------------------------------
